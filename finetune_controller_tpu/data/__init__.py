@@ -1,0 +1,4 @@
+from .synthetic import synthetic_batches
+from .loader import jsonl_token_batches, batches_from_tokens
+
+__all__ = ["synthetic_batches", "jsonl_token_batches", "batches_from_tokens"]

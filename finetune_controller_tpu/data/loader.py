@@ -1,0 +1,142 @@
+"""Host-side data pipeline: tokenized-JSONL → packed fixed-length batches.
+
+Datasets are files in the object store (the control plane downloads them to a
+local path before launch, mirroring the reference's init-container `s3 cp`
+seam — reference ``app/jobs/kubeflow/PyTorchJobDeployer.py:70-91``).
+
+Supported formats:
+  * ``.jsonl`` with ``{"tokens": [...]}`` rows (pre-tokenized), or
+    ``{"text": "..."}`` rows tokenized with a byte-level fallback tokenizer
+    (or a HuggingFace ``tokenizers`` file when provided);
+  * ``.npy`` — a flat int32 token stream.
+
+Packing: documents are concatenated into a flat stream with per-document
+``segment_ids`` so attention never crosses document boundaries, then cut into
+(batch, seq_len) blocks — the TPU-friendly static-shape layout.
+
+Multi-host: each process takes a strided shard of the block stream
+(``shard_index``/``shard_count``), so no two hosts train on the same block.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Iterator, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _byte_tokenize(text: str) -> list[int]:
+    return list(text.encode("utf-8"))
+
+
+def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[list[int]]:
+    if path.endswith(".npy"):
+        return [np.load(path).astype(np.int32).tolist()]
+    tokenizer = None
+    if tokenizer_file:
+        from tokenizers import Tokenizer
+
+        tokenizer = Tokenizer.from_file(tokenizer_file)
+    docs: list[list[int]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if "tokens" in row:
+                docs.append([int(t) for t in row["tokens"]])
+            elif "text" in row:
+                if tokenizer is not None:
+                    docs.append(tokenizer.encode(row["text"]).ids)
+                else:
+                    docs.append(_byte_tokenize(row["text"]))
+            else:
+                raise ValueError("jsonl rows must have a 'tokens' or 'text' field")
+    if not docs:
+        raise ValueError(f"no documents found in {path}")
+    return docs
+
+
+def pack_documents(
+    docs: Sequence[Sequence[int]], seq_len: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Concatenate docs → (n_blocks, seq_len) token and segment-id arrays."""
+    stream: list[int] = []
+    segs: list[int] = []
+    for i, d in enumerate(docs):
+        stream.extend(d)
+        segs.extend([i + 1] * len(d))
+    n_blocks = max(len(stream) // seq_len, 1)
+    if len(stream) < seq_len:  # pad tiny datasets up to one block
+        pad = seq_len - len(stream)
+        stream = list(stream) + [0] * pad
+        segs = list(segs) + [0] * pad
+    tokens = np.asarray(stream[: n_blocks * seq_len], np.int32).reshape(n_blocks, seq_len)
+    segments = np.asarray(segs[: n_blocks * seq_len], np.int32).reshape(n_blocks, seq_len)
+    return tokens, segments
+
+
+def batches_from_tokens(
+    tokens: np.ndarray,
+    segments: np.ndarray | None,
+    batch_size: int,
+    seed: int = 0,
+    shard_index: int = 0,
+    shard_count: int = 1,
+) -> Iterator[dict]:
+    """Infinite shuffled batch iterator over packed blocks."""
+    n = tokens.shape[0]
+    rng = np.random.default_rng(seed)
+
+    def make_batch(idx: np.ndarray) -> dict:
+        batch = {
+            "tokens": tokens[idx],
+            "loss_mask": (segments[idx] > 0).astype(np.float32)
+            if segments is not None
+            else np.ones_like(tokens[idx], np.float32),
+        }
+        if segments is not None:
+            batch["segment_ids"] = segments[idx]
+        return batch
+
+    warned = False
+    while True:
+        order = rng.permutation(n)
+        order = order[shard_index::shard_count]
+        if not len(order):
+            # Fewer blocks than hosts — unavoidable overlap; warn once.
+            if not warned:
+                logger.warning(
+                    "dataset has %d blocks for %d shards; shard %d falls back "
+                    "to the full block set (hosts will overlap)",
+                    n, shard_count, shard_index,
+                )
+                warned = True
+            order = rng.permutation(n)
+        for i in range(0, len(order) - batch_size + 1, batch_size):
+            yield make_batch(order[i : i + batch_size])
+        if len(order) < batch_size:
+            # Shard smaller than one batch: tile this shard's own blocks.
+            yield make_batch(np.resize(order, batch_size))
+
+
+def jsonl_token_batches(
+    path: str,
+    batch_size: int,
+    seq_len: int,
+    tokenizer_file: str | None = None,
+    seed: int = 0,
+    shard_index: int = 0,
+    shard_count: int = 1,
+) -> Iterator[dict]:
+    docs = load_token_documents(path, tokenizer_file)
+    tokens, segments = pack_documents(docs, seq_len)
+    return batches_from_tokens(
+        tokens, segments, batch_size, seed=seed,
+        shard_index=shard_index, shard_count=shard_count,
+    )

@@ -1,0 +1,4 @@
+from .llama import LlamaConfig, LlamaForCausalLM, PRESETS
+from .lora import LoRAConfig, LoRADense
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "PRESETS", "LoRAConfig", "LoRADense"]

@@ -1,0 +1,234 @@
+"""Llama-family decoder (RMSNorm + RoPE + GQA + SwiGLU) in Flax linen.
+
+TPU-first choices:
+  * layers run under ``nn.scan`` (one traced layer, stacked params) so XLA
+    compiles one block body instead of N — critical for compile latency on
+    real models;
+  * per-layer rematerialisation (``nn.remat``) trades FLOPs for HBM;
+  * bf16 compute / f32 params+softmax;
+  * attention dispatches through ``ops.causal_attention`` (XLA or Pallas).
+
+Capability parity note: the reference framework contains no model code at all
+(training is a user container — SURVEY.md §2.2); this module is the in-repo
+compute plane that replaces it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import causal_attention
+from .lora import LoRAConfig, LoRADense
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 22
+    n_heads: int = 32
+    n_kv_heads: int = 4
+    d_ff: int = 5632
+    rope_theta: float = 10000.0
+    max_seq_len: int = 2048
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"
+    remat: bool = True
+    scan_layers: bool = True
+    tie_embeddings: bool = False
+    lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def replace(self, **kw) -> "LlamaConfig":
+        return dataclasses.replace(self, **kw)
+
+    def param_count(self) -> int:
+        d, v, f, L = self.d_model, self.vocab_size, self.d_ff, self.n_layers
+        kvd = self.n_kv_heads * self.head_dim
+        per_layer = d * d + 2 * d * kvd + d * d + 3 * d * f + 2 * d
+        return v * d + L * per_layer + d + (0 if self.tie_embeddings else d * v)
+
+
+# Architecture presets for the BASELINE.md configs (shapes per the public
+# model cards; weights are random-init — no network egress in this build).
+PRESETS: dict[str, LlamaConfig] = {
+    "tiny-test": LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128,
+    ),
+    "tinyllama-1.1b": LlamaConfig(),
+    "llama3-8b": LlamaConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, rope_theta=500000.0, max_seq_len=8192,
+    ),
+    "mistral-7b": LlamaConfig(
+        vocab_size=32768, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=8192,
+    ),
+}
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: (B, S, H, D), positions: (B, S)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", nn.initializers.ones_init(), (x.shape[-1],), self.param_dtype
+        )
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+def _proj(cfg: LlamaConfig, name: str, features: int) -> LoRADense:
+    lora_on = cfg.lora.enabled_for(name)
+    return LoRADense(
+        features=features,
+        name=name,
+        lora_rank=cfg.lora.rank if lora_on else 0,
+        lora_alpha=cfg.lora.alpha,
+        lora_dropout=cfg.lora.dropout,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    )
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids, deterministic=True):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+        q = _proj(cfg, "q_proj", cfg.n_heads * hd)(x, deterministic)
+        k = _proj(cfg, "k_proj", cfg.n_kv_heads * hd)(x, deterministic)
+        v = _proj(cfg, "v_proj", cfg.n_kv_heads * hd)(x, deterministic)
+        q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions, cfg.rope_theta)
+        k = apply_rope(k.reshape(b, s, cfg.n_kv_heads, hd), positions, cfg.rope_theta)
+        v = v.reshape(b, s, cfg.n_kv_heads, hd)
+        out = causal_attention(q, k, v, impl=cfg.attention_impl, segment_ids=segment_ids)
+        return _proj(cfg, "o_proj", cfg.d_model)(out.reshape(b, s, -1), deterministic)
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        gate = _proj(cfg, "gate_proj", cfg.d_ff)(x, deterministic)
+        up = _proj(cfg, "up_proj", cfg.d_ff)(x, deterministic)
+        return _proj(cfg, "down_proj", cfg.d_model)(nn.silu(gate) * up, deterministic)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids, deterministic=True):
+        cfg = self.cfg
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="attn_norm")(x)
+        x = x + Attention(cfg, name="attn")(h, positions, segment_ids, deterministic)
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="mlp_norm")(x)
+        return x + MLP(cfg, name="mlp")(h, deterministic)
+
+
+class _ScanBlock(nn.Module):
+    """Block adapted to nn.scan's (carry, *broadcast) -> (carry, out) shape."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids, deterministic=True):
+        y = Block(self.cfg, name="block")(x, positions, segment_ids, deterministic)
+        return y, None
+
+
+class LlamaForCausalLM(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, segment_ids=None, deterministic=True):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="embed_tokens",
+        )
+        x = embed(tokens)
+
+        if cfg.scan_layers:
+            block_cls = _ScanBlock
+            if cfg.remat:
+                block_cls = nn.remat(
+                    _ScanBlock,
+                    prevent_cse=False,
+                    # arg 4 = deterministic (0 is self): a static python bool
+                    static_argnums=(4,),
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "lora": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.n_layers,
+            )(cfg, name="blocks")
+            x, _ = stack(x, positions, segment_ids, deterministic)
+        else:
+            block_cls = (
+                nn.remat(Block, prevent_cse=False, static_argnums=(4,))
+                if cfg.remat
+                else Block
+            )
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids, deterministic)
+
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = x @ embed.embedding.astype(cfg.dtype).T
+        else:
+            logits = LoRADense(
+                cfg.vocab_size,
+                name="lm_head",
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+            )(x)
+        return logits.astype(jnp.float32)
+
+    def init_variables(self, rng: jax.Array, batch: int = 1, seq: int = 8):
+        tokens = jnp.zeros((batch, seq), jnp.int32)
+        return self.init({"params": rng}, tokens)

@@ -1,0 +1,89 @@
+"""LoRA adapters as a first-class parameter collection.
+
+The frozen base weights live in the ``"params"`` collection; adapters live in
+a separate ``"lora"`` collection.  The trainer differentiates only w.r.t. the
+trainable collection, so no gradients or optimizer state are ever materialised
+for the frozen base — the property that makes 8B LoRA fit a v5e chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+DEFAULT_TARGETS = (
+    "q_proj",
+    "k_proj",
+    "v_proj",
+    "o_proj",
+    "gate_proj",
+    "up_proj",
+    "down_proj",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 0            # 0 disables LoRA (full fine-tune)
+    alpha: float = 16.0
+    dropout: float = 0.0
+    targets: Sequence[str] = DEFAULT_TARGETS
+
+    def enabled_for(self, name: str) -> bool:
+        return self.rank > 0 and name in self.targets
+
+
+class LoRADense(nn.Module):
+    """Dense layer with an optional low-rank adapter branch.
+
+    ``y = x @ W  +  (alpha / r) * (x @ A) @ B`` with ``A: (in, r)`` normal-init
+    and ``B: (r, out)`` zero-init, so the adapter starts as identity.
+    """
+
+    features: int
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel", self.kernel_init, (in_features, self.features), self.param_dtype
+        )
+        y = x @ kernel.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype
+            )
+            y = y + bias.astype(self.dtype)
+        if self.lora_rank > 0:
+            a = self.variable(
+                "lora",
+                "lora_a",
+                nn.initializers.normal(stddev=0.02),
+                self.make_rng("params") if self.is_initializing() else None,
+                (in_features, self.lora_rank),
+                self.param_dtype,
+            ).value
+            b = self.variable(
+                "lora",
+                "lora_b",
+                lambda _rng, shape, dt: jnp.zeros(shape, dt),
+                None,
+                (self.lora_rank, self.features),
+                self.param_dtype,
+            ).value
+            h = x
+            if self.lora_dropout > 0.0 and not deterministic:
+                h = nn.Dropout(rate=self.lora_dropout, deterministic=False)(h)
+            scale = self.lora_alpha / self.lora_rank
+            y = y + (h @ a.astype(self.dtype)) @ b.astype(self.dtype) * scale
+        return y

@@ -1,0 +1,86 @@
+"""Attention implementations with a single dispatch point.
+
+``impl``:
+  * ``"xla"``    — einsum + masked softmax; XLA fuses this well on TPU and it
+                   runs everywhere (CPU tests).  Default.
+  * ``"pallas"`` — hand-written TPU flash attention (``ops.pallas``); used when
+                   it beats the XLA default at the benchmark shapes.
+  * ``"ring"``   — ring attention over the ``sp`` mesh axis for long context
+                   (``parallel.ring``); requires shard_map.
+
+All paths compute softmax in float32 regardless of input dtype (bf16 inputs,
+f32 accumulation — the MXU-friendly recipe).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """q: (B, S, H, D); k: (B, S, Hkv, D) → scores (B, Hkv, G, S, S)."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    q = q.reshape(b, s, hkv, g, d)
+    return jnp.einsum("bskgd,btkd->bkgst", q, k)
+
+
+def xla_causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Causal (optionally segment-masked) GQA attention.
+
+    Shapes: q (B, S, H, D); k, v (B, S, Hkv, D) with H % Hkv == 0.
+    Returns (B, S, H, D) in q.dtype.
+    """
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+    scores = _gqa_scores(q * scale, k).astype(jnp.float32)
+
+    pos = jnp.arange(s)
+    mask = pos[:, None] >= pos[None, :]  # (S, S) causal
+    mask = mask[None, None, None]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, None, :, None] == segment_ids[:, None, None, None, :]
+        mask = mask & seg
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "xla",
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    if impl == "xla":
+        return xla_causal_attention(q, k, v, segment_ids=segment_ids)
+    if impl == "pallas":
+        try:
+            from .pallas.flash_attention import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attention impl='pallas' requires ops.pallas.flash_attention "
+                "(not built in this installation); use impl='xla'"
+            ) from e
+        return flash_attention(q, k, v, segment_ids=segment_ids)
+    if impl == "ring":
+        raise NotImplementedError(
+            "attention impl='ring' (sequence-parallel ring attention) is "
+            "selected via the trainer's sp mesh axis, not per-call; use "
+            "impl='xla' here"
+        )
+    raise ValueError(f"unknown attention impl: {impl!r}")

@@ -1,0 +1,12 @@
+from .mesh import MeshSpec, AxisNames, build_mesh
+from .sharding import PartitionRules, LLAMA_RULES, sharding_for_tree, batch_sharding
+
+__all__ = [
+    "MeshSpec",
+    "AxisNames",
+    "build_mesh",
+    "PartitionRules",
+    "LLAMA_RULES",
+    "sharding_for_tree",
+    "batch_sharding",
+]

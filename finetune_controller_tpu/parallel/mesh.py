@@ -1,0 +1,99 @@
+"""Device-mesh construction for TPU slices.
+
+The reference framework had no notion of a device mesh at all — its
+``cluster_nodes``/``accelerator_count`` pair (reference
+``app/models/base/finetuning.py:86-93``) was forwarded to Kubernetes as replica
+counts and everything else happened inside the user's container.  Here the mesh
+is the core abstraction: every parallelism strategy (DP, FSDP, TP, SP/CP, EP,
+PP) is an axis of one logical mesh, and XLA inserts the collectives.
+
+Axis layout convention (fastest-varying axis innermost so that TP rides ICI
+neighbours within a host, FSDP next, DP outermost across slices/DCN):
+
+    mesh shape = (dp, fsdp, ep, pp, sp, tp)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class AxisNames:
+    """Canonical mesh-axis names used across the framework."""
+
+    DATA = "dp"      # pure data parallelism (gradient all-reduce)
+    FSDP = "fsdp"    # data parallelism with fully-sharded params (ZeRO-3)
+    EXPERT = "ep"    # expert parallelism for MoE layers
+    PIPE = "pp"      # pipeline stages
+    SEQ = "sp"       # sequence/context parallelism (ring attention)
+    TENSOR = "tp"    # tensor (megatron-style) parallelism
+
+    ORDER = (DATA, FSDP, EXPERT, PIPE, SEQ, TENSOR)
+    # Axes over which the batch dimension is split:
+    BATCH_AXES = (DATA, FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh request; ``-1`` on at most one axis means "infer"."""
+
+    dp: int = 1
+    fsdp: int = -1
+    ep: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {
+            AxisNames.DATA: self.dp,
+            AxisNames.FSDP: self.fsdp,
+            AxisNames.EXPERT: self.ep,
+            AxisNames.PIPE: self.pp,
+            AxisNames.SEQ: self.sp,
+            AxisNames.TENSOR: self.tp,
+        }
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"cannot infer {unknown[0]}: {n_devices} devices not divisible "
+                    f"by product of fixed axes {known}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {known} devices but {n_devices} are available"
+            )
+        return sizes
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        return build_mesh(self, devices)
+
+
+def build_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    fixed = [spec.dp, spec.fsdp, spec.ep, spec.pp, spec.sp, spec.tp]
+    if -1 not in fixed and math.prod(fixed) < len(devices):
+        # A fully-specified mesh smaller than the host's device count is
+        # honoured on a prefix of the devices (e.g. a 1-chip job on a
+        # multi-device test host).
+        devices = devices[: math.prod(fixed)]
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AxisNames.ORDER)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AxisNames.ORDER)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    devices = [device] if device is not None else jax.devices()[:1]
+    return build_mesh(MeshSpec(fsdp=1), devices)

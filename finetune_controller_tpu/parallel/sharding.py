@@ -1,0 +1,108 @@
+"""Path-pattern → PartitionSpec rules for parameter and activation sharding.
+
+Megatron+FSDP layout for the Llama family:
+
+* column-parallel kernels (qkv, gate/up proj): ``P(fsdp, tp)`` — output
+  features split over TP, input features sharded over FSDP so the weight
+  all-gather rides ICI right before the matmul.
+* row-parallel kernels (o proj, down proj): ``P(tp, fsdp)``.
+* embeddings / lm head: vocab over TP, model dim over FSDP.
+* norms / biases / scalars: replicated.
+
+Rules are ordered regexes over the ``/``-joined param path; first match wins.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AxisNames as Ax
+
+
+class PartitionRules:
+    def __init__(self, rules: list[tuple[str, P]]):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, value: Any = None) -> P:
+        for pat, spec in self._rules:
+            if pat.search(path):
+                ndim = getattr(value, "ndim", None)
+                if value is None or ndim is None or len(spec) == ndim:
+                    return spec
+                if len(spec) == ndim - 1 and "blocks" in path:
+                    # Layer-stacked (nn.scan) params carry a leading layer axis.
+                    return P(None, *spec)
+                if len(spec) > ndim:
+                    # Rank-mismatch safety: replicate rather than mis-shard.
+                    return P()
+                return spec
+        return P()
+
+    def tree_specs(self, tree: Any) -> Any:
+        """Map a pytree of arrays (or ShapeDtypeStructs) to PartitionSpecs."""
+
+        def to_path(kp) -> str:
+            parts = []
+            for k in kp:
+                if hasattr(k, "key"):
+                    parts.append(str(k.key))
+                elif hasattr(k, "idx"):
+                    parts.append(str(k.idx))
+                else:
+                    parts.append(str(k))
+            return "/".join(parts)
+
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, v: self.spec_for(to_path(kp), v), tree
+        )
+
+
+# Llama-family parameter rules.  Kernel shapes as produced by
+# finetune_controller_tpu.models.llama (Dense kernels are (in, out)).
+LLAMA_RULES = PartitionRules(
+    [
+        # token embedding: (vocab, d_model)
+        (r"embed_tokens/embedding", P(Ax.TENSOR, Ax.FSDP)),
+        # lm head kernel: (d_model, vocab)
+        (r"lm_head/kernel", P(Ax.FSDP, Ax.TENSOR)),
+        # attention projections
+        (r"(q_proj|k_proj|v_proj)/kernel", P(Ax.FSDP, Ax.TENSOR)),
+        (r"o_proj/kernel", P(Ax.TENSOR, Ax.FSDP)),
+        # MLP
+        (r"(gate_proj|up_proj)/kernel", P(Ax.FSDP, Ax.TENSOR)),
+        (r"down_proj/kernel", P(Ax.TENSOR, Ax.FSDP)),
+        # MoE experts: (n_experts, in, out) with experts over EP
+        (r"experts/(gate_proj|up_proj)/kernel", P(Ax.EXPERT, Ax.FSDP, Ax.TENSOR)),
+        (r"experts/down_proj/kernel", P(Ax.EXPERT, Ax.TENSOR, Ax.FSDP)),
+        (r"router/kernel", P(Ax.FSDP, None)),
+        # LoRA adapters: A (in, r) sharded like the frozen kernel's input dim;
+        # B (r, out) over the output dim.  Rank r is tiny — keep it replicated.
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_a", P(Ax.FSDP, None)),
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_b", P(None, Ax.TENSOR)),
+        (r"o_proj/lora_a|down_proj/lora_a", P(Ax.TENSOR, None)),
+        (r"o_proj/lora_b|down_proj/lora_b", P(None, Ax.FSDP)),
+        # norms, scales, biases — replicated
+        (r".*", P()),
+    ]
+)
+
+
+def sharding_for_tree(tree: Any, mesh: Mesh, rules: PartitionRules) -> Any:
+    specs = rules.tree_specs(tree)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_sharding(mesh: Mesh, seq_sharded: bool = True) -> NamedSharding:
+    """Sharding for (batch, seq[, ...]) token arrays: batch over dp+fsdp, seq
+    over sp (ring/context parallelism) when requested."""
+    seq_axis = Ax.SEQ if seq_sharded else None
+    return NamedSharding(mesh, P(Ax.BATCH_AXES, seq_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,4 @@
+from .trainer import Trainer, TrainConfig, TrainState
+from .metrics import MetricsWriter
+
+__all__ = ["Trainer", "TrainConfig", "TrainState", "MetricsWriter"]

@@ -1,0 +1,80 @@
+"""Orbax-backed checkpointing with preemption-safe semantics.
+
+Closes a real gap in the reference: its jobs had no resume path at all —
+checkpoints lived on a pod-local emptyDir synced to S3, and a restarted pod
+started from scratch (SURVEY.md §5.4).  Here: every save is atomic (Orbax
+renames on commit), the latest step is discoverable, and restore re-shards
+onto the current mesh via device_put with the trainer's shardings.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    def all_steps(self) -> list[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(os.path.join(self.directory, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, tree: Any, force: bool = False) -> None:
+        path = self._path(step)
+        if os.path.exists(path):
+            if not force:
+                return
+            import shutil
+
+            shutil.rmtree(path)
+        self._ckptr.save(path, tree)
+        self._ckptr.wait_until_finished()
+        self._gc()
+
+    def restore(self, step: int, like: Any | None = None) -> Any:
+        restored = self._ckptr.restore(self._path(step), target=like)
+        return restored
+
+    def restore_latest(self, like: Any | None = None) -> tuple[int, Any] | None:
+        step = self.latest_step()
+        if step is None:
+            return None
+        return step, self.restore(step, like)
+
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        for step in steps[: -self.keep]:
+            import shutil
+
+            shutil.rmtree(self._path(step), ignore_errors=True)
+            logger.info("gc'd checkpoint step_%d", step)
+
+
+def reshard(tree: Any, shardings: Any) -> Any:
+    """Place a host-restored tree onto devices with the given shardings."""
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)

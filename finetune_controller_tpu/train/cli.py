@@ -1,0 +1,158 @@
+"""Training entrypoint: ``python -m finetune_controller_tpu.train.cli --spec job.json``.
+
+This is the process the control plane launches (locally as a subprocess, or
+on-cluster as the container command of every TPU worker pod).  The JSON spec
+is the contract between the planes — the deployer renders it, this module
+consumes it.  On completion it touches ``done.txt`` in the artifacts dir, the
+same completion signal the reference used to stop its S3-sync sidecar
+(reference ``app/jobs/kubeflow/PyTorchJobDeployer.py:30-32``).
+
+Spec schema (all sections optional except artifacts_dir):
+
+    {
+      "job_id": "...",
+      "model":    {"preset": "tiny-test", "overrides": {...}, "lora": {"rank": 8}},
+      "training": {... TrainConfig fields ...},
+      "mesh":     {"dp": 1, "fsdp": -1, "tp": 1, "sp": 1, "ep": 1, "pp": 1},
+      "dataset":  {"path": "...", "tokenizer_file": null}
+                  | {"synthetic": {"task": "increment"}},
+      "artifacts_dir": "/data/artifacts"
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def build_model_config(spec: dict):
+    from ..models.llama import PRESETS
+    from ..models.lora import LoRAConfig
+
+    model_spec = spec.get("model", {})
+    preset = model_spec.get("preset", "tiny-test")
+    if preset not in PRESETS:
+        raise ValueError(f"unknown model preset {preset!r}; have {sorted(PRESETS)}")
+    cfg = PRESETS[preset]
+    overrides = dict(model_spec.get("overrides", {}))
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    lora_spec = model_spec.get("lora")
+    if lora_spec is not None:
+        cfg = cfg.replace(lora=LoRAConfig(**lora_spec))
+    return cfg
+
+
+def build_train_config(spec: dict):
+    from .trainer import TrainConfig
+
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    raw = dict(spec.get("training", {}))
+    unknown = set(raw) - fields
+    if unknown:
+        raise ValueError(f"unknown training fields: {sorted(unknown)}")
+    return TrainConfig(**raw)
+
+
+def build_mesh(spec: dict):
+    from ..parallel.mesh import MeshSpec
+
+    return MeshSpec(**spec.get("mesh", {})).build()
+
+
+def build_batches(
+    spec: dict, model_cfg, train_cfg, local_batch_size: int,
+    shard_index: int, shard_count: int,
+):
+    from ..data.loader import jsonl_token_batches
+    from ..data.synthetic import synthetic_batches
+
+    ds = spec.get("dataset", {})
+    if "path" in ds and ds["path"]:
+        return jsonl_token_batches(
+            ds["path"],
+            batch_size=local_batch_size,
+            seq_len=train_cfg.seq_len,
+            tokenizer_file=ds.get("tokenizer_file"),
+            seed=train_cfg.seed,
+            shard_index=shard_index,
+            shard_count=shard_count,
+        )
+    synth = ds.get("synthetic", {})
+    return synthetic_batches(
+        batch_size=local_batch_size,
+        seq_len=train_cfg.seq_len,
+        vocab_size=model_cfg.vocab_size,
+        task=synth.get("task", "increment"),
+        seed=train_cfg.seed + shard_index,
+    )
+
+
+def run_job(spec: dict) -> None:
+    from ..parallel.distributed import maybe_initialize_distributed, is_rank_zero
+    from .trainer import Trainer
+
+    artifacts_dir = spec["artifacts_dir"]
+    os.makedirs(artifacts_dir, exist_ok=True)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # Make the env var authoritative even if a site plugin updated the
+        # config at interpreter startup (an explicit config.update outranks
+        # the env var in JAX's resolution order).
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    maybe_initialize_distributed()
+
+    model_cfg = build_model_config(spec)
+    train_cfg = build_train_config(spec)
+    mesh = build_mesh(spec)
+    logger.info(
+        "job %s: %s params=%.1fM mesh=%s devices=%d",
+        spec.get("job_id", "?"), spec.get("model", {}).get("preset"),
+        model_cfg.param_count() / 1e6, dict(zip(mesh.axis_names, mesh.devices.shape)),
+        jax.device_count(),
+    )
+    if is_rank_zero():
+        with open(os.path.join(artifacts_dir, "resolved_config.json"), "w") as f:
+            json.dump(spec, f, indent=2, default=str)
+
+    trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
+    batches = build_batches(
+        spec, model_cfg, train_cfg,
+        local_batch_size=trainer.local_batch_size,
+        shard_index=jax.process_index(), shard_count=jax.process_count(),
+    )
+    trainer.fit(batches, artifacts_dir)
+
+    if is_rank_zero():
+        with open(os.path.join(artifacts_dir, "done.txt"), "w") as f:
+            f.write("done\n")
+    logger.info("job %s finished", spec.get("job_id", "?"))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="ftc-train")
+    parser.add_argument("--spec", required=True, help="path to the job-spec JSON")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stdout,
+        force=True,
+    )
+    with open(args.spec) as f:
+        spec = json.load(f)
+    run_job(spec)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,55 @@
+"""Training-metrics CSV writer.
+
+Contract with the control plane (mirrors the reference's convention — model
+writes ``*metrics*.csv`` under the artifacts dir, monitor syncs the newest
+match into the DB; reference ``app/utils/S3Handler.py:252-258``,
+``app/core/monitor.py:34-95``): one header row, one row per logging step,
+flushed on every write so the monitor sees fresh data mid-run.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import IO, Any, Mapping
+
+
+class MetricsWriter:
+    def __init__(self, artifacts_dir: str, filename: str = "metrics.csv", append: bool = False):
+        os.makedirs(artifacts_dir, exist_ok=True)
+        self.path = os.path.join(artifacts_dir, filename)
+        self._file: IO[str] | None = None
+        self._writer: csv.DictWriter | None = None
+        self._resume_fields: list[str] | None = None
+        if append and os.path.exists(self.path):
+            with open(self.path) as f:
+                header = f.readline().strip()
+            if header:
+                self._resume_fields = header.split(",")
+
+    def write(self, row: Mapping[str, Any]) -> None:
+        row = {"timestamp": round(time.time(), 3), **row}
+        if self._writer is None:
+            if self._resume_fields is not None:
+                # Preemption-resume: keep prior rows, reuse the existing header.
+                self._file = open(self.path, "a", newline="")
+                self._writer = csv.DictWriter(self._file, fieldnames=self._resume_fields)
+            else:
+                self._file = open(self.path, "w", newline="")
+                self._writer = csv.DictWriter(self._file, fieldnames=list(row.keys()))
+                self._writer.writeheader()
+        self._writer.writerow({k: row.get(k, "") for k in self._writer.fieldnames})
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+            self._writer = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

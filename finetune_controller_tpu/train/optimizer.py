@@ -1,0 +1,47 @@
+"""Optimizer and LR-schedule construction (optax)."""
+
+from __future__ import annotations
+
+import optax
+
+
+def build_schedule(
+    learning_rate: float,
+    warmup_steps: int,
+    total_steps: int,
+    schedule: str = "cosine",
+    min_lr_ratio: float = 0.1,
+) -> optax.Schedule:
+    warmup = optax.linear_schedule(0.0, learning_rate, max(warmup_steps, 1))
+    decay_steps = max(total_steps - warmup_steps, 1)
+    if schedule == "cosine":
+        decay = optax.cosine_decay_schedule(
+            learning_rate, decay_steps, alpha=min_lr_ratio
+        )
+    elif schedule == "linear":
+        decay = optax.linear_schedule(
+            learning_rate, learning_rate * min_lr_ratio, decay_steps
+        )
+    elif schedule == "constant":
+        decay = optax.constant_schedule(learning_rate)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    return optax.join_schedules([warmup, decay], [warmup_steps])
+
+
+def build_optimizer(
+    learning_rate: float = 2e-4,
+    warmup_steps: int = 10,
+    total_steps: int = 1000,
+    schedule: str = "cosine",
+    weight_decay: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    clip_norm: float = 1.0,
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    sched = build_schedule(learning_rate, warmup_steps, total_steps, schedule)
+    tx = optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+    return tx, sched

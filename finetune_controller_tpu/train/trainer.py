@@ -1,0 +1,298 @@
+"""The trainer: sharded init, jitted train step, fit loop with metrics,
+checkpointing and preemption handling.
+
+Everything device-side happens inside two jitted functions (``_init_fn`` and
+``_step_fn``) whose in/out shardings come from ``parallel.sharding`` rules, so
+the same code runs single-chip, on a CPU test mesh, or across a v5e slice —
+only the MeshSpec changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import signal
+import time
+from functools import partial
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from flax.core import FrozenDict
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, LlamaForCausalLM
+from ..parallel.mesh import MeshSpec
+from ..parallel.sharding import LLAMA_RULES, PartitionRules, batch_sharding
+from .checkpoint import CheckpointManager, reshard
+from .losses import next_token_loss
+from .metrics import MetricsWriter
+from .optimizer import build_optimizer
+
+logger = logging.getLogger(__name__)
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    frozen: Any        # non-trainable variables ({} in full fine-tune mode)
+    trainable: Any     # differentiated + optimized tree
+    opt_state: Any
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    mode: str = "lora"            # "lora" | "full"
+    learning_rate: float = 2e-4
+    warmup_steps: int = 10
+    total_steps: int = 100
+    schedule: str = "cosine"
+    weight_decay: float = 0.0
+    clip_norm: float = 1.0
+    batch_size: int = 8           # global
+    seq_len: int = 512
+    seed: int = 0
+    log_every: int = 10
+    checkpoint_every: int = 100
+    keep_checkpoints: int = 3
+
+
+class PreemptionGuard:
+    """SIGTERM → save-and-exit flag (TPU spot/maintenance preemption)."""
+
+    def __init__(self):
+        self.requested = False
+
+    def install(self) -> None:
+        def handler(signum, frame):
+            logger.warning("preemption signal %s received; will checkpoint and exit", signum)
+            self.requested = True
+
+        signal.signal(signal.SIGTERM, handler)
+
+
+class Trainer:
+    def __init__(
+        self,
+        model_cfg: LlamaConfig,
+        train_cfg: TrainConfig,
+        mesh: Mesh | None = None,
+        rules: PartitionRules = LLAMA_RULES,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = train_cfg
+        self.mesh = mesh if mesh is not None else MeshSpec(fsdp=1).build(jax.devices()[:1])
+        self.rules = rules
+        self.model = LlamaForCausalLM(model_cfg)
+        self.tx, self.sched = build_optimizer(
+            learning_rate=train_cfg.learning_rate,
+            warmup_steps=train_cfg.warmup_steps,
+            total_steps=train_cfg.total_steps,
+            schedule=train_cfg.schedule,
+            weight_decay=train_cfg.weight_decay,
+            clip_norm=train_cfg.clip_norm,
+        )
+        self._state_shardings = None
+        self._init_jit = None
+        self._step_jit = None
+        self._build()
+
+    # ---- construction ----------------------------------------------------
+
+    def _split(self, variables: FrozenDict) -> tuple[Any, Any]:
+        """(frozen, trainable) per the training mode."""
+        variables = dict(variables)
+        if self.cfg.mode == "lora":
+            if "lora" not in variables:
+                raise ValueError("mode='lora' but the model has no LoRA params; set lora.rank > 0")
+            trainable = variables.pop("lora")
+            return variables, trainable
+        if self.cfg.mode == "full":
+            trainable = variables.pop("params")
+            return variables, trainable
+        raise ValueError(f"unknown training mode {self.cfg.mode!r}")
+
+    def _assemble(self, frozen: Any, trainable: Any) -> dict:
+        out = dict(frozen)
+        out["lora" if self.cfg.mode == "lora" else "params"] = trainable
+        return out
+
+    def _raw_init(self, rng: jax.Array) -> TrainState:
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        variables = self.model.init({"params": rng}, tokens)
+        frozen, trainable = self._split(variables)
+        opt_state = self.tx.init(trainable)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            frozen=frozen,
+            trainable=trainable,
+            opt_state=opt_state,
+        )
+
+    def _build(self) -> None:
+        rng = jax.random.PRNGKey(self.cfg.seed)
+        shapes = jax.eval_shape(self._raw_init, rng)
+        specs = self.rules.tree_specs(shapes)
+        self._state_specs = specs
+        self._state_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self._batch_sharding = batch_sharding(self.mesh)
+        self._init_jit = jax.jit(self._raw_init, out_shardings=self._state_shardings)
+        self._step_jit = jax.jit(
+            self._train_step,
+            in_shardings=(self._state_shardings, self._batch_sharding),
+            out_shardings=(self._state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    # ---- device-side fns -------------------------------------------------
+
+    @property
+    def _use_dropout(self) -> bool:
+        lora = self.model_cfg.lora
+        return lora.rank > 0 and lora.dropout > 0.0
+
+    def _loss_fn(self, trainable, frozen, batch, dropout_rng):
+        variables = self._assemble(frozen, trainable)
+        rngs = {"dropout": dropout_rng} if self._use_dropout else None
+        logits = self.model.apply(
+            variables,
+            batch["tokens"],
+            segment_ids=batch.get("segment_ids"),
+            deterministic=not self._use_dropout,
+            rngs=rngs,
+        )
+        return next_token_loss(logits, batch["tokens"], batch.get("loss_mask"))
+
+    def _train_step(self, state: TrainState, batch: dict):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), state.step)
+        grad_fn = jax.value_and_grad(self._loss_fn, has_aux=True)
+        (_, aux), grads = grad_fn(state.trainable, state.frozen, batch, dropout_rng)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.trainable)
+        trainable = optax.apply_updates(state.trainable, updates)
+        metrics = {
+            **aux,
+            "grad_norm": optax.global_norm(grads),
+            "learning_rate": self.sched(state.step),
+        }
+        new_state = state.replace(
+            step=state.step + 1, trainable=trainable, opt_state=opt_state
+        )
+        return new_state, metrics
+
+    # ---- host-side API ---------------------------------------------------
+
+    def init_state(self) -> TrainState:
+        with self.mesh:
+            return self._init_jit(jax.random.PRNGKey(self.cfg.seed))
+
+    def step(self, state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        batch = self._shard_batch(batch)
+        with self.mesh:
+            return self._step_jit(state, batch)
+
+    @property
+    def local_batch_size(self) -> int:
+        """Rows each process's data pipeline must supply per step.
+
+        ``cfg.batch_size`` is the GLOBAL batch; on a multi-host slice each
+        host loads only its share and the global array is assembled from
+        per-process shards (no cross-host row duplication or waste).
+        """
+        n = jax.process_count()
+        if self.cfg.batch_size % n:
+            raise ValueError(
+                f"global batch_size {self.cfg.batch_size} not divisible by "
+                f"process count {n}"
+            )
+        return self.cfg.batch_size // n
+
+    def _shard_batch(self, batch: dict) -> dict:
+        def put(x):
+            x = np.asarray(x)
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(self._batch_sharding, x)
+            return jax.device_put(x, self._batch_sharding)
+
+        return jax.tree.map(put, batch)
+
+    def state_to_host(self, state: TrainState) -> dict:
+        """Gather the persistable slice of state (trainable + opt) to host."""
+        tree = {"step": state.step, "trainable": state.trainable, "opt_state": state.opt_state}
+        return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+    def fit(
+        self,
+        batches: Iterable[dict],
+        artifacts_dir: str,
+        resume: bool = True,
+        on_metrics: Callable[[int, dict], None] | None = None,
+    ) -> TrainState:
+        guard = PreemptionGuard()
+        try:
+            guard.install()
+        except ValueError:
+            pass  # not on the main thread (e.g. tests)
+
+        ckpt = CheckpointManager(
+            f"{artifacts_dir}/checkpoints", keep=self.cfg.keep_checkpoints
+        )
+        state = self.init_state()
+        start_step = 0
+        if resume:
+            latest = ckpt.latest_step()
+            if latest is not None:
+                host = ckpt.restore(latest, like=self.state_to_host(state))
+                state = state.replace(
+                    step=jnp.asarray(host["step"], jnp.int32),
+                    trainable=reshard(host["trainable"], self._state_shardings.trainable),
+                    opt_state=reshard(host["opt_state"], self._state_shardings.opt_state),
+                )
+                start_step = int(host["step"])
+                logger.info("resumed from checkpoint step %d", start_step)
+
+        writer = MetricsWriter(artifacts_dir, append=start_step > 0)
+        it: Iterator[dict] = iter(batches)
+        # Fast-forward past already-consumed batches so a resumed run sees the
+        # same data stream an uninterrupted run would have.
+        for _ in range(start_step):
+            next(it)
+        tokens_per_batch = self.cfg.batch_size * self.cfg.seq_len
+        window_t0 = time.perf_counter()
+        window_tokens = 0
+        try:
+            for step_idx in range(start_step, self.cfg.total_steps):
+                batch = next(it)
+                state, metrics = self.step(state, batch)
+                window_tokens += tokens_per_batch
+
+                last = step_idx + 1 == self.cfg.total_steps
+                if (step_idx + 1) % self.cfg.log_every == 0 or last:
+                    metrics = {k: float(v) for k, v in metrics.items()}
+                    dt = time.perf_counter() - window_t0
+                    metrics["tokens_per_sec"] = window_tokens / max(dt, 1e-9)
+                    row = {"step": step_idx + 1, **metrics}
+                    writer.write(row)
+                    if on_metrics:
+                        on_metrics(step_idx + 1, metrics)
+                    logger.info(
+                        "step %d loss %.4f acc %.3f tok/s %.0f",
+                        step_idx + 1, metrics["loss"], metrics["accuracy"],
+                        metrics["tokens_per_sec"],
+                    )
+                    window_t0 = time.perf_counter()
+                    window_tokens = 0
+
+                if (step_idx + 1) % self.cfg.checkpoint_every == 0 or last or guard.requested:
+                    ckpt.save(step_idx + 1, self.state_to_host(state))
+                if guard.requested:
+                    logger.warning("exiting on preemption after step %d", step_idx + 1)
+                    raise SystemExit(143)
+        finally:
+            writer.close()
+        return state

@@ -1,0 +1,29 @@
+"""Test harness: an 8-virtual-device CPU mesh so every parallelism strategy
+(DP/FSDP/TP/SP) is exercised without TPU hardware — the CPU-simulation test
+seam the reference lacked entirely (SURVEY.md §4).
+
+Note: the JAX_PLATFORMS *env var* is not enough in environments where a TPU
+plugin calls ``jax.config.update("jax_platforms", ...)`` at interpreter
+startup (an explicit config update outranks the env var), so we re-update the
+config here, before any backend is initialised.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count=8".strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs[:8]

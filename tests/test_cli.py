@@ -1,0 +1,57 @@
+import json
+import os
+import subprocess
+import sys
+
+from finetune_controller_tpu.train import cli
+
+
+def _spec(tmp_path, **training):
+    return {
+        "job_id": "test-job",
+        "model": {"preset": "tiny-test", "lora": {"rank": 4}},
+        "training": {
+            "mode": "lora", "total_steps": 4, "batch_size": 4, "seq_len": 16,
+            "log_every": 2, "checkpoint_every": 100, **training,
+        },
+        "mesh": {"dp": 1, "fsdp": 1, "tp": 1},
+        "dataset": {"synthetic": {"task": "increment"}},
+        "artifacts_dir": str(tmp_path / "artifacts"),
+    }
+
+
+def test_run_job_in_process(tmp_path):
+    spec = _spec(tmp_path)
+    cli.run_job(spec)
+    art = tmp_path / "artifacts"
+    assert (art / "done.txt").exists()
+    assert (art / "metrics.csv").exists()
+    assert (art / "resolved_config.json").exists()
+    header = (art / "metrics.csv").read_text().splitlines()[0]
+    assert "loss" in header and "tokens_per_sec" in header
+
+
+def test_cli_subprocess(tmp_path):
+    """The exact launch path the local training backend uses."""
+    spec = _spec(tmp_path)
+    spec_path = tmp_path / "job.json"
+    spec_path.write_text(json.dumps(spec))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep tests off any TPU tunnel
+    proc = subprocess.run(
+        [sys.executable, "-m", "finetune_controller_tpu.train.cli", "--spec", str(spec_path)],
+        capture_output=True, text=True, timeout=300, env=env, cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "artifacts" / "done.txt").exists()
+
+
+def test_bad_spec_rejected(tmp_path):
+    spec = _spec(tmp_path)
+    spec["training"]["bogus_field"] = 1
+    try:
+        cli.run_job(spec)
+        raise AssertionError("should have raised")
+    except ValueError as e:
+        assert "bogus_field" in str(e)

@@ -1,0 +1,55 @@
+import json
+
+import numpy as np
+
+from finetune_controller_tpu.data.loader import (
+    batches_from_tokens,
+    jsonl_token_batches,
+    load_token_documents,
+    pack_documents,
+)
+
+
+def test_pack_documents_segments():
+    docs = [[1, 2, 3], [4, 5, 6, 7, 8]]
+    tokens, segs = pack_documents(docs, seq_len=4)
+    assert tokens.shape == (2, 4)
+    assert segs.tolist() == [[1, 1, 1, 2], [2, 2, 2, 2]]
+
+
+def test_pack_pads_tiny_dataset():
+    tokens, segs = pack_documents([[9, 9]], seq_len=8)
+    assert tokens.shape == (1, 8)
+    assert segs[0, :2].tolist() == [1, 1]
+    assert segs[0, 2:].sum() == 0
+
+
+def test_jsonl_loading_and_sharding(tmp_path):
+    path = tmp_path / "data.jsonl"
+    with open(path, "w") as f:
+        for i in range(50):
+            f.write(json.dumps({"tokens": list(range(i, i + 20))}) + "\n")
+    docs = load_token_documents(str(path))
+    assert len(docs) == 50
+
+    it0 = jsonl_token_batches(str(path), batch_size=2, seq_len=16, shard_index=0, shard_count=2)
+    it1 = jsonl_token_batches(str(path), batch_size=2, seq_len=16, shard_index=1, shard_count=2)
+    b0, b1 = next(it0), next(it1)
+    assert b0["tokens"].shape == (2, 16)
+    # different shards see different blocks
+    assert not np.array_equal(b0["tokens"], b1["tokens"])
+
+
+def test_text_rows_byte_fallback(tmp_path):
+    path = tmp_path / "text.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"text": "hello"}) + "\n")
+    docs = load_token_documents(str(path))
+    assert docs[0] == list(b"hello")
+
+
+def test_batches_have_loss_mask_and_segments():
+    tokens, segs = pack_documents([list(range(100))], seq_len=10)
+    b = next(batches_from_tokens(tokens, segs, batch_size=2))
+    assert set(b) >= {"tokens", "loss_mask", "segment_ids"}
+    assert b["loss_mask"].dtype == np.float32

@@ -1,0 +1,116 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from finetune_controller_tpu.models import PRESETS, LlamaForCausalLM, LoRAConfig
+
+
+def _tiny(lora_rank=0, **kw):
+    cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=lora_rank), **kw)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def test_forward_shapes():
+    cfg, model = _tiny()
+    vars_ = model.init_variables(jax.random.PRNGKey(0), batch=2, seq=16)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = model.apply(vars_, toks)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_lora_starts_as_identity():
+    """lora_b is zero-init, so the adapter branch contributes nothing at init:
+    perturbing lora_a must not change the output, perturbing lora_b must."""
+    cfg, model = _tiny(lora_rank=8)
+    vars_ = model.init_variables(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    base = model.apply(vars_, toks)
+
+    def perturb(tree, name, scale):
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, v: v + scale if name in jax.tree_util.keystr(kp) else v, tree
+        )
+
+    junk_a = {**vars_, "lora": perturb(vars_["lora"], "lora_a", 7.0)}
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(model.apply(junk_a, toks)), atol=1e-5
+    )
+    junk_b = {**vars_, "lora": perturb(vars_["lora"], "lora_b", 0.5)}
+    assert not np.allclose(np.asarray(base), np.asarray(model.apply(junk_b, toks)), atol=1e-3)
+
+
+def test_scan_and_loop_paths_agree():
+    """nn.scan layer stacking must be numerically identical to the loop."""
+    import jax.numpy as jnp
+
+    cfg_scan, model_scan = _tiny(scan_layers=True, remat=False, dtype=jnp.float32)
+    cfg_loop, model_loop = _tiny(scan_layers=False, remat=False, dtype=jnp.float32)
+    vs = model_scan.init_variables(jax.random.PRNGKey(0))
+    # map scanned params (leading layer axis) onto loop layout
+    import flax
+
+    ps = flax.core.unfreeze(vs)["params"]
+    loop_params = {k: v for k, v in ps.items() if k != "blocks"}
+    stacked = ps["blocks"]["block"]
+    for i in range(cfg_loop.n_layers):
+        loop_params[f"layer_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0, cfg_scan.vocab_size)
+    out_scan = model_scan.apply(vs, toks)
+    out_loop = model_loop.apply({"params": loop_params}, toks)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop), atol=1e-4)
+
+
+def test_segment_mask_blocks_cross_document_attention():
+    cfg, model = _tiny()
+    vars_ = model.init_variables(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, cfg.vocab_size)
+    seg_one = jnp.ones((1, 16), jnp.int32)
+    seg_split = jnp.concatenate(
+        [jnp.ones((1, 8), jnp.int32), 2 * jnp.ones((1, 8), jnp.int32)], axis=1
+    )
+    full = model.apply(vars_, toks, segment_ids=seg_one)
+    split = model.apply(vars_, toks, segment_ids=seg_split)
+    # first segment can't see the second either way → identical prefix
+    np.testing.assert_allclose(
+        np.asarray(full[:, :8]), np.asarray(split[:, :8]), atol=1e-5
+    )
+    # second segment differs (it lost its prefix context)
+    assert not np.allclose(np.asarray(full[:, 8:]), np.asarray(split[:, 8:]), atol=1e-3)
+
+
+def test_causal_attention_gqa_matches_mha_expansion():
+    from finetune_controller_tpu.ops.attention import xla_causal_attention
+
+    rng = jax.random.PRNGKey(0)
+    b, s, h, hkv, d = 2, 8, 4, 2, 16
+    q = jax.random.normal(rng, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), jnp.float32)
+    out = xla_causal_attention(q, k, v)
+    # expand kv to full heads and compare
+    k_full = jnp.repeat(k, h // hkv, axis=2)
+    v_full = jnp.repeat(v, h // hkv, axis=2)
+    # repeat maps kv head j -> heads [j*g, (j+1)*g); q reshape in impl maps
+    # q head i -> group (i // g) — same layout, so results must match.
+    out_full = xla_causal_attention(q, k_full, v_full)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_full), atol=1e-5)
+
+
+def test_lora_dropout_is_live_when_enabled():
+    """deterministic=False + dropout rng must actually perturb the lora branch."""
+    cfg, model = _tiny(lora_rank=8)
+    cfg = cfg.replace(lora=cfg.lora.__class__(rank=8, dropout=0.5))
+    from finetune_controller_tpu.models import LlamaForCausalLM
+
+    model = LlamaForCausalLM(cfg)
+    vars_ = model.init_variables(jax.random.PRNGKey(0))
+    # make lora_b nonzero so the (dropped-out) branch contributes
+    lora = jax.tree.map(lambda v: v + 0.1, vars_["lora"])
+    vars_ = {**vars_, "lora": lora}
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    det = model.apply(vars_, toks, deterministic=True)
+    d1 = model.apply(vars_, toks, deterministic=False, rngs={"dropout": jax.random.PRNGKey(2)})
+    d2 = model.apply(vars_, toks, deterministic=False, rngs={"dropout": jax.random.PRNGKey(3)})
+    assert not np.allclose(np.asarray(det), np.asarray(d1), atol=1e-4)
+    assert not np.allclose(np.asarray(d1), np.asarray(d2), atol=1e-4)

@@ -1,0 +1,65 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from finetune_controller_tpu.parallel import MeshSpec, LLAMA_RULES
+from finetune_controller_tpu.parallel.mesh import AxisNames
+
+
+def test_mesh_resolve_infer():
+    sizes = MeshSpec(dp=2, fsdp=-1, tp=2).resolve(8)
+    assert sizes[AxisNames.FSDP] == 2
+    assert np.prod(list(sizes.values())) == 8
+
+
+def test_mesh_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3, fsdp=-1).resolve(8)  # not divisible
+    with pytest.raises(ValueError):
+        MeshSpec(dp=2, fsdp=2, tp=4).resolve(8)  # product mismatch
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, fsdp=-1).resolve(8)  # two inferred
+
+
+def test_build_mesh(devices8):
+    mesh = MeshSpec(dp=2, fsdp=2, tp=2).build(devices8)
+    assert mesh.shape[AxisNames.DATA] == 2
+    assert mesh.shape[AxisNames.TENSOR] == 2
+    assert mesh.devices.size == 8
+
+
+def test_partition_rules_paths():
+    class Arr:
+        def __init__(self, ndim):
+            self.ndim = ndim
+
+    r = LLAMA_RULES
+    assert r.spec_for("params/layer_0/attn/q_proj/kernel", Arr(2)) == P("fsdp", "tp")
+    assert r.spec_for("params/layer_0/attn/o_proj/kernel", Arr(2)) == P("tp", "fsdp")
+    assert r.spec_for("params/layer_0/mlp/down_proj/kernel", Arr(2)) == P("tp", "fsdp")
+    assert r.spec_for("params/embed_tokens/embedding", Arr(2)) == P("tp", "fsdp")
+    assert r.spec_for("params/layer_0/attn_norm/scale", Arr(1)) == P()
+    # scanned stacks get a leading layer axis
+    assert r.spec_for("params/blocks/block/attn/q_proj/kernel", Arr(3)) == P(None, "fsdp", "tp")
+    assert r.spec_for("lora/blocks/block/attn/q_proj/lora_a", Arr(3)) == P(None, "fsdp", None)
+
+
+def test_tree_specs_on_real_model(devices8):
+    from finetune_controller_tpu.models import PRESETS, LlamaForCausalLM, LoRAConfig
+
+    cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=4))
+    model = LlamaForCausalLM(cfg)
+    shapes = jax.eval_shape(lambda r: model.init_variables(r), jax.random.PRNGKey(0))
+    specs = LLAMA_RULES.tree_specs(shapes)
+    flat = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    # every scanned kernel got a 3-long spec with leading None
+    kernel_specs = [
+        s for kp, s in flat if "kernel" in jax.tree_util.keystr(kp)
+    ]
+    assert kernel_specs, "no kernels found"
+    for s in kernel_specs:
+        if len(s) == 3:
+            assert s[0] is None

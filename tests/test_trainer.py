@@ -1,0 +1,85 @@
+import jax
+import numpy as np
+
+from finetune_controller_tpu.data import synthetic_batches
+from finetune_controller_tpu.models import PRESETS, LoRAConfig
+from finetune_controller_tpu.parallel import MeshSpec
+from finetune_controller_tpu.train import Trainer, TrainConfig
+
+
+def _tiny_cfg(rank=4):
+    return PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=rank))
+
+
+def test_lora_training_reduces_loss(devices8, tmp_path):
+    model_cfg = _tiny_cfg()
+    train_cfg = TrainConfig(
+        mode="lora", learning_rate=2e-2, warmup_steps=2, total_steps=40,
+        batch_size=8, seq_len=32, log_every=5, checkpoint_every=1000,
+    )
+    mesh = MeshSpec(dp=2, fsdp=2, tp=2).build(devices8)
+    trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
+    batches = synthetic_batches(8, 32, model_cfg.vocab_size, task="increment")
+    losses = []
+    trainer.fit(
+        batches, str(tmp_path), on_metrics=lambda s, m: losses.append(m["loss"])
+    )
+    assert losses[-1] < losses[0] * 0.7, f"loss did not drop: {losses}"
+    assert (tmp_path / "metrics.csv").exists()
+
+
+def test_full_finetune_mode(devices8, tmp_path):
+    model_cfg = PRESETS["tiny-test"]  # no LoRA
+    train_cfg = TrainConfig(
+        mode="full", learning_rate=1e-3, warmup_steps=2, total_steps=10,
+        batch_size=8, seq_len=16, log_every=5, checkpoint_every=1000,
+    )
+    mesh = MeshSpec(dp=1, fsdp=4, tp=2).build(devices8)
+    trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
+    batches = synthetic_batches(8, 16, model_cfg.vocab_size, task="increment")
+    losses = []
+    trainer.fit(batches, str(tmp_path), on_metrics=lambda s, m: losses.append(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_params_are_actually_sharded(devices8):
+    model_cfg = _tiny_cfg()
+    train_cfg = TrainConfig(total_steps=1, batch_size=8, seq_len=16)
+    mesh = MeshSpec(dp=1, fsdp=2, tp=4).build(devices8)
+    trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
+    state = trainer.init_state()
+    # a scanned attention kernel should be sharded over fsdp×tp
+    kern = state.frozen["params"]["blocks"]["block"]["attn"]["q_proj"]["kernel"]
+    assert len(kern.sharding.device_set) == 8
+    shard_shape = kern.sharding.shard_shape(kern.shape)
+    assert shard_shape[1] == kern.shape[1] // 2  # fsdp split on in-features
+    assert shard_shape[2] == kern.shape[2] // 4  # tp split on out-features
+
+
+def test_checkpoint_resume_continues(devices8, tmp_path):
+    model_cfg = _tiny_cfg()
+    mesh = MeshSpec(dp=2, fsdp=2, tp=2).build(devices8)
+    batches = lambda: synthetic_batches(4, 16, model_cfg.vocab_size, task="increment")
+
+    cfg1 = TrainConfig(
+        mode="lora", total_steps=6, batch_size=4, seq_len=16,
+        log_every=2, checkpoint_every=3,
+    )
+    t1 = Trainer(model_cfg, cfg1, mesh=mesh)
+    state1 = t1.fit(batches(), str(tmp_path))
+    assert int(state1.step) == 6
+
+    # same artifacts dir, more steps → resumes from step 6
+    cfg2 = TrainConfig(
+        mode="lora", total_steps=9, batch_size=4, seq_len=16,
+        log_every=2, checkpoint_every=3,
+    )
+    t2 = Trainer(model_cfg, cfg2, mesh=mesh)
+    state2 = t2.fit(batches(), str(tmp_path))
+    assert int(state2.step) == 9
+
+    # restored trainable matched what was saved (step-6 ckpt still on disk)
+    from finetune_controller_tpu.train.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(str(tmp_path / "checkpoints"))
+    assert set(ckpt.all_steps()) >= {6, 9}
