@@ -1,0 +1,113 @@
+"""Headline benchmark: LoRA SFT decode-training throughput, tokens/sec/chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference (`acceleratedscience/finetune-controller`) publishes **no**
+performance numbers (BASELINE.json: "published": {}) — it is a k8s control
+plane whose training throughput belongs to user containers.  The baseline is
+therefore self-established: ``vs_baseline`` is measured throughput divided by
+a roofline-derived target for the benchmark hardware (40% MFU on the model's
+6*N FLOPs/token), so >1.0 means we beat the target, and the number stays
+comparable across rounds.
+
+Env knobs: BENCH_PRESET, BENCH_STEPS, BENCH_BATCH, BENCH_SEQ, BENCH_TINY=1
+(CI-sized run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+# Peak bf16 TFLOP/s per chip, by jax device_kind substring (public specs).
+PEAK_TFLOPS = [
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+TARGET_MFU = 0.40
+CPU_FALLBACK_TARGET_TOKENS_PER_SEC = 2000.0  # tiny model on one CPU host
+
+
+def _peak_tflops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, tflops in PEAK_TFLOPS:
+        if key in kind:
+            return tflops
+    return None
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from finetune_controller_tpu.data.synthetic import synthetic_batches
+    from finetune_controller_tpu.models.llama import PRESETS
+    from finetune_controller_tpu.models.lora import LoRAConfig
+    from finetune_controller_tpu.parallel.mesh import MeshSpec
+    from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    tiny = bool(os.environ.get("BENCH_TINY")) or not on_tpu
+
+    if tiny:
+        preset = os.environ.get("BENCH_PRESET", "tiny-test")
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "128"))
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        lora = LoRAConfig(rank=8)
+    else:
+        preset = os.environ.get("BENCH_PRESET", "tinyllama-1.1b")
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "2048"))
+        steps = int(os.environ.get("BENCH_STEPS", "20"))
+        lora = LoRAConfig(rank=16)
+
+    model_cfg = PRESETS[preset].replace(lora=lora, max_seq_len=max(seq, 128))
+    n_chips = len(devices)
+    mesh = MeshSpec(fsdp=-1).build(devices)
+    train_cfg = TrainConfig(
+        mode="lora", batch_size=batch, seq_len=seq,
+        total_steps=steps + 3, log_every=10**9, checkpoint_every=10**9,
+    )
+    trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
+    state = trainer.init_state()
+    batches = synthetic_batches(batch, seq, model_cfg.vocab_size, seed=0)
+
+    # Warmup (compile + 2 steady steps), then timed window.
+    for _ in range(3):
+        state, metrics = trainer.step(state, next(batches))
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.step(state, next(batches))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = steps * batch * seq
+    tok_per_sec_chip = tokens / dt / n_chips
+
+    if on_tpu:
+        peak = _peak_tflops(devices[0].device_kind) or 197.0
+        flops_per_token = 6.0 * model_cfg.param_count()
+        target = TARGET_MFU * peak * 1e12 / flops_per_token
+    else:
+        target = CPU_FALLBACK_TARGET_TOKENS_PER_SEC
+    print(json.dumps({
+        "metric": f"lora_sft_tokens_per_sec_per_chip[{preset},bs{batch},seq{seq}]",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tok_per_sec_chip / target, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

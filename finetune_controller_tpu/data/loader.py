@@ -94,15 +94,20 @@ def batches_from_tokens(
     rng = np.random.default_rng(seed)
 
     def make_batch(idx: np.ndarray) -> dict:
-        batch = {
-            "tokens": tokens[idx],
-            "loss_mask": (segments[idx] > 0).astype(np.float32)
-            if segments is not None
-            else np.ones_like(tokens[idx], np.float32),
-        }
-        if segments is not None:
-            batch["segment_ids"] = segments[idx]
-        return batch
+        if segments is None:
+            return {
+                "tokens": tokens[idx],
+                "loss_mask": np.ones_like(tokens[idx], np.float32),
+            }
+        seg = segments[idx]
+        # Mask padding AND each document's first in-block token: predicting
+        # doc i+1's first token happens from inside doc i, which
+        # segment-masked attention cannot see — irreducible noise.  loss_mask
+        # is indexed by *target* position (losses.next_token_loss), so the
+        # zero goes on the boundary target itself.
+        mask = (seg > 0).astype(np.float32)
+        mask[:, 1:] *= (seg[:, 1:] == seg[:, :-1]).astype(np.float32)
+        return {"tokens": tokens[idx], "loss_mask": mask, "segment_ids": seg}
 
     warned = False
     while True:

@@ -26,7 +26,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.llama import LlamaConfig, LlamaForCausalLM
 from ..parallel.mesh import MeshSpec
-from ..parallel.sharding import LLAMA_RULES, PartitionRules, batch_sharding
+from ..parallel.sharding import (
+    LLAMA_RULES,
+    PartitionRules,
+    batch_sharding,
+    sharding_for_tree,
+)
 from .checkpoint import CheckpointManager, reshard
 from .losses import next_token_loss
 from .metrics import MetricsWriter
@@ -135,12 +140,7 @@ class Trainer:
     def _build(self) -> None:
         rng = jax.random.PRNGKey(self.cfg.seed)
         shapes = jax.eval_shape(self._raw_init, rng)
-        specs = self.rules.tree_specs(shapes)
-        self._state_specs = specs
-        self._state_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
+        self._state_shardings = sharding_for_tree(shapes, self.mesh, self.rules)
         self._batch_sharding = batch_sharding(self.mesh)
         self._init_jit = jax.jit(self._raw_init, out_shardings=self._state_shardings)
         self._step_jit = jax.jit(
@@ -222,8 +222,17 @@ class Trainer:
         return jax.tree.map(put, batch)
 
     def state_to_host(self, state: TrainState) -> dict:
-        """Gather the persistable slice of state (trainable + opt) to host."""
+        """Gather the persistable slice of state (trainable + opt) to host.
+
+        On a multi-host mesh, sharded arrays span non-addressable devices and
+        plain ``device_get`` raises; every process must participate in a
+        collective gather (all hosts call this, only rank 0 persists).
+        """
         tree = {"step": state.step, "trainable": state.trainable, "opt_state": state.opt_state}
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            tree = multihost_utils.process_allgather(tree, tiled=True)
         return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
     def fit(
@@ -289,7 +298,10 @@ class Trainer:
                     window_tokens = 0
 
                 if (step_idx + 1) % self.cfg.checkpoint_every == 0 or last or guard.requested:
-                    ckpt.save(step_idx + 1, self.state_to_host(state))
+                    # Collective gather on all hosts; rank 0 persists.
+                    host_state = self.state_to_host(state)
+                    if jax.process_index() == 0:
+                        ckpt.save(step_idx + 1, host_state)
                 if guard.requested:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
                     raise SystemExit(143)
