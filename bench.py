@@ -58,21 +58,24 @@ def main() -> None:
     on_tpu = devices[0].platform == "tpu"
     tiny = bool(os.environ.get("BENCH_TINY")) or not on_tpu
 
+    n_chips = len(devices)
+    # Default global batch must divide evenly over the fsdp=all-chips mesh,
+    # so scale it with the chip count (a v5e-16 slice gets batch 16, not 8).
+    default_batch = max(8, n_chips)
     if tiny:
         preset = os.environ.get("BENCH_PRESET", "tiny-test")
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
         seq = int(os.environ.get("BENCH_SEQ", "128"))
         steps = int(os.environ.get("BENCH_STEPS", "10"))
         lora = LoRAConfig(rank=8)
     else:
         preset = os.environ.get("BENCH_PRESET", "tinyllama-1.1b")
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
         seq = int(os.environ.get("BENCH_SEQ", "2048"))
         steps = int(os.environ.get("BENCH_STEPS", "20"))
         lora = LoRAConfig(rank=16)
 
     model_cfg = PRESETS[preset].replace(lora=lora, max_seq_len=max(seq, 128))
-    n_chips = len(devices)
     mesh = MeshSpec(fsdp=-1).build(devices)
     train_cfg = TrainConfig(
         mode="lora", batch_size=batch, seq_len=seq,

@@ -1,0 +1,17 @@
+"""Control plane for the TPU fine-tuning framework.
+
+This package provides the capability surface of the reference control plane
+(``acceleratedscience/finetune-controller`` — FastAPI app + Mongo + S3 + Kubeflow/
+Kueue, see SURVEY.md §1) re-designed for a TPU-native stack:
+
+- jobs are **our in-repo JAX trainer** (``finetune_controller_tpu.train``) on TPU
+  slice topologies, not arbitrary user CUDA containers;
+- state lives in an async in-repo document store (reference: MongoDB via motor,
+  ``app/database/db.py``);
+- artifacts/datasets move through a pluggable object store (reference: S3 via
+  aioboto3, ``app/utils/S3Handler.py``);
+- scheduling/quota is an in-repo gang scheduler speaking TPU slice flavors
+  (reference: external Kueue CRDs, ``crds/kueue/*``);
+- everything is lazy and injectable — no import-time cluster I/O (the
+  reference's biggest testability wart, ``app/core/config.py:59-90``).
+"""

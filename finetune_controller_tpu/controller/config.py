@@ -1,0 +1,120 @@
+"""Settings for the control plane.
+
+Capability parity with the reference's pydantic-settings singleton
+(``app/core/config.py:16-93``) with its two warts fixed:
+
+- **No import-time I/O.** The reference reads a Kubernetes Secret inside computed
+  fields at import (``app/core/config.py:59-90``), which makes the package
+  unimportable without cluster access. Here nothing happens until
+  :func:`get_settings` is called, and tests inject their own instance via
+  :func:`set_settings`.
+- **No hard dependency on pydantic-settings.** Plain env parsing over a pydantic
+  model keeps the dependency surface to what is baked into the image.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from pydantic import BaseModel, Field
+
+
+class Settings(BaseModel):
+    """Environment-driven configuration (reference: ``app/core/config.py:16-58``)."""
+
+    environment: str = "local"  # local | development | production
+    namespace: str = "default"
+
+    # --- API ---
+    cors_origins: list[str] = Field(default_factory=lambda: ["*"])
+    api_prefix: str = "/api/v1"
+
+    # --- Auth (reference: OpenBridge OAuth, app/core/config.py:33-42) ---
+    auth_enabled: bool = False
+    introspection_url: str = ""  # remote token introspection endpoint
+    introspection_client_id: str = ""
+    introspection_client_secret: str = ""
+    jwks_url: str = ""  # JWKS endpoint for RS256 validation
+    jwt_secret: str = "dev-secret-do-not-use-in-prod"  # HS256 dev mint/verify
+    jwt_audience: str = "finetune-controller-tpu"
+    dev_disable_introspection: bool = True
+
+    # --- State store (reference: Mongo URL/creds, app/core/config.py:44-49) ---
+    state_dir: str = "~/.finetune_controller_tpu/state"
+
+    # --- Object store (reference: S3 buckets, app/core/config.py:53-58) ---
+    object_store_root: str = "~/.finetune_controller_tpu/objects"
+    datasets_bucket: str = "datasets"
+    artifacts_bucket: str = "artifacts"
+    deploy_bucket: str = "deploy"
+    presign_secret: str = "dev-presign-secret"
+    presign_expiry_s: int = 3600
+
+    # --- Monitor / sync cadence (reference: app/core/config.py:50-52) ---
+    job_monitor_interval_s: float = 2.0
+    artifact_sync_interval_s: float = 60.0
+
+    # --- Log streaming (reference: LOG_STREAM_SEARCH_STRING, app/core/config.py:26) ---
+    log_stream_search_string: str = ""
+    log_stream_start_timeout_s: float = 300.0
+
+    # --- Scheduler / device catalog (reference: CONFIGURATION_FILE, app/core/config.py:43) ---
+    device_config_file: str = ""
+
+    # --- Backend selection ---
+    backend: str = "local"  # local | k8s
+    monitor_in_process: bool = True  # reference: DEV_LOCAL_JOB_MONITOR (config.py:51)
+
+    # --- Rate limits per minute (reference: app/main.py:377,525,714) ---
+    rate_limit_submit_per_min: int = 10
+    rate_limit_read_per_min: int = 50
+    rate_limit_promote_per_min: int = 2
+
+    @property
+    def state_path(self) -> Path:
+        return Path(self.state_dir).expanduser()
+
+    @property
+    def object_store_path(self) -> Path:
+        return Path(self.object_store_root).expanduser()
+
+
+_ENV_PREFIX = "FTC_"
+_settings: Settings | None = None
+
+
+def _from_env() -> Settings:
+    """Build Settings from ``FTC_*`` env vars (upper-snake of the field name)."""
+    raw: dict[str, object] = {}
+    for name, field in Settings.model_fields.items():
+        env_val = os.environ.get(_ENV_PREFIX + name.upper())
+        if env_val is None:
+            continue
+        ann = field.annotation
+        if ann is bool:
+            raw[name] = env_val.lower() in ("1", "true", "yes", "on")
+        elif ann in (int, float):
+            raw[name] = env_val
+        elif ann == list[str]:
+            raw[name] = (
+                json.loads(env_val) if env_val.startswith("[") else env_val.split(",")
+            )
+        else:
+            raw[name] = env_val
+    return Settings(**raw)
+
+
+def get_settings() -> Settings:
+    """Lazily build the process-wide settings (first call reads the env)."""
+    global _settings
+    if _settings is None:
+        _settings = _from_env()
+    return _settings
+
+
+def set_settings(settings: Settings | None) -> None:
+    """Inject (or reset with ``None``) settings — the test seam."""
+    global _settings
+    _settings = settings

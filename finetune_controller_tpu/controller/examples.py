@@ -1,0 +1,110 @@
+"""Built-in example job specs (reference: ``app/models/examples/mnist.py`` —
+SURVEY.md §2 component 4's example half).
+
+The reference ships one CPU-runnable example (MNIST with ``no_cuda``,
+``mnist.py:28-30``) as its designed smoke workload; ours is a TinyLlama LoRA
+SFT spec runnable on a CPU mesh (BASELINE config #1) plus the larger model
+family specs from BASELINE.md.
+
+Each module is also executable as a self-test by convention (reference:
+``mnist.py:102-107``, ``docs/setup_models.md:419-430``):
+``python -m finetune_controller_tpu.controller.examples``.
+"""
+
+from __future__ import annotations
+
+from pydantic import Field
+
+from .specs import (
+    BaseFineTuneJob,
+    TrainingArguments,
+    TrainingFramework,
+    TrainingTask,
+)
+
+
+class LoRASFTArguments(TrainingArguments):
+    """Hyperparameters surfaced on the submission form — the Field metadata IS
+    the UI (reference pattern: ``mnist.py:17-38``)."""
+
+    learning_rate: float = Field(
+        2e-4, gt=0, le=1.0, description="Peak AdamW learning rate"
+    )
+    total_steps: int = Field(100, ge=1, le=1_000_000, description="Optimizer steps")
+    warmup_steps: int = Field(10, ge=0, description="Linear warmup steps")
+    batch_size: int = Field(8, ge=1, le=4096, description="Global batch size (rows)")
+    seq_len: int = Field(512, ge=16, le=1_048_576, description="Sequence length")
+    lora_rank: int = Field(16, ge=1, le=256, description="LoRA adapter rank")
+    weight_decay: float = Field(0.0, ge=0, description="AdamW weight decay")
+    seed: int = Field(0, description="PRNG seed")
+
+
+class TinyLlamaLoRA(BaseFineTuneJob):
+    """BASELINE config #1 — the CPU-runnable smoke workload and CI workhorse."""
+
+    model_name = "tinyllama-1.1b-lora"
+    description = "TinyLlama-1.1B LoRA SFT (single host; CPU-runnable smoke config)"
+    task = TrainingTask.CAUSAL_LM
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "tinyllama-1.1b"
+    default_device = "cpu-test"
+    promotion_path = "models/tinyllama"
+
+    training_arguments: LoRASFTArguments
+
+
+class Llama3_8B_LoRA(BaseFineTuneJob):
+    """BASELINE config #2 — the v5e-16 FSDP north star."""
+
+    model_name = "llama3-8b-lora"
+    description = "Llama-3 8B LoRA SFT, FSDP over a v5e-16 slice"
+    task = TrainingTask.CAUSAL_LM
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "llama3-8b"
+    default_device = "v5e-16"
+    promotion_path = "models/llama3-8b"
+
+    training_arguments: LoRASFTArguments
+
+
+class Mistral7B_QLoRA(BaseFineTuneJob):
+    """BASELINE config #3 — int4-quantized base weights, LoRA deltas."""
+
+    model_name = "mistral-7b-qlora"
+    description = "Mistral-7B QLoRA (int4 base weights) on TPU"
+    task = TrainingTask.CAUSAL_LM
+    framework = TrainingFramework.JAX_QLORA
+    model_preset = "mistral-7b"
+    default_device = "v5e-8"
+    promotion_path = "models/mistral-7b"
+
+    training_arguments: LoRASFTArguments
+
+
+class TinyTestLoRA(BaseFineTuneJob):
+    """Milliseconds-scale spec used by the e2e lifecycle tests."""
+
+    model_name = "tiny-test-lora"
+    description = "2-layer test model; e2e lifecycle smoke spec"
+    model_preset = "tiny-test"
+    default_device = "cpu-test"
+    promotion_path = "models/tiny-test"
+
+    training_arguments: LoRASFTArguments
+
+
+BUILTIN_JOB_SPECS: list[type[BaseFineTuneJob]] = [
+    TinyLlamaLoRA,
+    Llama3_8B_LoRA,
+    Mistral7B_QLoRA,
+    TinyTestLoRA,
+]
+
+
+if __name__ == "__main__":
+    # executable smoke-validation, the model-author convention
+    for cls in BUILTIN_JOB_SPECS:
+        job = cls(training_arguments=LoRASFTArguments())
+        spec = job.build_trainer_spec("smoke-1", "/tmp/artifacts")
+        assert spec["model"]["preset"] == cls.model_preset
+        print(f"{cls.model_name}: ok ({spec['training']})")

@@ -1,0 +1,246 @@
+"""Pluggable object store for datasets and training artifacts.
+
+Capability parity with the reference's ``S3Handler`` (``app/utils/S3Handler.py``,
+443 LoC — SURVEY.md §2 component 9): dataset upload (bytes / file / async
+stream), the ``finetune_jobs/{user}/{job}/{dataset|artifacts}`` URI convention
+(``S3Handler.py:46-71``), presigned download URLs (``:168``), newest-metrics-CSV
+fetch via pandas (``:237-292``), artifact zip streaming (``:294-373``), recursive
+copy for promotion (``:375-439``) and prefix cleanup (``:216-235``).
+
+The default backend is a local-filesystem store (``obj://bucket/key`` URIs) so
+the whole control plane runs hermetically in CI; a GCS/S3 backend slots in
+behind the same :class:`ObjectStore` interface (cloud creds/IO being exactly the
+delegation seam the reference leaves to aioboto3 + aws-cli sidecars).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import io
+import shutil
+import time
+import zipfile
+from pathlib import Path
+from typing import Any, AsyncIterator
+
+import pandas as pd
+
+URI_SCHEME = "obj://"
+
+
+def build_uri(bucket: str, *parts: str) -> str:
+    key = "/".join(p.strip("/") for p in parts if p)
+    return f"{URI_SCHEME}{bucket}/{key}"
+
+
+def parse_uri(uri: str) -> tuple[str, str]:
+    if not uri.startswith(URI_SCHEME):
+        raise ValueError(f"not an object-store uri: {uri!r}")
+    bucket, _, key = uri[len(URI_SCHEME) :].partition("/")
+    return bucket, key
+
+
+def dataset_prefix(bucket: str, user_id: str, job_id: str) -> str:
+    """Reference convention ``S3Handler.py:46-62``."""
+    return build_uri(bucket, "finetune_jobs", user_id, job_id, "dataset")
+
+
+def artifacts_prefix(bucket: str, user_id: str, job_id: str) -> str:
+    """Reference convention ``S3Handler.py:63-71``."""
+    return build_uri(bucket, "finetune_jobs", user_id, job_id, "artifacts")
+
+
+class ObjectStore:
+    """Abstract async object store."""
+
+    async def put_bytes(self, uri: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    async def put_stream(self, uri: str, chunks: AsyncIterator[bytes]) -> int:
+        raise NotImplementedError
+
+    async def put_file(self, uri: str, path: Path | str) -> None:
+        raise NotImplementedError
+
+    async def get_bytes(self, uri: str) -> bytes:
+        raise NotImplementedError
+
+    async def exists(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
+        """Return [{"uri", "size", "mtime"}] under a prefix."""
+        raise NotImplementedError
+
+    async def delete_prefix(self, prefix_uri: str) -> int:
+        raise NotImplementedError
+
+    async def copy_prefix(self, src_uri: str, dst_uri: str) -> int:
+        raise NotImplementedError
+
+    # -- shared higher-level helpers -----------------------------------------
+
+    async def get_metrics_records(self, artifacts_uri: str) -> tuple[list[dict[str, Any]], str] | None:
+        """Pick the newest ``*metrics*.csv`` under the artifacts prefix and
+        parse it to records (reference: ``S3Handler.py:237-292``)."""
+        objs = await self.list_prefix(artifacts_uri)
+        csvs = [o for o in objs if "metrics" in Path(o["uri"]).name and o["uri"].endswith(".csv")]
+        if not csvs:
+            return None
+        newest = max(csvs, key=lambda o: o["mtime"])
+        raw = await self.get_bytes(newest["uri"])
+        df = await asyncio.to_thread(pd.read_csv, io.BytesIO(raw))
+        records = df.to_dict(orient="records")
+        return records, newest["uri"]
+
+    async def zip_prefix(self, prefix_uri: str) -> bytes:
+        """Zip every object under a prefix for download streaming
+        (reference: ``S3Handler.py:294-373``)."""
+        objs = await self.list_prefix(prefix_uri)
+        _, prefix_key = parse_uri(prefix_uri)
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for o in objs:
+                _, key = parse_uri(o["uri"])
+                arcname = key[len(prefix_key) :].lstrip("/") if key.startswith(prefix_key) else key
+                zf.writestr(arcname, await self.get_bytes(o["uri"]))
+        return buf.getvalue()
+
+
+class LocalObjectStore(ObjectStore):
+    """Filesystem-backed store rooted at ``root/<bucket>/<key>``."""
+
+    def __init__(self, root: Path | str):
+        self.root = Path(root).expanduser()
+
+    def path_for(self, uri: str) -> Path:
+        bucket, key = parse_uri(uri)
+        base = (self.root / bucket).resolve()
+        p = (self.root / bucket / key).resolve()
+        if p != base and not p.is_relative_to(base):
+            raise ValueError(f"path escape in uri {uri!r}")
+        return p
+
+    async def put_bytes(self, uri: str, data: bytes) -> None:
+        def write() -> None:
+            p = self.path_for(uri)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            tmp = p.with_name(p.name + ".tmp")
+            tmp.write_bytes(data)
+            tmp.replace(p)
+
+        await asyncio.to_thread(write)
+
+    async def put_stream(self, uri: str, chunks: AsyncIterator[bytes]) -> int:
+        """Zero-copy-ish streaming upload (reference: URL→S3 streaming,
+        ``dataset_helpers.py:113-145``)."""
+        p = self.path_for(uri)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_name(p.name + ".tmp")
+        total = 0
+        with tmp.open("wb") as f:
+            async for chunk in chunks:
+                total += len(chunk)
+                await asyncio.to_thread(f.write, chunk)
+        tmp.replace(p)
+        return total
+
+    async def put_file(self, uri: str, path: Path | str) -> None:
+        def copy() -> None:
+            p = self.path_for(uri)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copyfile(path, p)
+
+        await asyncio.to_thread(copy)
+
+    async def get_bytes(self, uri: str) -> bytes:
+        return await asyncio.to_thread(self.path_for(uri).read_bytes)
+
+    async def exists(self, uri: str) -> bool:
+        return await asyncio.to_thread(self.path_for(uri).exists)
+
+    async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
+        bucket, key = parse_uri(prefix_uri)
+
+        def scan() -> list[dict[str, Any]]:
+            base = self.root / bucket / key
+            if not base.exists():
+                return []
+            out = []
+            for p in sorted(base.rglob("*")):
+                if p.is_file() and not p.name.endswith(".tmp"):
+                    rel = p.relative_to(self.root / bucket)
+                    st = p.stat()
+                    out.append(
+                        {
+                            "uri": build_uri(bucket, str(rel)),
+                            "size": st.st_size,
+                            "mtime": st.st_mtime,
+                        }
+                    )
+            return out
+
+        return await asyncio.to_thread(scan)
+
+    async def delete_prefix(self, prefix_uri: str) -> int:
+        """Reference: ``S3Handler.py:216-235``."""
+        objs = await self.list_prefix(prefix_uri)
+
+        def rm() -> None:
+            bucket, key = parse_uri(prefix_uri)
+            base = self.root / bucket / key
+            if base.is_dir():
+                shutil.rmtree(base)
+            elif base.exists():
+                base.unlink()
+
+        await asyncio.to_thread(rm)
+        return len(objs)
+
+    async def copy_prefix(self, src_uri: str, dst_uri: str) -> int:
+        """Recursive copy for promotion (reference: ``S3Handler.py:375-439`` —
+        head the key; on miss treat as prefix and copy each object)."""
+        src_path = self.path_for(src_uri)
+        if src_path.is_file():
+            await self.put_bytes(dst_uri, await self.get_bytes(src_uri))
+            return 1
+        objs = await self.list_prefix(src_uri)
+        _, src_key = parse_uri(src_uri)
+        dst_bucket, dst_key = parse_uri(dst_uri)
+        n = 0
+        for o in objs:
+            _, key = parse_uri(o["uri"])
+            rel = key[len(src_key) :].lstrip("/")
+            await self.put_bytes(
+                build_uri(dst_bucket, dst_key, rel), await self.get_bytes(o["uri"])
+            )
+            n += 1
+        return n
+
+
+class Presigner:
+    """HMAC presigned-download tokens (reference: S3 presigned URLs,
+    ``S3Handler.py:168-214``; ours are served by the API's ``/download`` route
+    since the local store has no external endpoint)."""
+
+    def __init__(self, secret: str, expiry_s: int = 3600):
+        self._secret = secret.encode()
+        self._expiry_s = expiry_s
+
+    def sign(self, uri: str, now: float | None = None) -> str:
+        expires = int((now if now is not None else time.time()) + self._expiry_s)
+        mac = hmac.new(self._secret, f"{uri}:{expires}".encode(), hashlib.sha256)
+        return f"{expires}.{mac.hexdigest()}"
+
+    def verify(self, uri: str, token: str, now: float | None = None) -> bool:
+        try:
+            expires_s, digest = token.split(".", 1)
+            expires = int(expires_s)
+        except ValueError:
+            return False
+        if (now if now is not None else time.time()) > expires:
+            return False
+        mac = hmac.new(self._secret, f"{uri}:{expires}".encode(), hashlib.sha256)
+        return hmac.compare_digest(mac.hexdigest(), digest)

@@ -1,0 +1,247 @@
+"""Auth: JWT mint/verify, remote token introspection, aiohttp middleware.
+
+Capability parity with the reference's ``app/core/security.py`` (472 LoC —
+SURVEY.md §2 component 2): bearer-or-cookie extraction, OAuth token
+introspection against a remote endpoint, local JWT validation, a dev-mode mint/
+verify path so the whole stack runs without an identity provider
+(``security.py:347-421``), and per-user model entitlements carried in the JWT
+``scp`` claim (``security.py:17,354``). JWTs are HS256 via stdlib ``hmac``
+(PyJWT is not in the image); the introspection client is injectable for tests
+(the seam the reference's test implicitly lacked — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import logging
+import time
+from typing import Any, Awaitable, Callable
+
+from pydantic import BaseModel, Field
+
+logger = logging.getLogger(__name__)
+
+
+class AuthError(Exception):
+    def __init__(self, message: str, status: int = 401):
+        super().__init__(message)
+        self.status = status
+
+
+class UserJWT(BaseModel):
+    """Validated identity attached to each request (reference: ``UserJWT``,
+    ``security.py:33-38``)."""
+
+    user_id: str
+    email: str = ""
+    scopes: list[str] = Field(default_factory=list)  # `scp` claim: entitled models
+    is_admin: bool = False
+    expires_at: float | None = None
+
+    def entitled_models(self, all_models: list[str]) -> list[str]:
+        """Models this user may submit (reference: entitlement check,
+        ``app/main.py:412,1323-1341``): empty scp ⇒ everything, else filter."""
+        if not self.scopes or self.is_admin:
+            return list(all_models)
+        return [m for m in all_models if m in self.scopes]
+
+
+# ---------------------------------------------------------------------------
+# Stdlib HS256 JWT
+# ---------------------------------------------------------------------------
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def encode_jwt(claims: dict[str, Any], secret: str) -> str:
+    """Mint an HS256 JWT (dev path; reference: ``dev_generate_token``,
+    ``security.py:347-389``)."""
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url(json.dumps(claims).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = hmac.new(secret.encode(), signing_input, hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+def decode_jwt(token: str, secret: str | None = None, verify_exp: bool = True) -> dict[str, Any]:
+    """Decode (and optionally verify) a JWT (reference: ``decode_jwt``,
+    ``security.py:46-63``)."""
+    try:
+        header_s, payload_s, sig_s = token.split(".")
+    except ValueError as e:
+        raise AuthError("malformed token") from e
+    if secret is not None:
+        expected = hmac.new(
+            secret.encode(), f"{header_s}.{payload_s}".encode(), hashlib.sha256
+        ).digest()
+        if not hmac.compare_digest(expected, _b64url_decode(sig_s)):
+            raise AuthError("invalid token signature")
+    try:
+        claims = json.loads(_b64url_decode(payload_s))
+    except (ValueError, json.JSONDecodeError) as e:
+        raise AuthError("malformed token payload") from e
+    if verify_exp and "exp" in claims and time.time() > float(claims["exp"]):
+        raise AuthError("token expired")
+    return claims
+
+
+def dev_generate_token(
+    user_id: str,
+    secret: str,
+    *,
+    scopes: list[str] | None = None,
+    is_admin: bool = False,
+    email: str = "",
+    ttl_s: float = 24 * 3600,
+) -> str:
+    claims = {
+        "sub": user_id,
+        "email": email,
+        "scp": scopes or [],
+        "admin": is_admin,
+        "iat": time.time(),
+        "exp": time.time() + ttl_s,
+    }
+    return encode_jwt(claims, secret)
+
+
+def user_from_claims(claims: dict[str, Any]) -> UserJWT:
+    return UserJWT(
+        user_id=str(claims.get("sub") or claims.get("user_id") or ""),
+        email=str(claims.get("email") or ""),
+        scopes=list(claims.get("scp") or []),
+        is_admin=bool(claims.get("admin", False)),
+        expires_at=claims.get("exp"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Token validation (introspection or local verify)
+# ---------------------------------------------------------------------------
+
+IntrospectFn = Callable[[str], Awaitable[dict[str, Any]]]
+
+
+async def dev_mock_token_introspection(token: str) -> dict[str, Any]:
+    """Canned introspection for dev/tests (reference:
+    ``dev_mock_token_introspection``, ``security.py:412-421``)."""
+    if token == "valid_token":
+        return {"active": True, "sub": "dev-user", "scp": []}
+    return {"active": False}
+
+
+class TokenValidator:
+    """Validates bearer tokens, with a small TTL cache (reference:
+    ``TokenValidator``, ``security.py:66-189``).
+
+    Strategies, tried in order:
+    1. injected/remote **introspection** (OAuth RFC 7662-style endpoint);
+    2. local **HS256 verification** against the configured secret.
+    """
+
+    def __init__(
+        self,
+        *,
+        jwt_secret: str,
+        introspection_url: str = "",
+        introspect_fn: IntrospectFn | None = None,
+        cache_ttl_s: float = 60.0,
+    ):
+        self._jwt_secret = jwt_secret
+        self._introspection_url = introspection_url
+        self._introspect_fn = introspect_fn
+        self._cache: dict[str, tuple[float, UserJWT]] = {}
+        self._cache_ttl_s = cache_ttl_s
+
+    async def _remote_introspect(self, token: str) -> dict[str, Any]:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                self._introspection_url, data={"token": token}
+            ) as resp:
+                if resp.status != 200:
+                    raise AuthError(f"introspection failed ({resp.status})", 401)
+                return await resp.json()
+
+    async def validate(self, token: str) -> UserJWT:
+        now = time.time()
+        cached = self._cache.get(token)
+        if cached and cached[0] > now:
+            return cached[1]
+
+        user: UserJWT | None = None
+        if self._introspect_fn is not None or self._introspection_url:
+            fn = self._introspect_fn or self._remote_introspect
+            data = await fn(token)
+            if not data.get("active", False):
+                raise AuthError("token not active")
+            user = user_from_claims(data)
+        else:
+            claims = decode_jwt(token, self._jwt_secret)
+            user = user_from_claims(claims)
+        if not user.user_id:
+            raise AuthError("token has no subject")
+        ttl = self._cache_ttl_s
+        if user.expires_at is not None:
+            ttl = min(ttl, max(user.expires_at - now, 0.0))
+        self._cache[token] = (now + ttl, user)
+        if len(self._cache) > 10_000:  # bound the cache
+            self._cache = {k: v for k, v in self._cache.items() if v[0] > now}
+        return user
+
+
+# ---------------------------------------------------------------------------
+# aiohttp middleware
+# ---------------------------------------------------------------------------
+
+
+def extract_bearer(request: Any) -> str | None:
+    """Authorization header or auth cookie (reference cookie-or-bearer
+    extraction, ``security.py:211-240``)."""
+    auth = request.headers.get("Authorization", "")
+    if auth.lower().startswith("bearer "):
+        return auth[7:].strip()
+    cookie = request.cookies.get("ftc_token")
+    return cookie or None
+
+
+def build_auth_middleware(
+    validator: TokenValidator,
+    *,
+    enabled: bool,
+    api_prefix: str = "/api/v1",
+    dev_user: str = "dev-user",
+):
+    """aiohttp middleware guarding ``/api/v1/*`` (reference:
+    ``OpenBridgeBasicMiddleware``, ``security.py:201-268``). With auth disabled
+    (local env) every request is attributed to ``dev_user`` — the reference's
+    local-env fallback (``security.py:242-248``)."""
+    from aiohttp import web
+
+    @web.middleware
+    async def auth_middleware(request, handler):
+        if not request.path.startswith(api_prefix) or request.path.endswith("/health"):
+            return await handler(request)
+        if not enabled:
+            request["user"] = UserJWT(user_id=dev_user, is_admin=True)
+            return await handler(request)
+        token = extract_bearer(request)
+        if not token:
+            return web.json_response({"detail": "missing bearer token"}, status=401)
+        try:
+            request["user"] = await validator.validate(token)
+        except AuthError as e:
+            return web.json_response({"detail": str(e)}, status=e.status)
+        return await handler(request)
+
+    return auth_middleware

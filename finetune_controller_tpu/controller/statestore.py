@@ -1,0 +1,344 @@
+"""Async document state store — the control plane's source of truth.
+
+Capability parity with the reference's ``MongoDBManager`` (``app/database/db.py``,
+710 LoC — SURVEY.md §2 component 7): jobs / metrics / datasets / archived_jobs
+collections, indexed lookups, paginated job queries with server-side computed
+fields, metadata merge on status updates, archive-on-delete. The engine is an
+in-repo async document store (JSON-file persistence + in-memory indexes) instead
+of an external MongoDB server — the reference's Mongo is an external C++ process
+(SURVEY.md §2.2), so "external document store" is the delegation seam we replace
+with an embedded one. The public API is transport-agnostic, so a Mongo-backed
+implementation can be swapped in behind the same interface.
+
+Fixes a reference wart on the way: the monitor's N+1 per-job DB reads
+(``app/core/monitor.py:151-158``) are avoided by :meth:`StateStore.get_jobs_by_ids`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Callable
+
+from .schemas import (
+    DatabaseStatus,
+    DatasetRecord,
+    JobRecord,
+    MetricsDocument,
+    PaginatedTableResponse,
+    PromotionStatus,
+)
+
+
+def generate_short_uuid() -> str:
+    """8-char lowercase job-id suffix (reference: ``app/utils/naming.py:4-6``)."""
+    return uuid.uuid4().hex[:8]
+
+
+class Collection:
+    """One named document collection with unique-key index and file persistence.
+
+    Persistence is an append-only JSONL log: each write appends the changed
+    document (or a ``{"__tombstone__": key}`` record for deletes); load replays
+    the log last-record-wins. The log compacts in place once it grows past
+    ~4x the live document count, so a single write is O(doc) amortised rather
+    than O(collection) — the monitor's per-tick status updates stay cheap even
+    with thousands of accumulated jobs. All file I/O runs off the event loop.
+    """
+
+    _COMPACT_MIN_RECORDS = 1024
+
+    def __init__(self, path: Path | None, key: str):
+        self._path = path
+        self._key = key
+        self._docs: dict[str, dict[str, Any]] = {}
+        self._lock = asyncio.Lock()
+        self._loaded = False
+        self._log_records = 0
+
+    def _load(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        if self._path is not None and self._path.exists():
+            with self._path.open() as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    self._log_records += 1
+                    if "__tombstone__" in rec:
+                        self._docs.pop(rec["__tombstone__"], None)
+                    else:
+                        self._docs[rec[self._key]] = rec
+
+    def _append(self, record: dict[str, Any]) -> None:
+        if self._path is None:
+            return
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        with self._path.open("a") as f:
+            f.write(json.dumps(record) + "\n")
+        self._log_records += 1
+        if self._log_records >= max(self._COMPACT_MIN_RECORDS, 4 * len(self._docs)):
+            self._compact()
+
+    def _compact(self) -> None:
+        tmp = self._path.with_suffix(".tmp")
+        with tmp.open("w") as f:
+            for doc in self._docs.values():
+                f.write(json.dumps(doc) + "\n")
+        tmp.replace(self._path)
+        self._log_records = len(self._docs)
+
+    async def insert(self, doc: dict[str, Any]) -> None:
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = dict(doc)
+            self._docs[doc[self._key]] = doc
+            await asyncio.to_thread(self._append, doc)
+
+    async def get(self, key: str) -> dict[str, Any] | None:
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = self._docs.get(key)
+            return dict(doc) if doc else None
+
+    async def update(self, key: str, fields: dict[str, Any]) -> bool:
+        """Atomic set of top-level fields (reference: Mongo ``update_one`` with
+        ``$set``, ``db.py:217-219``)."""
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = self._docs.get(key)
+            if doc is None:
+                return False
+            doc.update(fields)
+            await asyncio.to_thread(self._append, doc)
+            return True
+
+    async def merge_subdoc(self, key: str, field: str, patch: dict[str, Any]) -> bool:
+        """Last-writer-wins merge into a dict field (reference metadata merge,
+        ``db.py:206-215``)."""
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = self._docs.get(key)
+            if doc is None:
+                return False
+            sub = dict(doc.get(field) or {})
+            sub.update(patch)
+            doc[field] = sub
+            await asyncio.to_thread(self._append, doc)
+            return True
+
+    async def delete(self, key: str) -> dict[str, Any] | None:
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = self._docs.pop(key, None)
+            if doc is not None:
+                await asyncio.to_thread(self._append, {"__tombstone__": key})
+            return doc
+
+    async def find(
+        self, predicate: Callable[[dict[str, Any]], bool] | None = None
+    ) -> list[dict[str, Any]]:
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            docs = [dict(d) for d in self._docs.values()]
+        if predicate is not None:
+            docs = [d for d in docs if predicate(d)]
+        return docs
+
+    async def count(
+        self, predicate: Callable[[dict[str, Any]], bool] | None = None
+    ) -> int:
+        return len(await self.find(predicate))
+
+
+class StateStore:
+    """Domain-level store over four collections (reference: ``MongoDBManager``).
+
+    ``state_dir=None`` keeps everything in memory (the unit-test seam the
+    reference never had).
+    """
+
+    def __init__(self, state_dir: Path | str | None = None):
+        self._dir = Path(state_dir).expanduser() if state_dir is not None else None
+
+        def path(name: str) -> Path | None:
+            return None if self._dir is None else self._dir / f"{name}.jsonl"
+
+        self.jobs = Collection(path("jobs"), "job_id")
+        self.archived_jobs = Collection(path("archived_jobs"), "job_id")
+        self.metrics = Collection(path("metrics"), "job_id")
+        self.datasets = Collection(path("datasets"), "dataset_id")
+        self._connected = False
+
+    # -- lifecycle (reference: connect/_ensure_indexes, db.py:33-105) --------
+
+    async def connect(self) -> None:
+        if self._dir is not None:
+            self._dir.mkdir(parents=True, exist_ok=True)
+        self._connected = True
+
+    async def close(self) -> None:
+        self._connected = False
+
+    # -- jobs (reference: db.py:107-379) -------------------------------------
+
+    async def create_job(self, job: JobRecord) -> None:
+        await self.jobs.insert(job.model_dump(mode="json"))
+
+    async def get_job(self, job_id: str) -> JobRecord | None:
+        doc = await self.jobs.get(job_id)
+        return JobRecord(**doc) if doc else None
+
+    async def get_jobs_by_ids(self, job_ids: list[str]) -> dict[str, JobRecord]:
+        """Batch fetch — kills the reference monitor's N+1 pattern
+        (``app/core/monitor.py:151-158``)."""
+        wanted = set(job_ids)
+        docs = await self.jobs.find(lambda d: d["job_id"] in wanted)
+        return {d["job_id"]: JobRecord(**d) for d in docs}
+
+    async def update_job_status(
+        self,
+        job_id: str,
+        status: DatabaseStatus,
+        *,
+        metadata: dict[str, Any] | None = None,
+        **fields: Any,
+    ) -> bool:
+        """Status update + metadata merge (reference: ``db.py:195-228``)."""
+        ok = await self.jobs.update(
+            job_id,
+            {"status": DatabaseStatus(status).value, **_jsonify(fields)},
+        )
+        if ok and metadata:
+            await self.jobs.merge_subdoc(job_id, "metadata", _jsonify(metadata))
+        return ok
+
+    async def update_job_promotion(
+        self,
+        job_id: str,
+        promotion_status: PromotionStatus,
+        promotion_uri: str | None = None,
+    ) -> bool:
+        """Reference: ``db.py:230-255``."""
+        fields: dict[str, Any] = {
+            "promotion_status": PromotionStatus(promotion_status).value
+        }
+        if promotion_uri is not None:
+            fields["promotion_uri"] = promotion_uri
+        return await self.jobs.update(job_id, fields)
+
+    async def update_job_fields(self, job_id: str, **fields: Any) -> bool:
+        return await self.jobs.update(job_id, _jsonify(fields))
+
+    async def get_user_jobs(
+        self,
+        user_id: str | None,
+        *,
+        page: int = 1,
+        page_size: int = 20,
+        status: DatabaseStatus | None = None,
+        search: str | None = None,
+        sort_by: str = "submitted_at",
+        descending: bool = True,
+    ) -> PaginatedTableResponse:
+        """Paginated job table with computed fields.
+
+        Mirrors the reference's server-side aggregation pipeline
+        (``db.py:282-379`` + ``_job_pipeline_add_fields`` ``db.py:381-517``):
+        ``duration``, ``status_merged`` (status + promotion), and a stable
+        ``index_`` row number; filtering by status and free-text search.
+        ``user_id=None`` lists all users' jobs (the admin view,
+        ``app/main.py:1099-1297``).
+        """
+        docs = await self.jobs.find(
+            lambda d: user_id is None or d["user_id"] == user_id
+        )
+        if status is not None:
+            docs = [d for d in docs if d["status"] == DatabaseStatus(status).value]
+        if search:
+            needle = search.lower()
+            docs = [
+                d
+                for d in docs
+                if needle in d["job_id"].lower() or needle in d["model_name"].lower()
+            ]
+        docs.sort(key=lambda d: (d.get(sort_by) is None, d.get(sort_by)), reverse=descending)
+        total = len(docs)
+        lo = max(page - 1, 0) * page_size
+        page_docs = docs[lo : lo + page_size]
+        now = time.time()
+        items = []
+        for i, d in enumerate(page_docs):
+            start, end = d.get("start_time"), d.get("end_time")
+            duration = None
+            if start is not None:
+                duration = (end if end is not None else now) - start
+            status_merged = d["status"]
+            if d.get("promotion_status") not in (None, PromotionStatus.NOT_PROMOTED.value):
+                status_merged = f"{d['status']}/{d['promotion_status']}"
+            items.append(
+                {**d, "duration": duration, "status_merged": status_merged,
+                 "index_": lo + i}
+            )
+        return PaginatedTableResponse(
+            total=total, page=page, page_size=page_size, items=items
+        )
+
+    async def delete_job(self, job_id: str) -> bool:
+        """Archive-on-delete (reference: ``db.py:519-526``)."""
+        doc = await self.jobs.delete(job_id)
+        if doc is None:
+            return False
+        doc["archived_at"] = time.time()
+        await self.archived_jobs.insert(doc)
+        await self.metrics.delete(job_id)
+        return True
+
+    # -- metrics (reference: db.py:150-193,528) -------------------------------
+
+    async def upsert_metrics(self, metrics: MetricsDocument) -> None:
+        await self.metrics.insert(metrics.model_dump(mode="json"))
+
+    async def get_metrics(self, job_id: str) -> MetricsDocument | None:
+        doc = await self.metrics.get(job_id)
+        return MetricsDocument(**doc) if doc else None
+
+    # -- datasets (reference: db.py:534-706) ----------------------------------
+
+    async def insert_dataset(self, dataset: DatasetRecord) -> None:
+        await self.datasets.insert(dataset.model_dump(mode="json"))
+
+    async def get_dataset(self, dataset_id: str) -> DatasetRecord | None:
+        doc = await self.datasets.get(dataset_id)
+        return DatasetRecord(**doc) if doc else None
+
+    async def get_user_datasets(self, user_id: str) -> list[DatasetRecord]:
+        docs = await self.datasets.find(lambda d: d["user_id"] == user_id)
+        docs.sort(key=lambda d: d["created_at"], reverse=True)
+        return [DatasetRecord(**d) for d in docs]
+
+    async def add_dataset_job_ref(self, dataset_id: str, job_id: str) -> bool:
+        """Append a job reference (reference: ``db.py:681-699``)."""
+        doc = await self.datasets.get(dataset_id)
+        if doc is None:
+            return False
+        refs = list(doc.get("job_refs") or [])
+        if job_id not in refs:
+            refs.append(job_id)
+        return await self.datasets.update(dataset_id, {"job_refs": refs})
+
+    async def delete_dataset(self, dataset_id: str) -> bool:
+        return (await self.datasets.delete(dataset_id)) is not None
+
+
+def _jsonify(fields: dict[str, Any]) -> dict[str, Any]:
+    return {
+        k: (v.value if isinstance(v, (DatabaseStatus, PromotionStatus)) else v)
+        for k, v in fields.items()
+    }

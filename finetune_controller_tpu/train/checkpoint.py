@@ -52,13 +52,36 @@ class CheckpointManager:
             import shutil
 
             shutil.rmtree(path)
-        self._ckptr.save(path, tree)
-        self._ckptr.wait_until_finished()
+        if jax.process_count() > 1:
+            # Orbax's save is itself a cross-process collective (sync_global_
+            # processes barriers); on multi-host only rank 0 calls save with an
+            # already-gathered host tree, so use a non-collective msgpack
+            # writer (atomic via tmp-dir rename).
+            self._save_msgpack(path, tree)
+        else:
+            self._ckptr.save(path, tree)
+            self._ckptr.wait_until_finished()
         self._gc()
 
+    @staticmethod
+    def _save_msgpack(path: str, tree: Any) -> None:
+        from flax import serialization
+
+        tmp = path + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+            f.write(serialization.to_bytes(tree))
+        os.replace(tmp, path)
+
     def restore(self, step: int, like: Any | None = None) -> Any:
-        restored = self._ckptr.restore(self._path(step), target=like)
-        return restored
+        path = self._path(step)
+        msgpack_file = os.path.join(path, "state.msgpack")
+        if os.path.exists(msgpack_file):
+            from flax import serialization
+
+            with open(msgpack_file, "rb") as f:
+                return serialization.from_bytes(like, f.read())
+        return self._ckptr.restore(path, target=like)
 
     def restore_latest(self, like: Any | None = None) -> tuple[int, Any] | None:
         step = self.latest_step()

@@ -235,6 +235,20 @@ class Trainer:
             tree = multihost_utils.process_allgather(tree, tiled=True)
         return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
+    @staticmethod
+    def _sync_preemption(local_flag: bool) -> bool:
+        """OR a per-host preemption flag across all hosts (one tiny allgather
+        per step — negligible next to a training step, and required so every
+        host takes the same checkpoint/exit branch)."""
+        if jax.process_count() == 1:
+            return local_flag
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(local_flag, np.bool_), tiled=False
+        )
+        return bool(np.any(flags))
+
     def fit(
         self,
         batches: Iterable[dict],
@@ -247,6 +261,9 @@ class Trainer:
             guard.install()
         except ValueError:
             pass  # not on the main thread (e.g. tests)
+        # Preemption-flag sync cadence: bounded by log cadence so detection
+        # latency stays low without paying a cross-host sync every step.
+        self._preempt_sync_every = max(1, min(self.cfg.log_every, self.cfg.checkpoint_every))
 
         ckpt = CheckpointManager(
             f"{artifacts_dir}/checkpoints", keep=self.cfg.keep_checkpoints
@@ -255,8 +272,29 @@ class Trainer:
         start_step = 0
         if resume:
             latest = ckpt.latest_step()
+            multi = jax.process_count() > 1
+            if multi:
+                # All hosts must agree on the resume decision: artifacts_dir may
+                # be host-local storage where only rank 0 persisted, so rank 0's
+                # view is authoritative. Without this broadcast, hosts would run
+                # different numbers of jitted steps and deadlock on collectives.
+                from jax.experimental import multihost_utils
+
+                latest_arr = multihost_utils.broadcast_one_to_all(
+                    np.asarray(-1 if latest is None else latest, np.int64)
+                )
+                latest = None if int(latest_arr) < 0 else int(latest_arr)
             if latest is not None:
-                host = ckpt.restore(latest, like=self.state_to_host(state))
+                # Only rank 0 is guaranteed to hold the checkpoint bytes, so
+                # rank 0 restores and the tree is broadcast; other hosts feed
+                # the broadcast a structure-matching template.
+                template = self.state_to_host(state)
+                if not multi or jax.process_index() == 0:
+                    host = ckpt.restore(latest, like=template)
+                else:
+                    host = template
+                if multi:
+                    host = multihost_utils.broadcast_one_to_all(host)
                 state = state.replace(
                     step=jnp.asarray(host["step"], jnp.int32),
                     trainable=reshard(host["trainable"], self._state_shardings.trainable),
@@ -297,12 +335,24 @@ class Trainer:
                     window_t0 = time.perf_counter()
                     window_tokens = 0
 
-                if (step_idx + 1) % self.cfg.checkpoint_every == 0 or last or guard.requested:
+                # SIGTERM may reach only some hosts; state_to_host is a
+                # collective, so the preempt flag must be agreed across hosts
+                # (any-host OR) before any host enters the gather. The sync is
+                # a blocking allgather that would serialize host and device if
+                # run every step, so it only runs on a deterministic cadence
+                # (same arithmetic on every host ⇒ still collective-safe).
+                sync_now = (
+                    (step_idx + 1) % self._preempt_sync_every == 0
+                    or (step_idx + 1) % self.cfg.checkpoint_every == 0
+                    or last
+                )
+                preempt = self._sync_preemption(guard.requested) if sync_now else False
+                if (step_idx + 1) % self.cfg.checkpoint_every == 0 or last or preempt:
                     # Collective gather on all hosts; rank 0 persists.
                     host_state = self.state_to_host(state)
                     if jax.process_index() == 0:
                         ckpt.save(step_idx + 1, host_state)
-                if guard.requested:
+                if preempt:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
                     raise SystemExit(143)
         finally:

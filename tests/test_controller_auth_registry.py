@@ -1,0 +1,176 @@
+"""Tests for job specs, the model registry, and auth.
+
+Covers SURVEY.md §2 components 2,3,4: subclass type enforcement
+(reference ``finetuning.py:110-145``), schema-as-form, plugin discovery
+(``model_loader.py:14-45``), JWT mint/verify + introspection + entitlements
+(``security.py``). The reference's only real test is the 401/200 middleware
+test (``tests/test_security.py:1-36``) — these go well beyond it.
+"""
+
+import asyncio
+
+import pytest
+
+from finetune_controller_tpu.controller import registry
+from finetune_controller_tpu.controller.examples import (
+    BUILTIN_JOB_SPECS,
+    LoRASFTArguments,
+    TinyTestLoRA,
+)
+from finetune_controller_tpu.controller.security import (
+    AuthError,
+    TokenValidator,
+    decode_jwt,
+    dev_generate_token,
+    dev_mock_token_introspection,
+    encode_jwt,
+    user_from_claims,
+)
+from finetune_controller_tpu.controller.specs import (
+    BaseFineTuneJob,
+    TrainingArguments,
+    TrainingTask,
+)
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# Specs
+# ---------------------------------------------------------------------------
+
+
+def test_subclass_type_enforcement():
+    with pytest.raises(TypeError, match="model_name"):
+
+        class BadName(BaseFineTuneJob):
+            model_name = 123  # type: ignore[assignment]
+
+    with pytest.raises(TypeError, match="task"):
+
+        class BadTask(BaseFineTuneJob):
+            model_name = "x"
+            task = "causal_lm"  # type: ignore[assignment]  # must be the enum
+
+    class Good(BaseFineTuneJob):
+        model_name = "good"
+        task = TrainingTask.CAUSAL_LM
+        training_arguments: TrainingArguments
+
+    assert Good.model_name == "good"
+
+
+def test_arguments_validation_and_schema():
+    with pytest.raises(Exception):  # pydantic ValidationError: extra forbidden
+        LoRASFTArguments(not_a_field=1)
+    with pytest.raises(Exception):  # constraint violation
+        LoRASFTArguments(learning_rate=-1.0)
+    schema = TinyTestLoRA.arguments_schema()
+    props = schema["properties"]
+    assert props["learning_rate"]["description"] == "Peak AdamW learning rate"
+    assert props["lora_rank"]["default"] == 16
+
+
+def test_build_trainer_spec_and_run_cmd():
+    job = TinyTestLoRA(
+        training_arguments=LoRASFTArguments(total_steps=5, batch_size=4, seq_len=32)
+    )
+    spec = job.build_trainer_spec(
+        "tiny-abc", "/tmp/art", dataset_path="/tmp/ds.jsonl", mesh={"fsdp": 2}
+    )
+    assert spec["model"] == {"preset": "tiny-test", "lora": {"rank": 16}}
+    assert spec["training"]["total_steps"] == 5
+    assert spec["training"]["mode"] == "lora"
+    assert spec["dataset"] == {"path": "/tmp/ds.jsonl"}
+    assert spec["mesh"] == {"fsdp": 2}
+    cmd = job.run_cmd("/data/job.json")
+    assert "finetune_controller_tpu.train.cli" in cmd
+    assert cmd.endswith("done.txt")  # completion signal convention
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_builtin_registration():
+    registry.reset()
+    registry.load_builtin_models()
+    assert set(registry.JOB_MANIFESTS) == {c.model_name for c in BUILTIN_JOB_SPECS}
+    assert registry.get_spec("tiny-test-lora") is TinyTestLoRA
+    registry.reset()
+
+
+def test_plugin_discovery(tmp_path):
+    (tmp_path / "my_model.py").write_text(
+        "from finetune_controller_tpu.controller.specs import (\n"
+        "    BaseFineTuneJob, TrainingArguments)\n"
+        "from pydantic import Field\n"
+        "class MyArgs(TrainingArguments):\n"
+        "    epochs: int = Field(3, ge=1)\n"
+        "class MyModel(BaseFineTuneJob):\n"
+        "    model_name = 'my-custom-model'\n"
+        "    model_preset = 'tiny-test'\n"
+        "    training_arguments: MyArgs\n"
+    )
+    (tmp_path / "broken.py").write_text("raise RuntimeError('bad plugin')\n")
+    (tmp_path / "_private.py").write_text("raise RuntimeError('must not load')\n")
+    registry.reset()
+    names = registry.load_models_from_directory(tmp_path)
+    assert names == ["my-custom-model"]  # broken plugin skipped, not fatal
+    assert registry.get_spec("my-custom-model") is not None
+    registry.reset()
+
+
+def test_missing_plugin_dir_ok(tmp_path):
+    registry.reset()
+    assert registry.load_models_from_directory(tmp_path / "nope") == []
+    registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Auth
+# ---------------------------------------------------------------------------
+
+
+def test_jwt_roundtrip_and_tamper():
+    tok = dev_generate_token("alice", "s3cret", scopes=["m1"], ttl_s=60)
+    claims = decode_jwt(tok, "s3cret")
+    assert claims["sub"] == "alice" and claims["scp"] == ["m1"]
+    with pytest.raises(AuthError, match="signature"):
+        decode_jwt(tok, "wrong-secret")
+    with pytest.raises(AuthError, match="malformed"):
+        decode_jwt("abc.def")
+    expired = encode_jwt({"sub": "a", "exp": 1.0}, "s3cret")
+    with pytest.raises(AuthError, match="expired"):
+        decode_jwt(expired, "s3cret")
+
+
+def test_validator_local_and_introspection():
+    async def go():
+        v = TokenValidator(jwt_secret="s")
+        user = await v.validate(dev_generate_token("bob", "s"))
+        assert user.user_id == "bob"
+        with pytest.raises(AuthError):
+            await v.validate(dev_generate_token("bob", "other"))
+
+        vi = TokenValidator(jwt_secret="s", introspect_fn=dev_mock_token_introspection)
+        user = await vi.validate("valid_token")
+        assert user.user_id == "dev-user"
+        # cached second call works even if backend would now say no
+        assert (await vi.validate("valid_token")).user_id == "dev-user"
+        with pytest.raises(AuthError, match="not active"):
+            await vi.validate("expired_token")
+
+    run(go())
+
+
+def test_entitlements():
+    user = user_from_claims({"sub": "u", "scp": ["m1", "m3"]})
+    assert user.entitled_models(["m1", "m2", "m3"]) == ["m1", "m3"]
+    admin = user_from_claims({"sub": "a", "admin": True, "scp": ["m1"]})
+    assert admin.entitled_models(["m1", "m2"]) == ["m1", "m2"]
+    open_user = user_from_claims({"sub": "u2"})
+    assert open_user.entitled_models(["m1", "m2"]) == ["m1", "m2"]

@@ -1,0 +1,317 @@
+"""Tests for control-plane core: settings, schemas, state store, object store.
+
+Covers the capability surface of the reference's ``app/core/config.py``,
+``app/schemas/``, ``app/database/db.py`` and ``app/utils/S3Handler.py``
+(SURVEY.md §2 components 7,8,9,13) with the hermetic test seams the reference
+lacked (SURVEY.md §4).
+"""
+
+import asyncio
+
+import pytest
+
+from finetune_controller_tpu.controller import config as cfg
+from finetune_controller_tpu.controller.objectstore import (
+    LocalObjectStore,
+    Presigner,
+    artifacts_prefix,
+    build_uri,
+    dataset_prefix,
+    parse_uri,
+)
+from finetune_controller_tpu.controller.schemas import (
+    BackendJobState,
+    DatabaseStatus,
+    DatasetRecord,
+    JobRecord,
+    MetricsDocument,
+    PromotionStatus,
+    map_backend_state,
+)
+from finetune_controller_tpu.controller.statestore import StateStore, generate_short_uuid
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# Settings
+# ---------------------------------------------------------------------------
+
+
+def test_settings_env_parsing(monkeypatch):
+    monkeypatch.setenv("FTC_NAMESPACE", "prod-ns")
+    monkeypatch.setenv("FTC_AUTH_ENABLED", "true")
+    monkeypatch.setenv("FTC_JOB_MONITOR_INTERVAL_S", "0.5")
+    monkeypatch.setenv("FTC_CORS_ORIGINS", "https://a.example,https://b.example")
+    cfg.set_settings(None)
+    s = cfg.get_settings()
+    assert s.namespace == "prod-ns"
+    assert s.auth_enabled is True
+    assert s.job_monitor_interval_s == 0.5
+    assert s.cors_origins == ["https://a.example", "https://b.example"]
+    cfg.set_settings(None)
+
+
+def test_settings_injectable():
+    custom = cfg.Settings(namespace="injected")
+    cfg.set_settings(custom)
+    assert cfg.get_settings() is custom
+    cfg.set_settings(None)
+
+
+# ---------------------------------------------------------------------------
+# State machine
+# ---------------------------------------------------------------------------
+
+
+def test_backend_state_mapping():
+    assert map_backend_state(BackendJobState.RUNNING) == DatabaseStatus.RUNNING
+    assert map_backend_state("Suspended") == DatabaseStatus.QUEUED
+    assert map_backend_state("Succeeded") == DatabaseStatus.SUCCEEDED
+    assert map_backend_state("bogus") == DatabaseStatus.UNKNOWN
+    assert DatabaseStatus.SUCCEEDED.is_final
+    assert not DatabaseStatus.RUNNING.is_final
+    assert BackendJobState.RESTARTING in BackendJobState.running_states()
+
+
+def test_short_uuid():
+    uid = generate_short_uuid()
+    assert len(uid) == 8 and uid == uid.lower()
+
+
+# ---------------------------------------------------------------------------
+# State store
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return StateStore(tmp_path / "state")
+
+
+def _job(job_id="llama-abc12345", user="alice", **kw):
+    return JobRecord(job_id=job_id, user_id=user, model_name="tinyllama-lora", **kw)
+
+
+def test_job_crud_and_persistence(store, tmp_path):
+    async def go():
+        await store.connect()
+        await store.create_job(_job())
+        job = await store.get_job("llama-abc12345")
+        assert job is not None and job.status == DatabaseStatus.QUEUED
+        ok = await store.update_job_status(
+            "llama-abc12345", DatabaseStatus.RUNNING,
+            metadata={"node": "w0"}, start_time=123.0,
+        )
+        assert ok
+        # metadata merges, not replaces (reference db.py:206-215)
+        await store.update_job_status(
+            "llama-abc12345", DatabaseStatus.RUNNING, metadata={"step": 5}
+        )
+        job = await store.get_job("llama-abc12345")
+        assert job.metadata == {"node": "w0", "step": 5}
+        assert job.start_time == 123.0
+
+        # survives a process restart (new store over same dir)
+        store2 = StateStore(tmp_path / "state")
+        await store2.connect()
+        job2 = await store2.get_job("llama-abc12345")
+        assert job2.status == DatabaseStatus.RUNNING
+
+    run(go())
+
+
+def test_pagination_and_computed_fields(store):
+    async def go():
+        await store.connect()
+        for i in range(25):
+            await store.create_job(
+                _job(job_id=f"job-{i:04d}", user="bob" if i % 2 else "alice")
+            )
+        await store.update_job_status(
+            "job-0000", DatabaseStatus.SUCCEEDED, start_time=10.0, end_time=70.0
+        )
+        await store.update_job_promotion("job-0000", PromotionStatus.COMPLETED)
+
+        page = await store.get_user_jobs("alice", page=1, page_size=5,
+                                         sort_by="job_id", descending=False)
+        assert page.total == 13 and len(page.items) == 5
+        assert page.items[0]["job_id"] == "job-0000"
+        assert page.items[0]["duration"] == 60.0
+        assert page.items[0]["status_merged"] == "succeeded/completed"
+        assert [it["index_"] for it in page.items] == [0, 1, 2, 3, 4]
+
+        page2 = await store.get_user_jobs("alice", page=2, page_size=5,
+                                          sort_by="job_id", descending=False)
+        assert page2.items[0]["index_"] == 5
+
+        filtered = await store.get_user_jobs("alice", status=DatabaseStatus.SUCCEEDED)
+        assert filtered.total == 1
+
+        searched = await store.get_user_jobs("alice", search="JOB-0002")
+        assert searched.total == 1
+
+        admin = await store.get_user_jobs(None)
+        assert admin.total == 25
+
+    run(go())
+
+
+def test_delete_archives(store):
+    async def go():
+        await store.connect()
+        await store.create_job(_job())
+        await store.upsert_metrics(
+            MetricsDocument(job_id="llama-abc12345", records=[{"loss": 1.0}])
+        )
+        assert await store.delete_job("llama-abc12345")
+        assert await store.get_job("llama-abc12345") is None
+        assert await store.get_metrics("llama-abc12345") is None
+        archived = await store.archived_jobs.get("llama-abc12345")
+        assert archived is not None and "archived_at" in archived
+
+    run(go())
+
+
+def test_datasets(store):
+    async def go():
+        await store.connect()
+        ds = DatasetRecord(dataset_id="ds1", user_id="alice", name="corpus",
+                           uri="obj://datasets/finetune_jobs/alice/j1/dataset/corpus.jsonl")
+        await store.insert_dataset(ds)
+        assert await store.add_dataset_job_ref("ds1", "job-1")
+        assert await store.add_dataset_job_ref("ds1", "job-1")  # idempotent
+        got = await store.get_dataset("ds1")
+        assert got.job_refs == ["job-1"]
+        assert len(await store.get_user_datasets("alice")) == 1
+        assert await store.delete_dataset("ds1")
+
+    run(go())
+
+
+def test_batch_get_no_n_plus_1(store):
+    async def go():
+        await store.connect()
+        for i in range(5):
+            await store.create_job(_job(job_id=f"j{i}"))
+        got = await store.get_jobs_by_ids(["j0", "j3", "missing"])
+        assert set(got) == {"j0", "j3"}
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# Object store
+# ---------------------------------------------------------------------------
+
+
+def test_uri_conventions():
+    uri = dataset_prefix("datasets", "alice", "job-1")
+    assert uri == "obj://datasets/finetune_jobs/alice/job-1/dataset"
+    assert parse_uri(uri) == ("datasets", "finetune_jobs/alice/job-1/dataset")
+    assert artifacts_prefix("artifacts", "a", "j").endswith("/artifacts")
+    with pytest.raises(ValueError):
+        parse_uri("s3://nope/key")
+
+
+def test_object_store_roundtrip(tmp_path):
+    store = LocalObjectStore(tmp_path / "obj")
+
+    async def go():
+        uri = build_uri("artifacts", "finetune_jobs/a/j/artifacts/ckpt.bin")
+        await store.put_bytes(uri, b"\x00\x01")
+        assert await store.exists(uri)
+        assert await store.get_bytes(uri) == b"\x00\x01"
+
+        async def chunks():
+            yield b"abc"
+            yield b"def"
+
+        surl = build_uri("datasets", "finetune_jobs/a/j/dataset/d.jsonl")
+        n = await store.put_stream(surl, chunks())
+        assert n == 6 and await store.get_bytes(surl) == b"abcdef"
+
+        objs = await store.list_prefix(build_uri("artifacts", "finetune_jobs/a/j"))
+        assert len(objs) == 1 and objs[0]["size"] == 2
+
+    run(go())
+
+
+def test_metrics_csv_and_zip_and_copy(tmp_path):
+    store = LocalObjectStore(tmp_path / "obj")
+
+    async def go():
+        prefix = artifacts_prefix("artifacts", "a", "j")
+        await store.put_bytes(f"{prefix}/metrics_old.csv", b"step,loss\n1,2.0\n")
+        await asyncio.sleep(0.02)
+        await store.put_bytes(f"{prefix}/metrics.csv", b"step,loss\n1,2.0\n2,1.5\n")
+        await store.put_bytes(f"{prefix}/adapter.ckpt", b"ww")
+
+        res = await store.get_metrics_records(prefix)
+        assert res is not None
+        records, src = res
+        assert src.endswith("metrics.csv") and len(records) == 2
+        assert records[1] == {"step": 2, "loss": 1.5}
+
+        blob = await store.zip_prefix(prefix)
+        import io, zipfile
+        names = zipfile.ZipFile(io.BytesIO(blob)).namelist()
+        assert "adapter.ckpt" in names and "metrics.csv" in names
+
+        # promotion copy (reference S3Handler.py:375-439)
+        dst = "obj://deploy/models/tinyllama/j"
+        n = await store.copy_prefix(prefix, dst)
+        assert n == 3
+        assert await store.get_bytes(f"{dst}/adapter.ckpt") == b"ww"
+
+        assert await store.delete_prefix(prefix) == 3
+        assert await store.list_prefix(prefix) == []
+
+    run(go())
+
+
+def test_object_store_rejects_path_escape(tmp_path):
+    store = LocalObjectStore(tmp_path / "obj")
+
+    async def go():
+        # sibling directory sharing the bucket-name prefix must not be reachable
+        await store.put_bytes("obj://data-private/secret.txt", b"s3cr3t")
+        with pytest.raises(ValueError):
+            store.path_for("obj://data/../data-private/secret.txt")
+        with pytest.raises(ValueError):
+            store.path_for("obj://data/../../etc/passwd")
+
+    run(go())
+
+
+def test_statestore_log_compaction(tmp_path):
+    store = StateStore(tmp_path / "state")
+
+    async def go():
+        await store.connect()
+        await store.create_job(_job(job_id="j0"))
+        # enough updates to cross the compaction threshold
+        for i in range(1100):
+            await store.update_job_fields("j0", queue_position=i)
+        job = await store.get_job("j0")
+        assert job.queue_position == 1099
+        # log compacted: far fewer lines than writes
+        lines = (tmp_path / "state" / "jobs.jsonl").read_text().splitlines()
+        assert len(lines) < 600
+        # reload still correct
+        store2 = StateStore(tmp_path / "state")
+        await store2.connect()
+        assert (await store2.get_job("j0")).queue_position == 1099
+
+    run(go())
+
+
+def test_presigner():
+    p = Presigner("secret", expiry_s=100)
+    tok = p.sign("obj://b/k", now=1000.0)
+    assert p.verify("obj://b/k", tok, now=1050.0)
+    assert not p.verify("obj://b/k", tok, now=1200.0)  # expired
+    assert not p.verify("obj://b/other", tok, now=1050.0)  # wrong uri
+    assert not p.verify("obj://b/k", "garbage", now=1050.0)
