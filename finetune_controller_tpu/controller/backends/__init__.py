@@ -1,0 +1,18 @@
+"""Training backends: where jobs actually run.
+
+The reference delegates execution to a Kubernetes cluster (Kubeflow
+``PyTorchJob`` + Kueue — SURVEY.md §2 components 6/10/11/24). Here the seam is
+an explicit interface (:class:`~finetune_controller_tpu.controller.backends.base.TrainingBackend`)
+with two implementations:
+
+- :mod:`.local` — in-process fake cluster running the in-repo JAX trainer as
+  subprocesses, with gang-scheduled admission. Carries the CI/integration
+  story the reference never had (SURVEY.md §4).
+- :mod:`.k8s` — renders TPU JobSet manifests for a real cluster (SURVEY.md §7
+  step 4).
+"""
+
+from .base import BackendError, TrainingBackend
+from .scheduler import GangScheduler, Workload
+
+__all__ = ["BackendError", "TrainingBackend", "GangScheduler", "Workload"]

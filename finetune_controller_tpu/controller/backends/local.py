@@ -1,0 +1,441 @@
+"""Local process backend — the in-repo fake cluster.
+
+Runs each job as a ``python -m finetune_controller_tpu.train.cli`` subprocess
+in a sandbox directory, reproducing the full pod lifecycle the reference gets
+from Kubernetes (SURVEY.md §3.1 post-admission flow):
+
+- **init container** (``aws s3 cp`` dataset download,
+  ``PyTorchJobDeployer.py:70-91``) → async dataset staging from the object
+  store into the sandbox before launch;
+- **suspend-until-admitted** (Kueue, ``PyTorchJobDeployer.py:179-185``) → the
+  in-repo :class:`~.scheduler.GangScheduler`;
+- **artifact sidecar** (``aws s3 sync`` loop every 60 s, exit on ``done.txt``,
+  ``PyTorchJobDeployer.py:121-168``) → an asyncio sync task copying
+  ``store_asset_patterns`` matches to the object store;
+- **restartPolicy OnFailure + backoffLimit 2** (``PyTorchJobDeployer.py:183,189``)
+  → bounded restart loop with a ``Restarting`` state;
+- **pod logs** (``stream_logger.py:204-284``) → a log file per job, tailed by
+  :meth:`read_logs`;
+- **pod events** (``kube_helpers.py:26-95``) → per-job event list.
+
+It also carries what the reference lacks: deterministic fault injection for
+elastic-recovery tests (SURVEY.md §5.3 gap).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import shlex
+import sys
+import time
+from pathlib import Path
+from typing import Any, AsyncIterator
+
+from ..devices import DeviceCatalog, DeviceFlavor, default_mesh_for
+from ..objectstore import ObjectStore
+from ..schemas import BackendJobReport, BackendJobState, JobInput
+from ..specs import BaseFineTuneJob
+from .base import BackendError, TrainingBackend
+from .scheduler import GangScheduler
+
+logger = logging.getLogger(__name__)
+
+
+class _JobHandle:
+    """Mutable per-job state (the backend's 'pod')."""
+
+    def __init__(self, job_id: str, sandbox: Path, artifacts_uri: str, patterns: list[str]):
+        self.job_id = job_id
+        self.sandbox = sandbox
+        self.artifacts_dir = sandbox / "artifacts"
+        self.logs_path = sandbox / "logs.txt"
+        self.spec_path = sandbox / "job.json"
+        self.artifacts_uri = artifacts_uri
+        self.patterns = patterns
+        self.state = BackendJobState.PENDING
+        self.message = ""
+        self.proc: asyncio.subprocess.Process | None = None
+        self.run_task: asyncio.Task | None = None
+        self.sync_task: asyncio.Task | None = None
+        self.restarts = 0
+        self.start_time: float | None = None
+        self.completion_time: float | None = None
+        self.events: list[dict[str, Any]] = []
+        self.env: dict[str, str] = {}
+        self.fault_kill_at_step: int | None = None
+        self.cancelled = False
+        #: path -> (mtime, size) at last successful upload (sync change detection)
+        self.synced: dict[str, tuple[float, int]] = {}
+
+    def event(self, reason: str, message: str = "") -> None:
+        self.events.append({"ts": time.time(), "reason": reason, "message": message})
+
+    def set_state(self, state: BackendJobState, message: str = "") -> None:
+        if state is not self.state:
+            self.event("StateChange", f"{self.state.value} -> {state.value}")
+        self.state = state
+        if message:
+            self.message = message
+
+
+class LocalProcessBackend(TrainingBackend):
+    """Fake cluster: gang-scheduled subprocesses + artifact sync sidecars."""
+
+    def __init__(
+        self,
+        root_dir: Path | str,
+        object_store: ObjectStore,
+        catalog: DeviceCatalog,
+        *,
+        sync_interval_s: float = 60.0,
+        backoff_limit: int = 2,
+        python: str | None = None,
+        extra_env: dict[str, str] | None = None,
+    ):
+        self.root = Path(root_dir).expanduser()
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.store = object_store
+        self.catalog = catalog
+        self.scheduler = GangScheduler(catalog)
+        self.sync_interval_s = sync_interval_s
+        self.backoff_limit = backoff_limit
+        self.python = python or sys.executable
+        self.extra_env = dict(extra_env or {})
+        self._handles: dict[str, _JobHandle] = {}
+        self._closing = False
+
+    # ------------------------------------------------------------------ submit
+
+    async def submit(
+        self,
+        job: JobInput,
+        spec: BaseFineTuneJob,
+        flavor: DeviceFlavor,
+        *,
+        dataset_uri: str | None,
+        artifacts_uri: str,
+    ) -> None:
+        if job.job_id in self._handles:
+            raise BackendError(f"job {job.job_id!r} already exists")
+        sandbox = self.root / job.job_id
+        handle = _JobHandle(job.job_id, sandbox, artifacts_uri, list(spec.store_asset_patterns))
+        self._handles[job.job_id] = handle
+        try:
+            handle.artifacts_dir.mkdir(parents=True, exist_ok=True)
+
+            # init-container equivalent: stage the dataset into the sandbox
+            # (reference: aws s3 cp init container, PyTorchJobDeployer.py:70-91)
+            dataset_path: str | None = None
+            if dataset_uri:
+                data = await self.store.get_bytes(dataset_uri)
+                local = sandbox / "dataset" / Path(dataset_uri).name
+                local.parent.mkdir(parents=True, exist_ok=True)
+                await asyncio.to_thread(local.write_bytes, data)
+                dataset_path = str(local)
+                handle.event("DatasetStaged", dataset_uri)
+
+            mesh = default_mesh_for(flavor, job.num_slices)
+            trainer_spec = spec.build_trainer_spec(
+                job.job_id,
+                str(handle.artifacts_dir),
+                dataset_path=dataset_path,
+                mesh=mesh,
+            )
+            handle.spec_path.write_text(json.dumps(trainer_spec, indent=2))
+
+            # runtime env: CPU flavors get a virtual device mesh the size of
+            # the slice (the TPU-less test story, SURVEY.md §4)
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            # the subprocess runs with the sandbox as cwd — make our package
+            # importable regardless of install state
+            pkg_root = str(Path(__file__).resolve().parents[3])
+            env["PYTHONPATH"] = (
+                pkg_root + os.pathsep + env["PYTHONPATH"]
+                if env.get("PYTHONPATH") else pkg_root
+            )
+            if flavor.runtime == "cpu":
+                env["JAX_PLATFORMS"] = "cpu"
+                n = flavor.total_chips * max(1, job.num_slices)
+                flags = env.get("XLA_FLAGS", "")
+                flags = " ".join(
+                    p for p in flags.split() if "host_platform_device_count" not in p
+                )
+                env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+            handle.env = env
+
+            self.scheduler.submit(job.job_id, flavor.name, job.num_slices)
+            handle.set_state(BackendJobState.SUSPENDED)
+            handle.event("Queued", f"flavor={flavor.name} slices={job.num_slices}")
+        except BackendError:
+            raise
+        except Exception as exc:
+            self.scheduler.release(job.job_id)
+            self._handles.pop(job.job_id, None)
+            raise BackendError(f"submit failed: {exc}") from exc
+        self._admit_pending()
+
+    def _admit_pending(self) -> None:
+        if self._closing:
+            return
+        for w in self.scheduler.try_admit():
+            handle = self._handles.get(w.job_id)
+            if handle is None:
+                self.scheduler.release(w.job_id)
+                continue
+            handle.set_state(BackendJobState.CREATED)
+            handle.event("Admitted", f"queue={w.queue}")
+            handle.run_task = asyncio.get_running_loop().create_task(self._run(handle))
+
+    # --------------------------------------------------------------- run loop
+
+    async def _run(self, handle: _JobHandle) -> None:
+        """Pod main loop: launch, restart on failure up to backoffLimit."""
+        try:
+            attempt = 0
+            while True:
+                rc = await self._run_once(handle, attempt)
+                if handle.cancelled:
+                    return
+                if rc == 0:
+                    handle.completion_time = time.time()
+                    handle.set_state(BackendJobState.SUCCEEDED)
+                    handle.event("Succeeded")
+                    break
+                attempt += 1
+                handle.restarts = attempt
+                if attempt > self.backoff_limit:
+                    handle.completion_time = time.time()
+                    handle.set_state(
+                        BackendJobState.FAILED, f"exit code {rc} after {attempt} attempts"
+                    )
+                    handle.event("Failed", handle.message)
+                    break
+                handle.set_state(BackendJobState.RESTARTING, f"exit code {rc}; retrying")
+                handle.event("Restarting", f"attempt {attempt}/{self.backoff_limit}")
+            await self._final_sync(handle)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # backend bug — surface as job failure
+            logger.exception("job %s runner crashed", handle.job_id)
+            handle.completion_time = handle.completion_time or time.time()
+            handle.set_state(BackendJobState.FAILED, f"backend error: {exc}")
+        finally:
+            self.scheduler.release(handle.job_id)
+            self._admit_pending()
+
+    async def _run_once(self, handle: _JobHandle, attempt: int) -> int:
+        cmd = [
+            self.python, "-m", "finetune_controller_tpu.train.cli",
+            "--spec", str(handle.spec_path),
+        ]
+        handle.event("Started", f"attempt {attempt}: {shlex.join(cmd)}")
+        log_f = open(handle.logs_path, "ab")
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                *cmd,
+                stdout=log_f,
+                stderr=asyncio.subprocess.STDOUT,
+                env=handle.env,
+                cwd=str(handle.sandbox),
+            )
+        except Exception:
+            log_f.close()
+            raise
+        handle.proc = proc
+        if handle.start_time is None:
+            handle.start_time = time.time()
+        handle.set_state(BackendJobState.RUNNING)
+        if handle.sync_task is None or handle.sync_task.done():
+            handle.sync_task = asyncio.get_running_loop().create_task(
+                self._sync_loop(handle)
+            )
+        try:
+            rc = await proc.wait()
+        finally:
+            handle.proc = None
+            log_f.close()
+        return rc
+
+    # ------------------------------------------------------- artifact sidecar
+
+    def _matched_files(self, handle: _JobHandle) -> list[Path]:
+        out: set[Path] = set()
+        for pattern in handle.patterns:
+            out.update(p for p in handle.artifacts_dir.glob(pattern) if p.is_file())
+        return sorted(out)
+
+    async def _sync_dir(self, handle: _JobHandle) -> int:
+        """Upload changed files only ((mtime, size) change detection — the
+        behavior ``aws s3 sync`` gave the reference for free)."""
+        n = 0
+        for path in self._matched_files(handle):
+            rel = path.relative_to(handle.artifacts_dir).as_posix()
+            st = path.stat()
+            stamp = (st.st_mtime, st.st_size)
+            if handle.synced.get(rel) == stamp:
+                continue
+            await self.store.put_file(f"{handle.artifacts_uri}/{rel}", path)
+            handle.synced[rel] = stamp
+            n += 1
+        return n
+
+    async def _sync_loop(self, handle: _JobHandle) -> None:
+        """Sidecar: sync every interval until done.txt appears
+        (``PyTorchJobDeployer.py:134-138``); the final sync runs in
+        :meth:`_final_sync`."""
+        try:
+            while not (handle.artifacts_dir / "done.txt").exists():
+                await asyncio.sleep(self.sync_interval_s)
+                if handle.state in BackendJobState.stopped_states():
+                    return
+                with contextlib.suppress(Exception):
+                    await self._sync_dir(handle)
+        except asyncio.CancelledError:
+            pass
+
+    async def _final_sync(self, handle: _JobHandle) -> None:
+        if handle.sync_task is not None:
+            handle.sync_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await handle.sync_task
+            handle.sync_task = None
+        try:
+            n = await self._sync_dir(handle)
+            handle.event("ArtifactsSynced", f"{n} files -> {handle.artifacts_uri}")
+        except Exception as exc:
+            # losing the final sync silently would let the monitor delete the
+            # sandbox believing artifacts are safe — record it loudly instead
+            logger.exception("job %s final artifact sync failed", handle.job_id)
+            handle.event("ArtifactSyncFailed", str(exc))
+            handle.message = (handle.message + f"; artifact sync failed: {exc}").lstrip("; ")
+
+    # ----------------------------------------------------------- introspection
+
+    def _report(self, handle: _JobHandle) -> BackendJobReport:
+        return BackendJobReport(
+            job_id=handle.job_id,
+            state=handle.state,
+            start_time=handle.start_time,
+            completion_time=handle.completion_time,
+            message=handle.message,
+            metadata={"restarts": handle.restarts},
+        )
+
+    async def list_jobs(self) -> list[BackendJobReport]:
+        return [self._report(h) for h in self._handles.values()]
+
+    async def get_job(self, job_id: str) -> BackendJobReport | None:
+        h = self._handles.get(job_id)
+        return self._report(h) if h else None
+
+    async def queue_snapshot(self) -> list[str]:
+        return self.scheduler.pending()
+
+    async def job_events(self, job_id: str) -> list[dict[str, Any]]:
+        h = self._handles.get(job_id)
+        return list(h.events) if h else []
+
+    # ---------------------------------------------------------------- control
+
+    async def delete_job(self, job_id: str) -> bool:
+        """Kill + forget (cluster-delete equivalent; DB record survives)."""
+        handle = self._handles.pop(job_id, None)
+        if handle is None:
+            return False
+        handle.cancelled = True
+        if handle.proc is not None:
+            with contextlib.suppress(ProcessLookupError):
+                handle.proc.terminate()
+        for task in (handle.run_task, handle.sync_task):
+            if task is not None and not task.done():
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await task
+        self.scheduler.release(job_id)
+        self._admit_pending()
+        return True
+
+    async def inject_fault(self, job_id: str, *, signum: int = 15) -> bool:
+        """Fault injection (SURVEY.md §5.3 gap): kill the running process;
+        the restart loop then exercises the elastic/backoff path."""
+        handle = self._handles.get(job_id)
+        if handle is None or handle.proc is None:
+            return False
+        handle.event("FaultInjected", f"signal {signum}")
+        with contextlib.suppress(ProcessLookupError):
+            handle.proc.send_signal(signum)
+        return True
+
+    # ------------------------------------------------------------------- logs
+
+    async def read_logs(
+        self,
+        job_id: str,
+        *,
+        follow: bool = False,
+        last_lines: int | None = None,
+    ) -> AsyncIterator[str]:
+        handle = self._handles.get(job_id)
+        if handle is None:
+            raise BackendError(f"unknown job {job_id!r}")
+
+        path = handle.logs_path
+
+        async def aiter() -> AsyncIterator[str]:
+            # wait for the log file to exist (pod may still be pending);
+            # historical reads return empty immediately rather than blocking
+            # on a job that has not started
+            while not path.exists():
+                h = self._handles.get(job_id)
+                if not follow:
+                    return
+                if h is None or h.state in BackendJobState.stopped_states():
+                    return
+                await asyncio.sleep(0.1)
+            f = await asyncio.to_thread(open, path, "r", errors="replace")
+            try:
+                if last_lines is not None:
+                    lines = await asyncio.to_thread(f.readlines)
+                    for line in lines[-last_lines:]:
+                        yield line.rstrip("\n")
+                    if not follow:
+                        return
+                else:
+                    while True:
+                        line = await asyncio.to_thread(f.readline)
+                        if not line:
+                            break
+                        yield line.rstrip("\n")
+                if not follow:
+                    return
+                # live tail with pod-liveness probe on empty reads
+                # (reference: stream_logger.py:286-341)
+                while True:
+                    line = await asyncio.to_thread(f.readline)
+                    if line:
+                        yield line.rstrip("\n")
+                        continue
+                    h = self._handles.get(job_id)
+                    if h is None or (
+                        h.state in BackendJobState.stopped_states() and h.proc is None
+                    ):
+                        # drain anything written between readline and the check
+                        tail = await asyncio.to_thread(f.read)
+                        for extra in tail.splitlines():
+                            yield extra
+                        return
+                    await asyncio.sleep(0.2)
+            finally:
+                await asyncio.to_thread(f.close)
+
+        return aiter()
+
+    async def close(self) -> None:
+        self._closing = True
+        for job_id in list(self._handles):
+            await self.delete_job(job_id)

@@ -1,0 +1,195 @@
+"""TPU device catalog — flavors, topologies, quotas, and the submission-form enum.
+
+Capability parity with the reference's worker/device configuration
+(``app/core/device_config.py:16-109`` + ``example.config.json`` — SURVEY.md §2
+component 12), redesigned for TPU pod-slice granularity:
+
+- the reference's flat GPU count (``accelerators: {"nvidia.com/gpu": n}``,
+  ``example.config.json:20-23``) becomes a **slice flavor**: chip generation,
+  topology (e.g. ``4x4``), hosts × chips/host — because TPUs are provisioned as
+  whole slices, not per-chip (SURVEY.md §7 "hard parts": slice topology ↔
+  scheduler quota);
+- each flavor carries its scheduler queue name + nominal chip quota (the
+  Kueue ClusterQueue / ResourceFlavor data, ``crds/kueue/cluster-queue.yaml:13-22``)
+  so the in-repo gang scheduler can enforce admission the way Kueue does;
+- JSON config files may contain ``//`` comments, as the reference allows
+  (``device_config.py:81-85``);
+- a missing config file degrades to the built-in default catalog with a log
+  line, mirroring the reference's empty-catalog fallback (``device_config.py:96-101``).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import logging
+import re
+from pathlib import Path
+
+from pydantic import BaseModel, Field
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceFlavor(BaseModel):
+    """One schedulable slice shape (reference: ``Worker``, ``device_config.py:16-44``)."""
+
+    name: str  # e.g. "v5e-16"
+    description: str = ""
+    generation: str = "v5e"  # v4 | v5e | v5p | v6e | cpu
+    topology: str = ""  # e.g. "4x4" (empty for cpu flavors)
+    hosts: int = 1
+    chips_per_host: int = 4
+    #: scheduler LocalQueue this flavor feeds (reference: ``LocalQueue``,
+    #: ``example.config.json:18``)
+    queue: str = "default-queue"
+    #: host-side pod resources (reference: default resources, ``example.config.json:8-14``)
+    cpu: str = "8"
+    memory: str = "32Gi"
+    #: node-selector labels for K8s backends (replaces GPU tolerations,
+    #: reference ``example.config.json:24-31``)
+    node_selectors: dict[str, str] = Field(default_factory=dict)
+    #: "tpu" runs on real chips; "cpu" runs on a virtual CPU mesh (the
+    #: CI/smoke runtime the reference never had — SURVEY.md §4)
+    runtime: str = "tpu"
+
+    @property
+    def total_chips(self) -> int:
+        return self.hosts * self.chips_per_host
+
+    def k8s_resource_name(self) -> str:
+        """The extended-resource key requested on pods (replaces
+        ``nvidia.com/gpu``, reference ``PyTorchJobDeployer.py:45-55``)."""
+        return "cpu" if self.runtime == "cpu" else "google.com/tpu"
+
+    def accelerator_selectors(self) -> dict[str, str]:
+        """TPU slice node selectors (SURVEY.md §2.2: topology selectors
+        replace the reference's free GPU count)."""
+        if self.runtime == "cpu":
+            return {}
+        sel = {
+            "cloud.google.com/gke-tpu-accelerator": f"tpu-{self.generation}-slice",
+            "cloud.google.com/gke-tpu-topology": self.topology,
+        }
+        sel.update(self.node_selectors)
+        return sel
+
+
+class FlavorQuota(BaseModel):
+    """Nominal chip quota for one flavor in the cluster queue (reference:
+    ``nominalQuota``, ``crds/kueue/cluster-queue.yaml:18-22``)."""
+
+    flavor: str
+    nominal_chips: int
+
+
+class DeviceCatalog(BaseModel):
+    """The full worker catalog (reference: ``APIConfiguration``,
+    ``device_config.py:46-75``)."""
+
+    flavors: list[DeviceFlavor] = Field(default_factory=list)
+    quotas: list[FlavorQuota] = Field(default_factory=list)
+    default_flavor: str = ""
+
+    def get(self, name: str) -> DeviceFlavor | None:
+        for f in self.flavors:
+            if f.name == name:
+                return f
+        return None
+
+    def get_worker(self, name: str) -> DeviceFlavor:
+        """Resolve a flavor, falling back to the default (reference:
+        ``device_configuration.get_worker`` + default-queue fallback,
+        ``device_config.py:59-75``)."""
+        f = self.get(name)
+        if f is not None:
+            return f
+        if self.default_flavor:
+            fallback = self.get(self.default_flavor)
+            if fallback is not None:
+                logger.warning("unknown device %r; using default %r", name, fallback.name)
+                return fallback
+        raise KeyError(f"unknown device flavor {name!r} and no default configured")
+
+    def quota_for(self, flavor: str) -> int:
+        for q in self.quotas:
+            if q.flavor == flavor:
+                return q.nominal_chips
+        f = self.get(flavor)
+        return f.total_chips if f else 0
+
+    def names(self) -> list[str]:
+        return [f.name for f in self.flavors]
+
+    def device_enum(self) -> type[enum.Enum]:
+        """Dynamic enum for the submission form (reference: ``DeviceTypes``,
+        ``device_config.py:107-109``)."""
+        return enum.Enum("DeviceTypes", {f.name: f.name for f in self.flavors})
+
+
+def default_catalog() -> DeviceCatalog:
+    """Built-in catalog covering the BASELINE.md configs plus the CPU smoke flavor."""
+    return DeviceCatalog(
+        flavors=[
+            DeviceFlavor(
+                name="cpu-test", description="virtual CPU mesh for CI/smoke",
+                generation="cpu", topology="", hosts=1, chips_per_host=1,
+                queue="cpu-queue", cpu="2", memory="4Gi", runtime="cpu",
+            ),
+            DeviceFlavor(
+                name="v5e-4", description="single-host v5e slice",
+                generation="v5e", topology="2x2", hosts=1, chips_per_host=4,
+                queue="tpu-small-queue",
+            ),
+            DeviceFlavor(
+                name="v5e-8", description="two-host v5e slice",
+                generation="v5e", topology="2x4", hosts=2, chips_per_host=4,
+                queue="tpu-small-queue",
+            ),
+            DeviceFlavor(
+                name="v5e-16", description="four-host v5e slice (8B FSDP north star)",
+                generation="v5e", topology="4x4", hosts=4, chips_per_host=4,
+                queue="tpu-medium-queue", cpu="96", memory="384Gi",
+            ),
+            DeviceFlavor(
+                name="v5p-64", description="v5p-64 slice (MoE expert-parallel config)",
+                generation="v5p", topology="4x4x4", hosts=16, chips_per_host=4,
+                queue="tpu-large-queue", cpu="96", memory="448Gi",
+            ),
+        ],
+        quotas=[
+            FlavorQuota(flavor="cpu-test", nominal_chips=2),
+            FlavorQuota(flavor="v5e-4", nominal_chips=8),
+            FlavorQuota(flavor="v5e-8", nominal_chips=16),
+            FlavorQuota(flavor="v5e-16", nominal_chips=32),
+            FlavorQuota(flavor="v5p-64", nominal_chips=64),
+        ],
+        default_flavor="cpu-test",
+    )
+
+
+_COMMENT_RE = re.compile(r"^\s*//.*$", re.MULTILINE)
+
+
+def load_catalog(path: Path | str | None) -> DeviceCatalog:
+    """Load the catalog from a JSON file with ``//`` comment support
+    (reference: ``load_config``, ``device_config.py:81-104``); fall back to
+    the built-in default catalog when absent."""
+    if not path:
+        return default_catalog()
+    path = Path(path).expanduser()
+    if not path.is_file():
+        logger.warning("device config %s not found; using built-in catalog", path)
+        return default_catalog()
+    text = _COMMENT_RE.sub("", path.read_text())
+    return DeviceCatalog.model_validate(json.loads(text))
+
+
+def default_mesh_for(flavor: DeviceFlavor, num_slices: int = 1) -> dict[str, int]:
+    """Map a slice request to trainer MeshSpec axis sizes.
+
+    Policy: FSDP over all chips in a slice (the north-star strategy,
+    SURVEY.md §2.3 FSDP row), DP over slices (DCN axis). Model families that
+    need TP/EP override this in their job spec.
+    """
+    return {"dp": num_slices, "fsdp": flavor.total_chips}

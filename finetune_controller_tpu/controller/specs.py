@@ -98,7 +98,9 @@ class BaseFineTuneJob(BaseModel):
     checkpoint_mount: ClassVar[str] = "/data/artifacts"
     #: glob patterns the artifact sync ships to the object store
     #: (reference: store_asset_patterns, ``finetuning.py:94-97``)
-    store_asset_patterns: ClassVar[list[str]] = ["*.csv", "*.json", "checkpoints/**", "done.txt"]
+    store_asset_patterns: ClassVar[list[str]] = [
+        "*.csv", "*.json", "checkpoints/**/*", "done.txt",
+    ]
     #: deploy-bucket prefix used on promotion (reference: ``finetuning.py:75-78``)
     promotion_path: ClassVar[str] = "models"
 

@@ -1,0 +1,275 @@
+"""Tests for the execution layer: device catalog, gang scheduler, local backend.
+
+Covers the capability surface of the reference's device config + Kueue
+integration + PyTorchJob deployer + pod lifecycle (SURVEY.md §2 components
+6/11/12/24) against the in-repo fake cluster — the hermetic cluster test seam
+the reference never had (SURVEY.md §4).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from finetune_controller_tpu.controller.backends.local import LocalProcessBackend
+from finetune_controller_tpu.controller.backends.scheduler import GangScheduler
+from finetune_controller_tpu.controller.devices import (
+    DeviceCatalog,
+    DeviceFlavor,
+    FlavorQuota,
+    default_catalog,
+    default_mesh_for,
+    load_catalog,
+)
+from finetune_controller_tpu.controller.examples import LoRASFTArguments, TinyTestLoRA
+from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+from finetune_controller_tpu.controller.schemas import BackendJobState, JobInput
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# Device catalog
+# ---------------------------------------------------------------------------
+
+
+def test_default_catalog_flavors_and_quota():
+    cat = default_catalog()
+    assert "v5e-16" in cat.names() and "cpu-test" in cat.names()
+    v5e16 = cat.get("v5e-16")
+    assert v5e16.total_chips == 16
+    assert v5e16.k8s_resource_name() == "google.com/tpu"
+    sel = v5e16.accelerator_selectors()
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+    assert cat.quota_for("v5e-16") == 32
+    # fallback to default flavor for unknown names (reference device_config.py:59-67)
+    assert cat.get_worker("nope").name == "cpu-test"
+
+
+def test_catalog_json_with_comments(tmp_path):
+    p = tmp_path / "devices.json"
+    p.write_text(
+        """
+{
+  // a comment, as the reference allows (device_config.py:81-85)
+  "flavors": [
+    {"name": "v6e-8", "generation": "v6e", "topology": "2x4",
+     "hosts": 2, "chips_per_host": 4, "queue": "q6"}
+  ],
+  "quotas": [{"flavor": "v6e-8", "nominal_chips": 8}],
+  "default_flavor": "v6e-8"
+}
+"""
+    )
+    cat = load_catalog(p)
+    assert cat.get("v6e-8").total_chips == 8
+    assert cat.quota_for("v6e-8") == 8
+    enum_cls = cat.device_enum()
+    assert enum_cls["v6e-8"].value == "v6e-8"
+
+
+def test_missing_catalog_falls_back_to_default(tmp_path):
+    cat = load_catalog(tmp_path / "absent.json")
+    assert "cpu-test" in cat.names()
+
+
+def test_default_mesh_covers_all_chips():
+    cat = default_catalog()
+    mesh = default_mesh_for(cat.get("v5e-16"), num_slices=2)
+    assert mesh == {"dp": 2, "fsdp": 16}
+
+
+# ---------------------------------------------------------------------------
+# Gang scheduler
+# ---------------------------------------------------------------------------
+
+
+def _small_catalog(quota=2):
+    return DeviceCatalog(
+        flavors=[
+            DeviceFlavor(name="chip-1", generation="cpu", hosts=1, chips_per_host=1,
+                         runtime="cpu", queue="q"),
+        ],
+        quotas=[FlavorQuota(flavor="chip-1", nominal_chips=quota)],
+        default_flavor="chip-1",
+    )
+
+
+def test_scheduler_fifo_admission_and_positions():
+    sched = GangScheduler(_small_catalog(quota=2))
+    sched.submit("a", "chip-1")
+    sched.submit("b", "chip-1")
+    sched.submit("c", "chip-1")
+    admitted = [w.job_id for w in sched.try_admit()]
+    assert admitted == ["a", "b"]  # quota = 2 chips, 1 chip each
+    assert sched.pending() == ["c"]
+    assert sched.position("c") == 1
+    assert sched.position("a") is None
+    sched.release("a")
+    assert [w.job_id for w in sched.try_admit()] == ["c"]
+    assert sched.pending() == []
+
+
+def test_scheduler_gang_all_or_nothing():
+    sched = GangScheduler(_small_catalog(quota=2))
+    sched.submit("big", "chip-1", num_slices=3)  # needs 3 > quota 2: never admits
+    assert sched.try_admit() == []
+    assert sched.position("big") == 1
+    # best-effort FIFO: a small job behind the blocked one still admits
+    sched.submit("small", "chip-1")
+    assert [w.job_id for w in sched.try_admit()] == ["small"]
+    usage = sched.usage()["chip-1"]
+    assert usage["used_chips"] == 1 and usage["pending"] == 1
+
+
+def test_scheduler_duplicate_rejected():
+    sched = GangScheduler(_small_catalog())
+    sched.submit("a", "chip-1")
+    with pytest.raises(ValueError):
+        sched.submit("a", "chip-1")
+
+
+# ---------------------------------------------------------------------------
+# Local backend (full pod lifecycle with a real trainer subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _job_spec():
+    return TinyTestLoRA(
+        training_arguments=LoRASFTArguments(
+            total_steps=3, warmup_steps=1, batch_size=2, seq_len=16, lora_rank=2
+        )
+    )
+
+
+def _backend(tmp_path, quota=2):
+    store = LocalObjectStore(tmp_path / "objects")
+    backend = LocalProcessBackend(
+        tmp_path / "sandbox", store, _small_catalog(quota=quota),
+        sync_interval_s=0.2,
+    )
+    return backend, store
+
+
+async def _wait_state(backend, job_id, states, timeout=120.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        report = await backend.get_job(job_id)
+        if report is not None and report.state in states:
+            return report
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"timeout waiting for {states}; last={report}")
+        await asyncio.sleep(0.2)
+
+
+def test_local_backend_end_to_end(tmp_path):
+    async def main():
+        backend, store = _backend(tmp_path)
+        job = JobInput(job_id="t-1", user_id="u", model_name="tiny-test-lora",
+                       device="chip-1", arguments={})
+        await backend.submit(
+            job, _job_spec(), backend.catalog.get("chip-1"),
+            dataset_uri=None, artifacts_uri="obj://artifacts/u/t-1",
+        )
+        report = await _wait_state(
+            backend, "t-1", {BackendJobState.SUCCEEDED, BackendJobState.FAILED}
+        )
+        logs = []
+        it = await backend.read_logs("t-1")
+        async for line in it:
+            logs.append(line)
+        assert report.state is BackendJobState.SUCCEEDED, "\n".join(logs[-30:])
+        # artifact sidecar shipped metrics + done.txt to the object store
+        keys = {o["uri"] for o in await store.list_prefix("obj://artifacts/u/t-1")}
+        assert any("metrics" in k and k.endswith(".csv") for k in keys), keys
+        assert any(k.endswith("done.txt") for k in keys)
+        assert any("finished" in l for l in logs), logs[-10:]
+        events = await backend.job_events("t-1")
+        reasons = [e["reason"] for e in events]
+        assert "Queued" in reasons and "Admitted" in reasons and "Succeeded" in reasons
+        await backend.close()
+
+    run(main())
+
+
+def test_local_backend_queueing_and_cancel(tmp_path):
+    async def main():
+        backend, _ = _backend(tmp_path, quota=1)
+        spec = _job_spec()
+        flavor = backend.catalog.get("chip-1")
+        for jid in ("q-1", "q-2"):
+            await backend.submit(
+                JobInput(job_id=jid, user_id="u", model_name="tiny-test-lora",
+                         device="chip-1", arguments={}),
+                spec, flavor, dataset_uri=None,
+                artifacts_uri=f"obj://artifacts/u/{jid}",
+            )
+        # q-2 waits in queue while q-1 holds the only chip
+        assert await backend.queue_snapshot() == ["q-2"]
+        r2 = await backend.get_job("q-2")
+        assert r2.state is BackendJobState.SUSPENDED
+        # cancel q-1 -> q-2 admits
+        assert await backend.delete_job("q-1")
+        assert await backend.get_job("q-1") is None
+        await _wait_state(
+            backend, "q-2",
+            {BackendJobState.CREATED, BackendJobState.RUNNING,
+             BackendJobState.SUCCEEDED},
+        )
+        assert await backend.queue_snapshot() == []
+        await backend.close()
+
+    run(main())
+
+
+def test_local_backend_failure_backoff(tmp_path):
+    async def main():
+        backend, _ = _backend(tmp_path)
+        backend.backoff_limit = 1
+        spec = _job_spec()
+        # poison the spec post-render by pointing at a preset that doesn't exist
+        job = JobInput(job_id="f-1", user_id="u", model_name="tiny-test-lora",
+                       device="chip-1", arguments={})
+        await backend.submit(
+            job, spec, backend.catalog.get("chip-1"),
+            dataset_uri=None, artifacts_uri="obj://artifacts/u/f-1",
+        )
+        handle = backend._handles["f-1"]
+        bad = json.loads(handle.spec_path.read_text())
+        bad["model"]["preset"] = "no-such-preset"
+        handle.spec_path.write_text(json.dumps(bad))
+        report = await _wait_state(
+            backend, "f-1", {BackendJobState.FAILED}, timeout=120.0
+        )
+        assert report.metadata["restarts"] == 2  # 1 restart + final attempt counted
+        events = await backend.job_events("f-1")
+        assert any(e["reason"] == "Restarting" for e in events)
+        await backend.close()
+
+    run(main())
+
+
+def test_local_backend_stages_dataset(tmp_path):
+    async def main():
+        backend, store = _backend(tmp_path)
+        rows = b'{"text": "hello world hello world"}\n' * 8
+        await store.put_bytes("obj://datasets/u/d1/train.jsonl", rows)
+        job = JobInput(job_id="d-1", user_id="u", model_name="tiny-test-lora",
+                       device="chip-1", arguments={})
+        await backend.submit(
+            job, _job_spec(), backend.catalog.get("chip-1"),
+            dataset_uri="obj://datasets/u/d1/train.jsonl",
+            artifacts_uri="obj://artifacts/u/d-1",
+        )
+        spec = json.loads((backend.root / "d-1" / "job.json").read_text())
+        assert spec["dataset"]["path"].endswith("train.jsonl")
+        await _wait_state(
+            backend, "d-1", {BackendJobState.SUCCEEDED, BackendJobState.FAILED}
+        )
+        report = await backend.get_job("d-1")
+        assert report.state is BackendJobState.SUCCEEDED
+        await backend.close()
+
+    run(main())
