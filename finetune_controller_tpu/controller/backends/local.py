@@ -131,10 +131,8 @@ class LocalProcessBackend(TrainingBackend):
             # (reference: aws s3 cp init container, PyTorchJobDeployer.py:70-91)
             dataset_path: str | None = None
             if dataset_uri:
-                data = await self.store.get_bytes(dataset_uri)
                 local = sandbox / "dataset" / Path(dataset_uri).name
-                local.parent.mkdir(parents=True, exist_ok=True)
-                await asyncio.to_thread(local.write_bytes, data)
+                await self.store.get_file(dataset_uri, local)  # streamed, not buffered
                 dataset_path = str(local)
                 handle.event("DatasetStaged", dataset_uri)
 
@@ -197,27 +195,31 @@ class LocalProcessBackend(TrainingBackend):
         """Pod main loop: launch, restart on failure up to backoffLimit."""
         try:
             attempt = 0
+            outcome = BackendJobState.FAILED
+            message = ""
             while True:
                 rc = await self._run_once(handle, attempt)
                 if handle.cancelled:
                     return
                 if rc == 0:
-                    handle.completion_time = time.time()
-                    handle.set_state(BackendJobState.SUCCEEDED)
-                    handle.event("Succeeded")
+                    outcome = BackendJobState.SUCCEEDED
                     break
                 attempt += 1
                 handle.restarts = attempt
                 if attempt > self.backoff_limit:
-                    handle.completion_time = time.time()
-                    handle.set_state(
-                        BackendJobState.FAILED, f"exit code {rc} after {attempt} attempts"
-                    )
-                    handle.event("Failed", handle.message)
+                    outcome = BackendJobState.FAILED
+                    message = f"exit code {rc} after {attempt} attempts"
                     break
                 handle.set_state(BackendJobState.RESTARTING, f"exit code {rc}; retrying")
                 handle.event("Restarting", f"attempt {attempt}/{self.backoff_limit}")
+            handle.completion_time = time.time()
+            # the terminal state must only become visible AFTER the final
+            # artifact sync: the monitor deletes succeeded jobs from the
+            # substrate as soon as it sees SUCCEEDED, which would cancel an
+            # in-flight upload and lose the artifacts
             await self._final_sync(handle)
+            handle.set_state(outcome, message)
+            handle.event(outcome.value, message)
         except asyncio.CancelledError:
             raise
         except Exception as exc:  # backend bug — surface as job failure

@@ -18,6 +18,7 @@ from pydantic import Field
 from .specs import (
     BaseFineTuneJob,
     TrainingArguments,
+    TrainingDataset,
     TrainingFramework,
     TrainingTask,
 )
@@ -89,6 +90,8 @@ class TinyTestLoRA(BaseFineTuneJob):
     model_preset = "tiny-test"
     default_device = "cpu-test"
     promotion_path = "models/tiny-test"
+    # smoke spec trains on synthetic data when no dataset is provided
+    dataset = TrainingDataset(required=False, description="optional jsonl")
 
     training_arguments: LoRASFTArguments
 

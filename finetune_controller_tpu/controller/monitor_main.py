@@ -1,0 +1,47 @@
+"""Standalone monitor daemon: ``python -m finetune_controller_tpu.controller.monitor_main``.
+
+Capability parity with the reference's monitor entrypoint
+(``app/monitor_main.py:19-89`` — SURVEY.md §2 component 15): an asyncio
+service with signal handlers and clean shutdown, running the reconciler
+forever. Meaningful for cluster-shared backends (k8s); with the in-process
+local backend the monitor instead runs inside the API process
+(``Settings.monitor_in_process``, reference ``DEV_LOCAL_JOB_MONITOR``
+``app/main.py:91-99``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+
+from .logging_config import setup_logging
+from .runtime import build_runtime
+
+logger = logging.getLogger(__name__)
+
+
+async def amain() -> None:
+    runtime = build_runtime()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        # reference: shutdown handlers, monitor_main.py:19-32
+        loop.add_signal_handler(sig, stop.set)
+    await runtime.start(with_monitor=True)
+    logger.info("monitor daemon up (backend=%s)", runtime.settings.backend)
+    try:
+        await stop.wait()
+    finally:
+        await runtime.close()
+        logger.info("monitor daemon shut down")
+
+
+def main() -> int:
+    setup_logging()
+    asyncio.run(amain())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

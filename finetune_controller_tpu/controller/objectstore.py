@@ -67,6 +67,11 @@ class ObjectStore:
     async def get_bytes(self, uri: str) -> bytes:
         raise NotImplementedError
 
+    async def get_file(self, uri: str, dest: Path | str) -> int:
+        """Stream an object to a local file without buffering it whole;
+        returns bytes written."""
+        raise NotImplementedError
+
     async def exists(self, uri: str) -> bool:
         raise NotImplementedError
 
@@ -157,6 +162,17 @@ class LocalObjectStore(ObjectStore):
 
     async def get_bytes(self, uri: str) -> bytes:
         return await asyncio.to_thread(self.path_for(uri).read_bytes)
+
+    async def get_file(self, uri: str, dest: Path | str) -> int:
+        src = self.path_for(uri)
+
+        def copy() -> int:
+            dest_p = Path(dest)
+            dest_p.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copyfile(src, dest_p)
+            return dest_p.stat().st_size
+
+        return await asyncio.to_thread(copy)
 
     async def exists(self, uri: str) -> bool:
         return await asyncio.to_thread(self.path_for(uri).exists)

@@ -1,0 +1,90 @@
+"""Runtime assembly: settings → wired control-plane components.
+
+The reference wires its singletons at import time — kube config, settings
+reading a k8s Secret, S3 handler (``SURVEY.md`` §3.5), its biggest
+testability wart. Here, assembly is an explicit factory: nothing touches the
+filesystem or spawns tasks until :func:`build_runtime` is called, and every
+component can be swapped in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from .backends.base import TrainingBackend
+from .backends.local import LocalProcessBackend
+from .config import Settings, get_settings
+from .devices import DeviceCatalog, load_catalog
+from .monitor import JobMonitor
+from .objectstore import LocalObjectStore, ObjectStore, Presigner
+from .registry import load_model_modules
+from .statestore import StateStore
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Runtime:
+    """Everything a control-plane process needs (API server or monitor daemon)."""
+
+    settings: Settings
+    state: StateStore
+    store: ObjectStore
+    catalog: DeviceCatalog
+    backend: TrainingBackend
+    monitor: JobMonitor
+    presigner: Presigner
+
+    async def start(self, *, with_monitor: bool | None = None) -> None:
+        await self.state.connect()
+        run_monitor = (
+            self.settings.monitor_in_process if with_monitor is None else with_monitor
+        )
+        if run_monitor:
+            self.monitor.start()
+
+    async def close(self) -> None:
+        await self.monitor.stop()
+        await self.backend.close()
+        await self.state.close()
+
+
+def build_runtime(
+    settings: Settings | None = None,
+    *,
+    plugin_dir: str | None = None,
+) -> Runtime:
+    """Assemble a runtime from settings (reference startup flow §3.5, made lazy)."""
+    settings = settings or get_settings()
+    load_model_modules(plugin_dir)
+    state = StateStore(settings.state_path)
+    store = LocalObjectStore(settings.object_store_path)
+    catalog = load_catalog(settings.device_config_file or None)
+    backend: TrainingBackend
+    if settings.backend == "local":
+        backend = LocalProcessBackend(
+            settings.state_path / "sandboxes",
+            store,
+            catalog,
+            sync_interval_s=settings.artifact_sync_interval_s,
+        )
+    elif settings.backend == "k8s":
+        from .backends.k8s import K8sJobSetBackend
+
+        backend = K8sJobSetBackend(catalog, settings)
+    else:
+        raise ValueError(f"unknown backend {settings.backend!r}")
+    monitor = JobMonitor(
+        state, store, backend, interval_s=settings.job_monitor_interval_s
+    )
+    presigner = Presigner(settings.presign_secret, settings.presign_expiry_s)
+    return Runtime(
+        settings=settings,
+        state=state,
+        store=store,
+        catalog=catalog,
+        backend=backend,
+        monitor=monitor,
+        presigner=presigner,
+    )

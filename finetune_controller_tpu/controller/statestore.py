@@ -202,6 +202,12 @@ class StateStore:
         docs = await self.jobs.find(lambda d: d["job_id"] in wanted)
         return {d["job_id"]: JobRecord(**d) for d in docs}
 
+    async def get_active_jobs(self) -> list[JobRecord]:
+        """Every job not in a final state — the monitor's lost-job sweep input."""
+        final = {s.value for s in DatabaseStatus.final_states()}
+        docs = await self.jobs.find(lambda d: d["status"] not in final)
+        return [JobRecord(**d) for d in docs]
+
     async def update_job_status(
         self,
         job_id: str,
@@ -289,6 +295,10 @@ class StateStore:
         return PaginatedTableResponse(
             total=total, page=page, page_size=page_size, items=items
         )
+
+    async def purge_job(self, job_id: str) -> bool:
+        """Hard-delete without archiving — submission rollback only."""
+        return (await self.jobs.delete(job_id)) is not None
 
     async def delete_job(self, job_id: str) -> bool:
         """Archive-on-delete (reference: ``db.py:519-526``)."""

@@ -19,6 +19,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import asyncio  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -27,3 +29,42 @@ def devices8():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs[:8]
+
+
+def run_async(coro):
+    """Run a coroutine on a fresh, properly closed event loop."""
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def one_chip_catalog(quota: int = 2):
+    """Single 1-chip CPU flavor catalog for backend/scheduler tests."""
+    from finetune_controller_tpu.controller.devices import (
+        DeviceCatalog,
+        DeviceFlavor,
+        FlavorQuota,
+    )
+
+    return DeviceCatalog(
+        flavors=[DeviceFlavor(name="chip-1", generation="cpu", hosts=1,
+                              chips_per_host=1, runtime="cpu", queue="q")],
+        quotas=[FlavorQuota(flavor="chip-1", nominal_chips=quota)],
+        default_flavor="chip-1",
+    )
+
+
+def tiny_job_spec(steps: int = 3):
+    """Milliseconds-scale TinyTestLoRA spec for lifecycle tests."""
+    from finetune_controller_tpu.controller.examples import (
+        LoRASFTArguments,
+        TinyTestLoRA,
+    )
+
+    return TinyTestLoRA(
+        training_arguments=LoRASFTArguments(
+            total_steps=steps, warmup_steps=1, batch_size=2, seq_len=16, lora_rank=2
+        )
+    )

@@ -21,13 +21,13 @@ from finetune_controller_tpu.controller.devices import (
     default_mesh_for,
     load_catalog,
 )
-from finetune_controller_tpu.controller.examples import LoRASFTArguments, TinyTestLoRA
 from finetune_controller_tpu.controller.objectstore import LocalObjectStore
 from finetune_controller_tpu.controller.schemas import BackendJobState, JobInput
 
 
-def run(coro):
-    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+from conftest import one_chip_catalog as _small_catalog
+from conftest import run_async as run
+from conftest import tiny_job_spec as _job_spec
 
 
 # ---------------------------------------------------------------------------
@@ -86,17 +86,6 @@ def test_default_mesh_covers_all_chips():
 # ---------------------------------------------------------------------------
 
 
-def _small_catalog(quota=2):
-    return DeviceCatalog(
-        flavors=[
-            DeviceFlavor(name="chip-1", generation="cpu", hosts=1, chips_per_host=1,
-                         runtime="cpu", queue="q"),
-        ],
-        quotas=[FlavorQuota(flavor="chip-1", nominal_chips=quota)],
-        default_flavor="chip-1",
-    )
-
-
 def test_scheduler_fifo_admission_and_positions():
     sched = GangScheduler(_small_catalog(quota=2))
     sched.submit("a", "chip-1")
@@ -134,14 +123,6 @@ def test_scheduler_duplicate_rejected():
 # ---------------------------------------------------------------------------
 # Local backend (full pod lifecycle with a real trainer subprocess)
 # ---------------------------------------------------------------------------
-
-
-def _job_spec():
-    return TinyTestLoRA(
-        training_arguments=LoRASFTArguments(
-            total_steps=3, warmup_steps=1, batch_size=2, seq_len=16, lora_rank=2
-        )
-    )
 
 
 def _backend(tmp_path, quota=2):

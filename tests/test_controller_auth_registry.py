@@ -33,8 +33,7 @@ from finetune_controller_tpu.controller.specs import (
 )
 
 
-def run(coro):
-    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+from conftest import run_async as run
 
 
 # ---------------------------------------------------------------------------

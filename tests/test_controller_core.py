@@ -31,8 +31,7 @@ from finetune_controller_tpu.controller.schemas import (
 from finetune_controller_tpu.controller.statestore import StateStore, generate_short_uuid
 
 
-def run(coro):
-    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+from conftest import run_async as run
 
 
 # ---------------------------------------------------------------------------

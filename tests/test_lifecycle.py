@@ -1,0 +1,346 @@
+"""Tests for task builder + monitor reconciliation + the full job lifecycle.
+
+Covers the reference's submit path (``app/jobs/task_builder.py``, SURVEY.md
+§3.1), the monitor loop (``app/core/monitor.py``, §3.2), and the end-to-end
+lifecycle (submit → queue → train → metrics → succeeded → substrate cleanup)
+that the reference could only exercise against a live cluster (SURVEY.md §4).
+"""
+
+import asyncio
+
+import pytest
+
+from finetune_controller_tpu.controller.backends.base import TrainingBackend
+from finetune_controller_tpu.controller.backends.local import LocalProcessBackend
+from finetune_controller_tpu.controller.datasets import (
+    filename_from_content_disposition,
+    upload_dataset_bytes,
+)
+from finetune_controller_tpu.controller.monitor import JobMonitor
+from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+from finetune_controller_tpu.controller.schemas import (
+    BackendJobReport,
+    BackendJobState,
+    DatabaseStatus,
+    JobInput,
+)
+from finetune_controller_tpu.controller.statestore import StateStore
+from finetune_controller_tpu.controller.task_builder import (
+    DatasetInput,
+    TaskBuildError,
+    task_builder,
+)
+
+
+from conftest import one_chip_catalog as _catalog
+from conftest import run_async as run
+from conftest import tiny_job_spec as _spec
+
+
+# ---------------------------------------------------------------------------
+# Scripted fake backend for monitor unit tests
+# ---------------------------------------------------------------------------
+
+
+class ScriptedBackend(TrainingBackend):
+    """Backend whose reports are set directly by the test."""
+
+    def __init__(self):
+        self.reports: dict[str, BackendJobReport] = {}
+        self.pending: list[str] = []
+        self.deleted: list[str] = []
+
+    async def submit(self, job, spec, flavor, *, dataset_uri, artifacts_uri):
+        self.reports[job.job_id] = BackendJobReport(
+            job_id=job.job_id, state=BackendJobState.SUSPENDED
+        )
+
+    async def list_jobs(self):
+        return list(self.reports.values())
+
+    async def get_job(self, job_id):
+        return self.reports.get(job_id)
+
+    async def delete_job(self, job_id):
+        self.deleted.append(job_id)
+        return self.reports.pop(job_id, None) is not None
+
+    async def read_logs(self, job_id, *, follow=False, last_lines=None):
+        async def aiter():
+            yield "line"
+        return aiter()
+
+    async def queue_snapshot(self):
+        return list(self.pending)
+
+
+def test_filename_from_content_disposition():
+    assert filename_from_content_disposition('attachment; filename="a b.csv"') == "a b.csv"
+    assert filename_from_content_disposition("attachment; filename*=UTF-8''x%20y.jsonl") == "x y.jsonl"
+    assert filename_from_content_disposition(None) is None
+
+
+def test_monitor_status_mapping_and_queue_positions(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        await state.connect()
+
+        job = JobInput(job_id="m-1", user_id="u", model_name="tiny-test-lora",
+                       device="chip-1", arguments={})
+        await task_builder(
+            job, _spec(), DatasetInput(),
+            state=state, store=store, backend=backend, catalog=_catalog(),
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        backend.pending = ["m-1"]
+        await monitor.tick()
+        rec = await state.get_job("m-1")
+        assert rec.status is DatabaseStatus.QUEUED
+        assert rec.queue_position == 1
+
+        # job starts running
+        backend.pending = []
+        backend.reports["m-1"] = BackendJobReport(
+            job_id="m-1", state=BackendJobState.RUNNING, start_time=100.0
+        )
+        await monitor.tick()
+        rec = await state.get_job("m-1")
+        assert rec.status is DatabaseStatus.RUNNING
+        assert rec.queue_position is None
+        assert rec.start_time == 100.0
+
+        # job succeeds -> duration computed, substrate cleaned
+        backend.reports["m-1"] = BackendJobReport(
+            job_id="m-1", state=BackendJobState.SUCCEEDED,
+            start_time=100.0, completion_time=160.0,
+        )
+        await monitor.tick()
+        rec = await state.get_job("m-1")
+        assert rec.status is DatabaseStatus.SUCCEEDED
+        assert rec.training_duration == 60.0
+        assert backend.deleted == ["m-1"]
+
+        # final jobs are skipped on later ticks (no re-update)
+        await monitor.tick()
+        assert (await state.get_job("m-1")).status is DatabaseStatus.SUCCEEDED
+
+    run(main())
+
+
+def test_monitor_failed_jobs_kept_for_forensics(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        await state.connect()
+        await task_builder(
+            JobInput(job_id="f-1", user_id="u", model_name="tiny-test-lora",
+                     device="chip-1", arguments={}),
+            _spec(), DatasetInput(),
+            state=state, store=store, backend=backend, catalog=_catalog(),
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        backend.reports["f-1"] = BackendJobReport(
+            job_id="f-1", state=BackendJobState.FAILED,
+            start_time=1.0, completion_time=2.0, message="exit code 1",
+        )
+        await monitor.tick()
+        rec = await state.get_job("f-1")
+        assert rec.status is DatabaseStatus.FAILED
+        assert rec.metadata["backend_message"] == "exit code 1"
+        assert backend.deleted == []  # failed jobs stay for inspection
+
+    run(main())
+
+
+def test_monitor_cleans_cancelled_jobs_backend_half(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        await state.connect()
+        await task_builder(
+            JobInput(job_id="c-1", user_id="u", model_name="tiny-test-lora",
+                     device="chip-1", arguments={}),
+            _spec(), DatasetInput(),
+            state=state, store=store, backend=backend, catalog=_catalog(),
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        await state.update_job_status("c-1", DatabaseStatus.CANCELLED)
+        await monitor.tick()
+        assert backend.deleted == ["c-1"]
+
+    run(main())
+
+
+def test_task_builder_dataset_branches(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        await state.connect()
+
+        # dataset by id
+        ds = await upload_dataset_bytes(
+            store, state, user_id="u", filename="train.jsonl",
+            data=b'{"text": "hi"}\n', bucket="datasets",
+        )
+        rec = await task_builder(
+            JobInput(job_id="j-id", user_id="u", model_name="tiny-test-lora",
+                     device="chip-1", arguments={}),
+            _spec(), DatasetInput(dataset_id=ds.dataset_id),
+            state=state, store=store, backend=backend, catalog=_catalog(),
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        assert rec.dataset_uri == ds.uri
+        refreshed = await state.get_dataset(ds.dataset_id)
+        assert "j-id" in refreshed.job_refs
+
+        # dataset by file
+        rec2 = await task_builder(
+            JobInput(job_id="j-file", user_id="u", model_name="tiny-test-lora",
+                     device="chip-1", arguments={}),
+            _spec(),
+            DatasetInput(file_name="up.jsonl", file_data=b'{"text": "yo"}\n'),
+            state=state, store=store, backend=backend, catalog=_catalog(),
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        assert rec2.dataset_uri and await store.exists(rec2.dataset_uri)
+
+        # unknown dataset id -> 404
+        with pytest.raises(TaskBuildError) as ei:
+            await task_builder(
+                JobInput(job_id="j-bad", user_id="u", model_name="tiny-test-lora",
+                         device="chip-1", arguments={}),
+                _spec(), DatasetInput(dataset_id="nope"),
+                state=state, store=store, backend=backend, catalog=_catalog(),
+                datasets_bucket="datasets", artifacts_bucket="artifacts",
+            )
+        assert ei.value.status == 404
+
+        # other-user dataset is invisible
+        with pytest.raises(TaskBuildError):
+            await task_builder(
+                JobInput(job_id="j-xuser", user_id="intruder",
+                         model_name="tiny-test-lora", device="chip-1", arguments={}),
+                _spec(), DatasetInput(dataset_id=ds.dataset_id),
+                state=state, store=store, backend=backend, catalog=_catalog(),
+                datasets_bucket="datasets", artifacts_bucket="artifacts",
+            )
+
+    run(main())
+
+
+def test_task_builder_submit_failure_rolls_back_job_ref(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        await state.connect()
+
+        class ExplodingBackend(ScriptedBackend):
+            async def submit(self, *a, **k):
+                raise RuntimeError("no quota")
+
+        ds = await upload_dataset_bytes(
+            store, state, user_id="u", filename="t.jsonl",
+            data=b"{}\n", bucket="datasets",
+        )
+        with pytest.raises(TaskBuildError) as ei:
+            await task_builder(
+                JobInput(job_id="j-boom", user_id="u", model_name="tiny-test-lora",
+                         device="chip-1", arguments={}),
+                _spec(), DatasetInput(dataset_id=ds.dataset_id),
+                state=state, store=store, backend=ExplodingBackend(),
+                catalog=_catalog(),
+                datasets_bucket="datasets", artifacts_bucket="artifacts",
+            )
+        assert ei.value.status == 500
+        refreshed = await state.get_dataset(ds.dataset_id)
+        assert "j-boom" not in refreshed.job_refs
+        assert await state.get_job("j-boom") is None
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Full lifecycle against the real local backend (the e2e slice, SURVEY §7 step 3)
+# ---------------------------------------------------------------------------
+
+
+def test_full_lifecycle_submit_train_metrics_succeed(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        catalog = _catalog()
+        backend = LocalProcessBackend(
+            tmp_path / "sandboxes", store, catalog, sync_interval_s=0.2
+        )
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        await state.connect()
+
+        rows = b'{"text": "the quick brown fox jumps over the lazy dog"}\n' * 16
+        ds = await upload_dataset_bytes(
+            store, state, user_id="u", filename="train.jsonl",
+            data=rows, bucket="datasets",
+        )
+        job = JobInput(job_id="e2e-1", user_id="u", model_name="tiny-test-lora",
+                       device="chip-1", arguments={"total_steps": 3})
+        await task_builder(
+            job, _spec(), DatasetInput(dataset_id=ds.dataset_id),
+            state=state, store=store, backend=backend, catalog=catalog,
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+
+        deadline = asyncio.get_event_loop().time() + 120
+        while True:
+            await monitor.tick()
+            rec = await state.get_job("e2e-1")
+            if rec.status.is_final:
+                break
+            assert asyncio.get_event_loop().time() < deadline, rec
+            await asyncio.sleep(0.3)
+
+        assert rec.status is DatabaseStatus.SUCCEEDED, rec
+        assert rec.training_duration and rec.training_duration > 0
+        # metrics flowed object store -> DB
+        metrics = await state.get_metrics("e2e-1")
+        assert metrics is not None and len(metrics.records) >= 1
+        assert "loss" in metrics.records[0]
+        # substrate cleaned up after success
+        assert await backend.get_job("e2e-1") is None
+        # artifacts remain in the object store
+        assert await store.exists(rec.artifacts_uri + "/done.txt")
+        await backend.close()
+        await state.close()
+
+    run(main())
+
+
+def test_monitor_sweeps_jobs_lost_by_backend(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        monitor.lost_job_grace_s = 0.0
+        await state.connect()
+        await task_builder(
+            JobInput(job_id="lost-1", user_id="u", model_name="tiny-test-lora",
+                     device="chip-1", arguments={}),
+            _spec(), DatasetInput(),
+            state=state, store=store, backend=backend, catalog=_catalog(),
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        # simulate a control-plane restart: backend forgot the job
+        backend.reports.clear()
+        await monitor.tick()
+        rec = await state.get_job("lost-1")
+        assert rec.status is DatabaseStatus.UNKNOWN
+        assert "no longer tracked" in rec.metadata["backend_message"]
+
+    run(main())
