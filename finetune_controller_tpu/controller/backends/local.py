@@ -308,6 +308,13 @@ class LocalProcessBackend(TrainingBackend):
             handle.sync_task = None
         try:
             n = await self._sync_dir(handle)
+            if handle.logs_path.exists():
+                # archive the training log with the artifacts so logs survive
+                # substrate cleanup (the reference loses pod logs once the
+                # succeeded job is deleted — core/monitor.py:182-186)
+                await self.store.put_file(
+                    f"{handle.artifacts_uri}/logs.txt", handle.logs_path
+                )
             handle.event("ArtifactsSynced", f"{n} files -> {handle.artifacts_uri}")
         except Exception as exc:
             # losing the final sync silently would let the monitor delete the

@@ -101,7 +101,7 @@ class ObjectStore:
         return records, newest["uri"]
 
     async def zip_prefix(self, prefix_uri: str) -> bytes:
-        """Zip every object under a prefix for download streaming
+        """Zip every object under a prefix, in memory — small prefixes only
         (reference: ``S3Handler.py:294-373``)."""
         objs = await self.list_prefix(prefix_uri)
         _, prefix_key = parse_uri(prefix_uri)
@@ -112,6 +112,21 @@ class ObjectStore:
                 arcname = key[len(prefix_key) :].lstrip("/") if key.startswith(prefix_key) else key
                 zf.writestr(arcname, await self.get_bytes(o["uri"]))
         return buf.getvalue()
+
+    async def zip_prefix_to_path(self, prefix_uri: str, dest: Path | str) -> int:
+        """Zip a prefix to a file on disk, one object at a time — bounded
+        memory for arbitrarily large artifact prefixes. Returns object count."""
+        objs = await self.list_prefix(prefix_uri)
+        _, prefix_key = parse_uri(prefix_uri)
+        with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as zf:
+            for o in objs:
+                _, key = parse_uri(o["uri"])
+                arcname = (
+                    key[len(prefix_key) :].lstrip("/")
+                    if key.startswith(prefix_key) else key
+                )
+                zf.writestr(arcname, await self.get_bytes(o["uri"]))
+        return len(objs)
 
 
 class LocalObjectStore(ObjectStore):

@@ -1,0 +1,89 @@
+"""Artifact promotion — publish a finished job's artifacts for inference.
+
+Capability parity with the reference's ``PromotionTask``
+(``app/tasks/promotion.py:10-62`` — SURVEY.md §2 component 19, §3.4): a
+background copy of the artifacts prefix into the deploy bucket with the state
+machine NOT_PROMOTED → IN_PROGRESS → COMPLETED/FAILED, and the reverse
+(DELETING → cleanup → NOT_PROMOTED).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from .objectstore import ObjectStore, build_uri
+from .schemas import PromotionStatus
+from .statestore import StateStore
+
+logger = logging.getLogger(__name__)
+
+
+def promotion_destination(deploy_bucket: str, promotion_path: str, job_id: str) -> str:
+    """Reference: destination assembly, ``app/main.py:736,769-771``."""
+    return build_uri(deploy_bucket, promotion_path, job_id)
+
+
+class PromotionTask:
+    """Background promote/unpromote operations (run via ``asyncio.create_task``,
+    the reference used FastAPI ``BackgroundTasks`` — ``app/main.py:776-781``)."""
+
+    def __init__(self, state: StateStore, store: ObjectStore):
+        self.state = state
+        self.store = store
+
+    async def promote_job_task(
+        self, job_id: str, artifacts_uri: str, destination_uri: str
+    ) -> None:
+        """Reference: ``promotion.py:11-36``."""
+        await self.state.update_job_promotion(
+            job_id, PromotionStatus.IN_PROGRESS, destination_uri
+        )
+        try:
+            n = await self.store.copy_prefix(artifacts_uri, destination_uri)
+            if n == 0:
+                raise FileNotFoundError(f"no artifacts under {artifacts_uri}")
+            await self.state.update_job_promotion(
+                job_id, PromotionStatus.COMPLETED, destination_uri
+            )
+            logger.info("promoted %s: %d objects -> %s", job_id, n, destination_uri)
+        except asyncio.CancelledError:
+            # shutdown mid-copy: record FAILED so the job isn't stuck
+            # IN_PROGRESS forever (the promote guard refuses retries otherwise)
+            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+            raise
+        except Exception:
+            logger.exception("promotion failed for %s", job_id)
+            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+
+    async def unpromote_job_task(self, job_id: str, destination_uri: str) -> None:
+        """Reference: ``unpromote_job_task``, ``promotion.py:38-62``."""
+        await self.state.update_job_promotion(
+            job_id, PromotionStatus.DELETING, destination_uri
+        )
+        try:
+            await self.store.delete_prefix(destination_uri)
+            await self.state.update_job_promotion(job_id, PromotionStatus.NOT_PROMOTED)
+            logger.info("unpromoted %s (removed %s)", job_id, destination_uri)
+        except asyncio.CancelledError:
+            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+            raise
+        except Exception:
+            logger.exception("unpromotion failed for %s", job_id)
+            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+
+    async def recover_interrupted(self) -> int:
+        """Crash recovery at startup: anything still IN_PROGRESS/DELETING has
+        no task running (the process died) — mark FAILED so the user can retry."""
+        n = 0
+        for job in await self.state.jobs.find(
+            lambda d: d.get("promotion_status")
+            in (PromotionStatus.IN_PROGRESS.value, PromotionStatus.DELETING.value)
+        ):
+            await self.state.update_job_promotion(
+                job["job_id"], PromotionStatus.FAILED
+            )
+            n += 1
+        if n:
+            logger.warning("marked %d interrupted promotion(s) as failed", n)
+        return n

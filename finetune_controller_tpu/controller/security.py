@@ -153,11 +153,15 @@ class TokenValidator:
         *,
         jwt_secret: str,
         introspection_url: str = "",
+        introspection_client_id: str = "",
+        introspection_client_secret: str = "",
         introspect_fn: IntrospectFn | None = None,
         cache_ttl_s: float = 60.0,
     ):
         self._jwt_secret = jwt_secret
         self._introspection_url = introspection_url
+        self._client_id = introspection_client_id
+        self._client_secret = introspection_client_secret
         self._introspect_fn = introspect_fn
         self._cache: dict[str, tuple[float, UserJWT]] = {}
         self._cache_ttl_s = cache_ttl_s
@@ -165,7 +169,14 @@ class TokenValidator:
     async def _remote_introspect(self, token: str) -> dict[str, Any]:
         import aiohttp
 
-        async with aiohttp.ClientSession() as session:
+        # RFC 7662 endpoints typically require client auth (the reference
+        # sends OpenBridge client creds, app/core/security.py:118-130)
+        auth = (
+            aiohttp.BasicAuth(self._client_id, self._client_secret)
+            if self._client_id
+            else None
+        )
+        async with aiohttp.ClientSession(auth=auth) as session:
             async with session.post(
                 self._introspection_url, data={"token": token}
             ) as resp:
@@ -230,7 +241,13 @@ def build_auth_middleware(
 
     @web.middleware
     async def auth_middleware(request, handler):
-        if not request.path.startswith(api_prefix) or request.path.endswith("/health"):
+        if (
+            not request.path.startswith(api_prefix)
+            or request.path.endswith("/health")
+            # token mint must be reachable without a token; the handler
+            # itself refuses in production
+            or request.path.endswith("/auth/dev-token")
+        ):
             return await handler(request)
         if not enabled:
             request["user"] = UserJWT(user_id=dev_user, is_admin=True)

@@ -1,0 +1,926 @@
+"""The control-plane HTTP/WS API (aiohttp).
+
+Capability parity with the reference's FastAPI app (``app/main.py`` 1,355 LoC
+— SURVEY.md §2 component 1) plus its middleware wiring (component 20) and
+OpenAPI customization (component 21). Route-by-route mapping to the reference
+is cited on each handler. Differences by design:
+
+- aiohttp instead of FastAPI (dependency surface: aiohttp is in the image);
+- the execution substrate is the backend seam, not raw Kubernetes clients;
+- nothing global: the app is built from an injected :class:`Runtime`
+  (reference wires singletons at import, SURVEY.md §3.5 wart).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import logging
+import tempfile
+import time
+from pathlib import Path
+from typing import Any
+from urllib.parse import urlencode
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from . import registry
+from .config import Settings
+from .promotion import PromotionTask, promotion_destination
+from .runtime import Runtime, build_runtime
+from .schemas import DatabaseStatus, JobInput, PromotionStatus
+from .security import TokenValidator, build_auth_middleware, dev_generate_token
+from .statestore import generate_short_uuid
+from .stream_logger import LogStreamManager
+from .task_builder import DatasetInput, TaskBuildError, task_builder
+
+logger = logging.getLogger(__name__)
+
+RUNTIME_KEY = web.AppKey("runtime", Runtime)
+PROMOTION_KEY = web.AppKey("promotion", PromotionTask)
+LIMITER_KEY = web.AppKey("limiter", object)
+BG_TASKS_KEY = web.AppKey("bg_tasks", set)
+
+
+# ---------------------------------------------------------------------------
+# Rate limiting (reference: slowapi limiter, app/api/middleware.py:18,
+# limits at app/main.py:377,525,714)
+# ---------------------------------------------------------------------------
+
+
+class RateLimiter:
+    """Sliding-window per-user, per-class limiter."""
+
+    def __init__(self, limits_per_min: dict[str, int]):
+        self.limits = limits_per_min
+        self._hits: dict[tuple[str, str], collections.deque] = collections.defaultdict(
+            collections.deque
+        )
+
+    def check(self, user_id: str, bucket: str) -> bool:
+        limit = self.limits.get(bucket)
+        if not limit:
+            return True
+        now = time.monotonic()
+        if len(self._hits) > 10_000:
+            # sweep fully-stale keys so distinct clients don't accumulate forever
+            stale = [
+                k for k, dq in self._hits.items() if not dq or dq[-1] < now - 60.0
+            ]
+            for k in stale:
+                del self._hits[k]
+        q = self._hits[(user_id, bucket)]
+        while q and q[0] < now - 60.0:
+            q.popleft()
+        if len(q) >= limit:
+            return False
+        q.append(now)
+        return True
+
+
+def _limited(bucket: str):
+    """Decorator enforcing a rate-limit class on a handler."""
+
+    def deco(handler):
+        async def wrapped(request: web.Request):
+            limiter: RateLimiter = request.app[LIMITER_KEY]
+            user = request.get("user")
+            uid = user.user_id if user else request.remote or "anon"
+            if not limiter.check(uid, bucket):
+                raise web.HTTPTooManyRequests(
+                    text=json.dumps({"detail": f"rate limit exceeded ({bucket})"}),
+                    content_type="application/json",
+                )
+            return await handler(request)
+
+        wrapped.__name__ = handler.__name__
+        wrapped.__doc__ = handler.__doc__
+        return wrapped
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def _json_error(status: int, detail: Any) -> web.Response:
+    return web.json_response({"detail": detail}, status=status)
+
+
+def _bad_request(detail: str) -> web.HTTPBadRequest:
+    return web.HTTPBadRequest(
+        text=json.dumps({"detail": detail}), content_type="application/json"
+    )
+
+
+def _int_param(q, name: str, default: int) -> int:
+    raw = q.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise _bad_request(f"query parameter {name!r} must be an integer")
+
+
+def _status_param(q) -> DatabaseStatus | None:
+    raw = q.get("status")
+    if not raw:
+        return None
+    try:
+        return DatabaseStatus(raw)
+    except ValueError:
+        raise _bad_request(
+            f"unknown status {raw!r}; one of {[s.value for s in DatabaseStatus]}"
+        )
+
+
+async def _json_body(request: web.Request) -> dict[str, Any]:
+    try:
+        body = await request.json()
+    except Exception:
+        raise _bad_request("request body must be valid JSON")
+    if not isinstance(body, dict):
+        raise _bad_request("request body must be a JSON object")
+    return body
+
+
+def _signed_download_url(rt: Runtime, uri: str) -> str:
+    """Presigned, URL-encoded download link (unencoded URIs with spaces/&
+    would self-invalidate the signature)."""
+    query = urlencode({"uri": uri, "sig": rt.presigner.sign(uri)})
+    return f"{rt.settings.api_prefix}/download?{query}"
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    """Uniform JSON error shapes (reference: FastAPI exception handlers)."""
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except TaskBuildError as e:
+        return _json_error(e.status, str(e))
+    except ValidationError as e:
+        # reference renders a per-field list on submit validation
+        # (app/main.py:437-471)
+        errors = [
+            {"field": ".".join(str(p) for p in err["loc"]), "message": err["msg"]}
+            for err in e.errors()
+        ]
+        return _json_error(400, errors)
+    except Exception:
+        logger.exception("unhandled error on %s %s", request.method, request.path)
+        return _json_error(500, "internal server error")
+
+
+def _user(request: web.Request):
+    user = request.get("user")
+    if user is None:
+        raise web.HTTPUnauthorized(
+            text=json.dumps({"detail": "not authenticated"}),
+            content_type="application/json",
+        )
+    return user
+
+
+async def _owned_job(request: web.Request, job_id: str):
+    """Fetch a job and enforce ownership (reference: ``app/main.py:725-726``;
+    admins see everything, as in the reference's admin routes)."""
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    job = await rt.state.get_job(job_id)
+    if job is None or (job.user_id != user.user_id and not user.is_admin):
+        raise web.HTTPNotFound(
+            text=json.dumps({"detail": f"job {job_id!r} not found"}),
+            content_type="application/json",
+        )
+    return job
+
+
+def _spawn_bg(app: web.Application, coro) -> None:
+    """Track background tasks so shutdown can await them (reference used
+    FastAPI BackgroundTasks, ``app/main.py:776-781``)."""
+    task = asyncio.get_running_loop().create_task(coro)
+    app[BG_TASKS_KEY].add(task)
+    task.add_done_callback(app[BG_TASKS_KEY].discard)
+
+
+# ---------------------------------------------------------------------------
+# Handlers — models & form schema
+# ---------------------------------------------------------------------------
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def list_models(request: web.Request) -> web.Response:
+    """Entitled models (reference: ``user_available_models``,
+    ``app/main.py:1323-1341``)."""
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    names = user.entitled_models(sorted(registry.JOB_MANIFESTS))
+    out = []
+    for name in names:
+        cls = registry.JOB_MANIFESTS[name]
+        out.append(
+            {
+                "name": name,
+                "description": cls.description,
+                "task": cls.task.value,
+                "framework": cls.framework.value,
+                "default_device": cls.default_device,
+                "devices": rt.catalog.names(),
+                "dataset": cls.dataset.model_dump(),
+            }
+        )
+    return web.json_response({"models": out})
+
+
+async def model_schema(request: web.Request) -> web.Response:
+    """Submission-form JSON schema (reference: ``app/main.py:244-281`` —
+    the pydantic Field metadata IS the form)."""
+    user = _user(request)
+    name = request.match_info["model_name"]
+    cls = registry.get_spec(name)
+    if cls is None or name not in user.entitled_models(list(registry.JOB_MANIFESTS)):
+        return _json_error(404, f"model {name!r} not found")
+    rt = request.app[RUNTIME_KEY]
+    return web.json_response(
+        {
+            "model": name,
+            "arguments_schema": cls.arguments_schema(),
+            "devices": rt.catalog.names(),
+            "default_device": cls.default_device,
+            "default_num_slices": cls.default_num_slices,
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# Handlers — job submission (reference: start_job, app/main.py:376-502, §3.1)
+# ---------------------------------------------------------------------------
+
+
+def _parse_arguments(raw: Any) -> dict[str, Any]:
+    """Reference: ``_parse_arguments_input``, ``app/main.py:505-511``."""
+    if raw is None or raw == "":
+        return {}
+    if isinstance(raw, dict):
+        return raw
+    try:
+        parsed = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise TaskBuildError(f"arguments is not valid JSON: {e}") from e
+    if not isinstance(parsed, dict):
+        raise TaskBuildError("arguments must be a JSON object")
+    return parsed
+
+
+async def _stream_part_to_dataset(request: web.Request, part) -> str:
+    """Stream a multipart file part straight into the object store as a
+    dataset record (no whole-file buffering); returns the dataset id."""
+    from .datasets import upload_dataset_stream
+
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+
+    async def chunks():
+        while chunk := await part.read_chunk(1 << 20):
+            yield chunk
+
+    record = await upload_dataset_stream(
+        rt.store, rt.state,
+        user_id=user.user_id,
+        filename=part.filename or "dataset.jsonl",
+        chunks=chunks(),
+        bucket=rt.settings.datasets_bucket,
+        content_type=part.headers.get("Content-Type"),
+    )
+    return record.dataset_id
+
+
+async def _read_submission(request: web.Request) -> tuple[dict[str, Any], DatasetInput]:
+    """Accept JSON or multipart (file upload) submissions."""
+    ds = DatasetInput()
+    if request.content_type == "multipart/form-data":
+        fields: dict[str, Any] = {}
+        async for part in await request.multipart():
+            if part.name == "dataset_file":
+                # uploaded file becomes a first-class dataset record; the job
+                # then references it by id (streams, never buffers)
+                ds.dataset_id = await _stream_part_to_dataset(request, part)
+            else:
+                fields[part.name] = (await part.read(decode=True)).decode()
+    else:
+        fields = await _json_body(request)
+    ds.dataset_id = fields.pop("dataset_id", None) or ds.dataset_id
+    ds.url = fields.pop("dataset_url", None) or None
+    return fields, ds
+
+
+@_limited("submit")
+async def start_job(request: web.Request) -> web.Response:
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    fields, ds = await _read_submission(request)
+
+    model_name = fields.get("model_name") or fields.get("model")
+    if not model_name:
+        return _json_error(400, "model_name is required")
+    cls = registry.get_spec(model_name)
+    if cls is None:
+        return _json_error(404, f"model {model_name!r} not found")
+    # entitlement check (reference: app/main.py:408-416)
+    if model_name not in user.entitled_models(list(registry.JOB_MANIFESTS)):
+        return _json_error(403, f"not entitled to model {model_name!r}")
+
+    arguments = _parse_arguments(fields.get("arguments"))
+    # pydantic-validates the typed hyperparameters; ValidationError → 400 list
+    spec = cls(training_arguments=arguments)
+
+    # optional task cross-check (reference: app/main.py:455-459)
+    task = fields.get("task")
+    if task and task != cls.task.value:
+        return _json_error(400, f"model {model_name!r} is a {cls.task.value} model")
+
+    device = fields.get("device") or cls.default_device
+    if rt.catalog.get(device) is None:
+        return _json_error(
+            400,
+            f"unknown device {device!r}; available: {rt.catalog.names()}",
+        )
+    try:
+        num_slices = int(fields.get("num_slices") or cls.default_num_slices)
+    except (TypeError, ValueError):
+        return _json_error(400, "num_slices must be an integer")
+
+    job_id = f"{model_name}-{generate_short_uuid()}"  # reference: app/main.py:422
+    job = JobInput(
+        job_id=job_id,
+        user_id=user.user_id,
+        model_name=model_name,
+        device=device,
+        num_slices=num_slices,
+        arguments=arguments,
+    )
+    await task_builder(
+        job, spec, ds,
+        state=rt.state, store=rt.store, backend=rt.backend, catalog=rt.catalog,
+        datasets_bucket=rt.settings.datasets_bucket,
+        artifacts_bucket=rt.settings.artifacts_bucket,
+    )
+    # reference response shape: app/main.py:488
+    return web.json_response({"message": "Job started successfully", "job_id": job_id})
+
+
+# ---------------------------------------------------------------------------
+# Handlers — job reads
+# ---------------------------------------------------------------------------
+
+
+@_limited("read")
+async def get_jobs_page(request: web.Request) -> web.Response:
+    """Paginated job table (reference: ``get_user_jobs_page``,
+    ``app/main.py:524-613``)."""
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    q = request.query
+    page = await rt.state.get_user_jobs(
+        user.user_id,
+        page=_int_param(q, "page", 1),
+        page_size=min(_int_param(q, "page_size", 20), 100),
+        status=_status_param(q),
+        search=q.get("search"),
+        sort_by=q.get("sort_by", "submitted_at"),
+        descending=q.get("descending", "true").lower() != "false",
+    )
+    return web.json_response(page.model_dump(mode="json"))
+
+
+async def get_job(request: web.Request) -> web.Response:
+    job = await _owned_job(request, request.match_info["job_id"])
+    return web.json_response(job.model_dump(mode="json"))
+
+
+async def get_job_metrics(request: web.Request) -> web.Response:
+    """Last 100 metric rows reversed + presigned CSV link (reference:
+    ``app/main.py:660-709``)."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    doc = await rt.state.get_metrics(job.job_id)
+    records = (doc.records if doc else [])[-100:][::-1]
+    csv_url = _signed_download_url(rt, doc.source_uri) if doc and doc.source_uri else None
+    return web.json_response(
+        {"job_id": job.job_id, "records": records, "csv_url": csv_url}
+    )
+
+
+async def get_job_artifacts(request: web.Request) -> web.Response:
+    """Artifact zip download (reference: ``S3Handler.py:294-373`` streamed
+    through the API)."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    if not job.artifacts_uri:
+        return _json_error(404, "job has no artifacts")
+    objs = await rt.store.list_prefix(job.artifacts_uri)
+    if not objs:
+        return _json_error(404, "no artifacts found")
+    # spool the zip to disk and stream it out — multi-GB checkpoint prefixes
+    # must not be materialised in RAM per download
+    with tempfile.NamedTemporaryFile(suffix=".zip", delete=False) as tmp:
+        tmp_path = Path(tmp.name)
+    try:
+        await rt.store.zip_prefix_to_path(job.artifacts_uri, tmp_path)
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "application/zip",
+                "Content-Disposition": (
+                    f'attachment; filename="{job.job_id}_artifacts.zip"'
+                ),
+                "Content-Length": str(tmp_path.stat().st_size),
+            }
+        )
+        await resp.prepare(request)
+        with open(tmp_path, "rb") as f:
+            while chunk := await asyncio.to_thread(f.read, 1 << 20):
+                await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+    finally:
+        tmp_path.unlink(missing_ok=True)
+
+
+async def download(request: web.Request) -> web.Response:
+    """Presigned-URL fulfillment (LocalObjectStore's stand-in for S3
+    presigned GETs, reference ``S3Handler.py:168``)."""
+    rt = request.app[RUNTIME_KEY]
+    uri, sig = request.query.get("uri", ""), request.query.get("sig", "")
+    if not uri or not rt.presigner.verify(uri, sig):
+        return _json_error(403, "invalid or expired signature")
+    if not await rt.store.exists(uri):
+        return _json_error(404, "object not found")
+    data = await rt.store.get_bytes(uri)
+    return web.Response(
+        body=data,
+        content_type="application/octet-stream",
+        headers={
+            "Content-Disposition": f'attachment; filename="{uri.rsplit("/", 1)[-1]}"'
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# Handlers — lifecycle mutations
+# ---------------------------------------------------------------------------
+
+
+@_limited("promote")
+async def promote_job(request: web.Request) -> web.Response:
+    """Reference: ``promote_job``, ``app/main.py:713-794`` (§3.4), with the
+    same guards."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    if job.promotion_status is PromotionStatus.IN_PROGRESS:
+        return web.json_response(
+            {"detail": "promotion already in progress"}, status=202
+        )
+    if not job.status.is_final:
+        return _json_error(400, "cannot promote a running job")
+    if job.status is not DatabaseStatus.SUCCEEDED:
+        return _json_error(400, f"cannot promote a {job.status.value} job")
+    if not job.artifacts_uri or not await rt.store.list_prefix(job.artifacts_uri):
+        return _json_error(404, "job has no artifacts to promote")
+    cls = registry.get_spec(job.model_name)
+    promotion_path = cls.promotion_path if cls else "models"
+    destination = promotion_destination(
+        rt.settings.deploy_bucket, promotion_path, job.job_id
+    )
+    promo = request.app[PROMOTION_KEY]
+    _spawn_bg(
+        request.app,
+        promo.promote_job_task(job.job_id, job.artifacts_uri, destination),
+    )
+    return web.json_response(
+        {"message": "promotion started", "destination": destination}, status=202
+    )
+
+
+@_limited("promote")
+async def unpromote_job(request: web.Request) -> web.Response:
+    """Reference: ``unpromote_job``, ``app/main.py:798-835``."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    if job.promotion_status not in (PromotionStatus.COMPLETED, PromotionStatus.FAILED):
+        return _json_error(400, "job is not promoted")
+    if not job.promotion_uri:
+        return _json_error(404, "no promotion destination recorded")
+    promo = request.app[PROMOTION_KEY]
+    _spawn_bg(request.app, promo.unpromote_job_task(job.job_id, job.promotion_uri))
+    return web.json_response({"message": "unpromotion started"}, status=202)
+
+
+async def cancel_job(request: web.Request) -> web.Response:
+    """Reference: ``cancel_job``, ``app/main.py:839-903``: stop the backend
+    half, mark CANCELLED."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    if job.status.is_final:
+        return _json_error(400, f"job already {job.status.value}")
+    await rt.backend.delete_job(job.job_id)
+    await rt.state.update_job_status(
+        job.job_id, DatabaseStatus.CANCELLED, end_time=time.time(), queue_position=None
+    )
+    return web.json_response({"message": "job cancelled", "job_id": job.job_id})
+
+
+async def delete_job(request: web.Request) -> web.Response:
+    """Reference: ``delete_job``, ``app/main.py:907-946``: archive-on-delete;
+    running jobs must be cancelled first."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    if not job.status.is_final and job.status is not DatabaseStatus.UNKNOWN:
+        return _json_error(400, "cancel the job before deleting it")
+    await rt.backend.delete_job(job.job_id)
+    await rt.state.delete_job(job.job_id)
+    return web.json_response({"message": "job deleted", "job_id": job.job_id})
+
+
+# ---------------------------------------------------------------------------
+# Handlers — datasets (reference: app/main.py:953-1060)
+# ---------------------------------------------------------------------------
+
+
+async def upload_dataset(request: web.Request) -> web.Response:
+    from .datasets import stream_dataset_url
+
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    if request.content_type == "multipart/form-data":
+        async for part in await request.multipart():
+            if part.name in ("file", "dataset_file"):
+                dataset_id = await _stream_part_to_dataset(request, part)
+                record = await rt.state.get_dataset(dataset_id)
+                return web.json_response(record.model_dump(mode="json"), status=201)
+        return _json_error(400, "multipart field 'file' is required")
+    body = await _json_body(request)
+    url = body.get("url")
+    if not url:
+        return _json_error(400, "provide a multipart file or a JSON body with 'url'")
+    record = await stream_dataset_url(
+        rt.store, rt.state,
+        user_id=user.user_id, url=url, bucket=rt.settings.datasets_bucket,
+    )
+    return web.json_response(record.model_dump(mode="json"), status=201)
+
+
+async def list_datasets(request: web.Request) -> web.Response:
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    records = await rt.state.get_user_datasets(user.user_id)
+    return web.json_response(
+        {"datasets": [r.model_dump(mode="json") for r in records]}
+    )
+
+
+async def get_dataset(request: web.Request) -> web.Response:
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    record = await rt.state.get_dataset(request.match_info["dataset_id"])
+    if record is None or (record.user_id != user.user_id and not user.is_admin):
+        return _json_error(404, "dataset not found")
+    out = record.model_dump(mode="json")
+    out["download_url"] = _signed_download_url(rt, record.uri)
+    return web.json_response(out)
+
+
+async def delete_dataset(request: web.Request) -> web.Response:
+    rt = request.app[RUNTIME_KEY]
+    user = _user(request)
+    record = await rt.state.get_dataset(request.match_info["dataset_id"])
+    if record is None or (record.user_id != user.user_id and not user.is_admin):
+        return _json_error(404, "dataset not found")
+    await rt.store.delete_prefix(record.uri.rsplit("/", 1)[0])
+    await rt.state.delete_dataset(record.dataset_id)
+    return web.json_response({"message": "dataset deleted"})
+
+
+# ---------------------------------------------------------------------------
+# Handlers — WebSocket log streaming (reference: app/main.py:340-366, §3.3)
+# ---------------------------------------------------------------------------
+
+
+async def stream_logs_ws(request: web.Request) -> web.WebSocketResponse:
+    rt = request.app[RUNTIME_KEY]
+    job_id = request.match_info["job_id"]
+    # ownership check before accepting (the reference checks inside the
+    # manager via DB reads; checking here fails fast)
+    await _owned_job(request, job_id)
+    q = request.query
+    # validate query params BEFORE hijacking the connection — a 400 must go
+    # out as HTTP, not onto a prepared WebSocket
+    follow = q.get("follow", "true").lower() != "false"
+    last_lines = _int_param(q, "last_lines", 0) or None
+    search_string = q.get("search_string", rt.settings.log_stream_search_string)
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+    manager = LogStreamManager(
+        ws, job_id, rt.state, rt.backend,
+        follow=follow,
+        last_lines=last_lines,
+        search_string=search_string,
+        start_timeout_s=rt.settings.log_stream_start_timeout_s,
+    )
+    try:
+        await manager.run()
+    finally:
+        await ws.close()
+    return ws
+
+
+async def get_job_logs(request: web.Request) -> web.Response:
+    """REST log read (reference admin pod-log route ``app/main.py:1214-1252``)."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    last = _int_param(request.query, "last_lines", 0) or None
+    try:
+        lines_iter = await rt.backend.read_logs(
+            job.job_id, follow=False, last_lines=last
+        )
+        lines = [line async for line in lines_iter]
+    except Exception:
+        # substrate cleaned up: serve the archived copy from the artifacts
+        # (capability the reference lacks — pod logs die with the pods)
+        archived = f"{job.artifacts_uri}/logs.txt" if job.artifacts_uri else None
+        if not archived or not await rt.store.exists(archived):
+            return _json_error(404, "logs unavailable")
+        text = (await rt.store.get_bytes(archived)).decode(errors="replace")
+        lines = text.splitlines()
+        if last:
+            lines = lines[-last:]
+    return web.json_response({"job_id": job.job_id, "lines": lines})
+
+
+# ---------------------------------------------------------------------------
+# Handlers — admin (reference: app/main.py:1099-1297)
+# ---------------------------------------------------------------------------
+
+
+def _admin(request: web.Request):
+    user = _user(request)
+    if not user.is_admin:
+        raise web.HTTPForbidden(
+            text=json.dumps({"detail": "admin only"}), content_type="application/json"
+        )
+    return user
+
+
+async def admin_jobs(request: web.Request) -> web.Response:
+    """All users' jobs (reference: admin job table, ``app/main.py:1099-1150``)."""
+    rt = request.app[RUNTIME_KEY]
+    _admin(request)
+    q = request.query
+    page = await rt.state.get_user_jobs(
+        None,
+        page=_int_param(q, "page", 1),
+        page_size=min(_int_param(q, "page_size", 20), 100),
+        status=_status_param(q),
+        search=q.get("search"),
+    )
+    return web.json_response(page.model_dump(mode="json"))
+
+
+async def admin_queue(request: web.Request) -> web.Response:
+    """Queue order + quota usage (reference: Kueue introspection,
+    ``app/utils/kueue_helpers.py``)."""
+    rt = request.app[RUNTIME_KEY]
+    _admin(request)
+    pending = await rt.backend.queue_snapshot()
+    usage = None
+    scheduler = getattr(rt.backend, "scheduler", None)
+    if scheduler is not None:
+        usage = scheduler.usage()
+    return web.json_response({"pending": pending, "usage": usage})
+
+
+async def admin_job_events(request: web.Request) -> web.Response:
+    """Pod-events debug digest (reference: ``app/main.py:1214-1252``,
+    ``kube_helpers.py:26-95``)."""
+    rt = request.app[RUNTIME_KEY]
+    _admin(request)
+    events = await rt.backend.job_events(request.match_info["job_id"])
+    return web.json_response({"events": events})
+
+
+async def admin_backend_jobs(request: web.Request) -> web.Response:
+    rt = request.app[RUNTIME_KEY]
+    _admin(request)
+    reports = await rt.backend.list_jobs()
+    return web.json_response(
+        {"jobs": [r.model_dump(mode="json") for r in reports]}
+    )
+
+
+# ---------------------------------------------------------------------------
+# Handlers — auth + observability
+# ---------------------------------------------------------------------------
+
+
+async def mint_dev_token(request: web.Request) -> web.Response:
+    """Dev-mode token mint (reference: ``dev_generate_token``,
+    ``app/core/security.py:347-389``); disabled in production."""
+    rt = request.app[RUNTIME_KEY]
+    # the mint route is reachable unauthenticated, so it must only exist in
+    # the local env — in any deployed environment an open mint + the HS256
+    # verify fallback would hand out admin tokens to anyone
+    if rt.settings.environment != "local":
+        return _json_error(403, "dev tokens are only available in the local environment")
+    body = await _json_body(request)
+    token = dev_generate_token(
+        body.get("user_id", "dev-user"),
+        rt.settings.jwt_secret,
+        scopes=body.get("scopes"),
+        is_admin=bool(body.get("is_admin", False)),
+        email=body.get("email", ""),
+    )
+    return web.json_response({"access_token": token, "token_type": "bearer"})
+
+
+async def prometheus_metrics(request: web.Request) -> web.Response:
+    """Controller self-metrics in Prometheus text format — a gap in the
+    reference (SURVEY.md §5.5: 'No Prometheus/metrics endpoint')."""
+    rt = request.app[RUNTIME_KEY]
+    lines = [
+        "# TYPE ftc_monitor_ticks_total counter",
+        f"ftc_monitor_ticks_total {rt.monitor.ticks}",
+    ]
+    counts: dict[str, int] = {}
+    for job in await rt.state.get_active_jobs():
+        counts[job.status.value] = counts.get(job.status.value, 0) + 1
+    lines.append("# TYPE ftc_jobs_active gauge")
+    for status, n in sorted(counts.items()):
+        lines.append(f'ftc_jobs_active{{status="{status}"}} {n}')
+    scheduler = getattr(rt.backend, "scheduler", None)
+    if scheduler is not None:
+        lines.append("# TYPE ftc_quota_chips gauge")
+        for flavor, u in scheduler.usage().items():
+            lines.append(
+                f'ftc_quota_chips{{flavor="{flavor}",kind="used"}} {u["used_chips"]}'
+            )
+            lines.append(
+                f'ftc_quota_chips{{flavor="{flavor}",kind="nominal"}} {u["nominal_chips"]}'
+            )
+    return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+
+def _openapi_schema(app: web.Application, settings: Settings) -> dict[str, Any]:
+    """Minimal OpenAPI doc with BearerAuth on every API path (reference:
+    ``custom_openapi_jwt_auth``, ``app/api/custom_openapi.py:6-31``)."""
+    paths: dict[str, Any] = {}
+    for route in app.router.routes():
+        info = route.resource.get_info() if route.resource else {}
+        path = info.get("path") or info.get("formatter")
+        if not path or not path.startswith(settings.api_prefix):
+            continue
+        method = route.method.lower()
+        if method in ("head", "options", "*"):
+            continue
+        entry = paths.setdefault(path, {})
+        entry[method] = {
+            "summary": (route.handler.__doc__ or "").strip().split("\n")[0],
+            "security": [{"BearerAuth": []}],
+            "responses": {"200": {"description": "OK"}},
+        }
+    return {
+        "openapi": "3.1.0",
+        "info": {"title": "finetune-controller-tpu", "version": "0.1.0"},
+        "paths": paths,
+        "components": {
+            "securitySchemes": {
+                "BearerAuth": {"type": "http", "scheme": "bearer", "bearerFormat": "JWT"}
+            }
+        },
+    }
+
+
+async def openapi_json(request: web.Request) -> web.Response:
+    rt = request.app[RUNTIME_KEY]
+    return web.json_response(_openapi_schema(request.app, rt.settings))
+
+
+# ---------------------------------------------------------------------------
+# App assembly (reference: setup_middleware app/api/middleware.py:59-66 +
+# lifespan app/main.py:78-105)
+# ---------------------------------------------------------------------------
+
+
+def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Application:
+    settings = runtime.settings
+    if settings.auth_enabled and not (
+        settings.introspection_url or settings.jwt_secret
+    ):
+        # reference warns loudly when prod auth is unconfigured
+        # (app/api/middleware.py:28-30)
+        logger.warning("auth enabled but no introspection URL or JWT secret set")
+    validator = TokenValidator(
+        jwt_secret=settings.jwt_secret,
+        introspection_url=settings.introspection_url,
+        introspection_client_id=settings.introspection_client_id,
+        introspection_client_secret=settings.introspection_client_secret,
+    )
+    app = web.Application(
+        middlewares=[
+            error_middleware,
+            build_auth_middleware(
+                validator,
+                enabled=settings.auth_enabled,
+                api_prefix=settings.api_prefix,
+            ),
+        ],
+        client_max_size=1 << 30,  # dataset uploads
+    )
+    app[RUNTIME_KEY] = runtime
+    app[PROMOTION_KEY] = PromotionTask(runtime.state, runtime.store)
+    app[LIMITER_KEY] = RateLimiter(
+        {
+            "submit": settings.rate_limit_submit_per_min,
+            "read": settings.rate_limit_read_per_min,
+            "promote": settings.rate_limit_promote_per_min,
+        }
+    )
+    app[BG_TASKS_KEY] = set()
+
+    p = settings.api_prefix
+    app.router.add_get(f"{p}/health", health)
+    app.router.add_get(f"{p}/models", list_models)
+    app.router.add_get(f"{p}/models/{{model_name}}/schema", model_schema)
+    app.router.add_post(f"{p}/jobs", start_job)
+    app.router.add_get(f"{p}/jobs", get_jobs_page)
+    app.router.add_get(f"{p}/jobs/{{job_id}}", get_job)
+    app.router.add_get(f"{p}/jobs/{{job_id}}/metrics", get_job_metrics)
+    app.router.add_get(f"{p}/jobs/{{job_id}}/artifacts", get_job_artifacts)
+    app.router.add_get(f"{p}/jobs/{{job_id}}/logs", get_job_logs)
+    app.router.add_post(f"{p}/jobs/{{job_id}}/promote", promote_job)
+    app.router.add_post(f"{p}/jobs/{{job_id}}/unpromote", unpromote_job)
+    app.router.add_post(f"{p}/jobs/{{job_id}}/cancel", cancel_job)
+    app.router.add_delete(f"{p}/jobs/{{job_id}}", delete_job)
+    app.router.add_get(f"{p}/logs/{{job_id}}", stream_logs_ws)  # WS
+    app.router.add_post(f"{p}/datasets", upload_dataset)
+    app.router.add_get(f"{p}/datasets", list_datasets)
+    app.router.add_get(f"{p}/datasets/{{dataset_id}}", get_dataset)
+    app.router.add_delete(f"{p}/datasets/{{dataset_id}}", delete_dataset)
+    app.router.add_get(f"{p}/download", download)
+    app.router.add_get(f"{p}/admin/jobs", admin_jobs)
+    app.router.add_get(f"{p}/admin/queue", admin_queue)
+    app.router.add_get(f"{p}/admin/jobs/{{job_id}}/events", admin_job_events)
+    app.router.add_get(f"{p}/admin/backend/jobs", admin_backend_jobs)
+    app.router.add_post(f"{p}/auth/dev-token", mint_dev_token)
+    app.router.add_get(f"{p}/openapi.json", openapi_json)
+    app.router.add_get("/metrics", prometheus_metrics)
+
+    async def on_startup(app: web.Application) -> None:
+        await runtime.start(with_monitor=with_monitor)
+        # crash recovery: promotions interrupted by a previous shutdown
+        await app[PROMOTION_KEY].recover_interrupted()
+        logger.info(
+            "control plane up: backend=%s monitor_in_process=%s",
+            settings.backend,
+            settings.monitor_in_process if with_monitor is None else with_monitor,
+        )
+
+    async def on_cleanup(app: web.Application) -> None:
+        for task in list(app[BG_TASKS_KEY]):
+            task.cancel()
+        await runtime.close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m finetune_controller_tpu.controller.server --port 8787``
+    (reference: ``uvicorn app.main:app``, ``Dockerfile:28``)."""
+    import argparse
+
+    from .logging_config import setup_logging
+
+    parser = argparse.ArgumentParser(prog="ftc-serve")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8787)
+    parser.add_argument("--plugin-dir", default=None, help="model plugin directory")
+    args = parser.parse_args(argv)
+    setup_logging()
+    runtime = build_runtime(plugin_dir=args.plugin_dir)
+    app = build_app(runtime)
+    web.run_app(app, host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
