@@ -39,6 +39,7 @@ from ..devices import DeviceCatalog, DeviceFlavor, default_mesh_for
 from ..objectstore import ObjectStore
 from ..schemas import BackendJobReport, BackendJobState, JobInput
 from ..specs import BaseFineTuneJob
+from ..syncer import sync_dir_to_store
 from .base import BackendError, TrainingBackend
 from .scheduler import GangScheduler
 
@@ -265,26 +266,13 @@ class LocalProcessBackend(TrainingBackend):
 
     # ------------------------------------------------------- artifact sidecar
 
-    def _matched_files(self, handle: _JobHandle) -> list[Path]:
-        out: set[Path] = set()
-        for pattern in handle.patterns:
-            out.update(p for p in handle.artifacts_dir.glob(pattern) if p.is_file())
-        return sorted(out)
-
     async def _sync_dir(self, handle: _JobHandle) -> int:
-        """Upload changed files only ((mtime, size) change detection — the
+        """Upload changed matching files only (shared ``syncer`` core — the
         behavior ``aws s3 sync`` gave the reference for free)."""
-        n = 0
-        for path in self._matched_files(handle):
-            rel = path.relative_to(handle.artifacts_dir).as_posix()
-            st = path.stat()
-            stamp = (st.st_mtime, st.st_size)
-            if handle.synced.get(rel) == stamp:
-                continue
-            await self.store.put_file(f"{handle.artifacts_uri}/{rel}", path)
-            handle.synced[rel] = stamp
-            n += 1
-        return n
+        return await sync_dir_to_store(
+            self.store, handle.artifacts_dir, handle.artifacts_uri,
+            patterns=handle.patterns, synced=handle.synced,
+        )
 
     async def _sync_loop(self, handle: _JobHandle) -> None:
         """Sidecar: sync every interval until done.txt appears
