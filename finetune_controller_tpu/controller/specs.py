@@ -174,6 +174,9 @@ class BaseFineTuneJob(BaseModel):
             if key in args:
                 training[key] = args.pop(key)
         model: dict[str, Any] = {"preset": self.model_preset}
+        if self.framework == TrainingFramework.JAX_QLORA:
+            # int4 base weights (models/quant.py); adapters still train in LoRA
+            model["overrides"] = {"quantize_base": True}
         if "lora_rank" in args:
             model["lora"] = {"rank": args.pop("lora_rank")}
         spec: dict[str, Any] = {

@@ -56,6 +56,11 @@ async def cmd_sync(
             if n:
                 logger.info("synced %d file(s) -> %s", n, dest_uri)
         except Exception:
+            if done is None:
+                # one-shot mode has no retry: a swallowed failure would exit 0
+                # and the caller would treat a failed upload as success
+                logger.exception("one-shot sync failed")
+                return 1
             logger.exception("sync pass failed; retrying")
         if done is not None and done.exists():
             await sync_dir_to_store(  # final pass

@@ -44,6 +44,14 @@ class LlamaConfig:
     scan_layers: bool = True
     tie_embeddings: bool = False
     lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
+    # MoE (0 experts = dense MLP); BASELINE config #4
+    n_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+    # QLoRA: frozen projection kernels stored as blockwise int4 (config #3)
+    quantize_base: bool = False
+    quant_block: int = 64
 
     @property
     def head_dim(self) -> int:
@@ -55,7 +63,11 @@ class LlamaConfig:
     def param_count(self) -> int:
         d, v, f, L = self.d_model, self.vocab_size, self.d_ff, self.n_layers
         kvd = self.n_kv_heads * self.head_dim
-        per_layer = d * d + 2 * d * kvd + d * d + 3 * d * f + 2 * d
+        if self.n_experts:
+            mlp = self.n_experts * 3 * d * f + d * self.n_experts
+        else:
+            mlp = 3 * d * f
+        per_layer = d * d + 2 * d * kvd + d * d + mlp + 2 * d
         return v * d + L * per_layer + d + (0 if self.tie_embeddings else d * v)
 
 
@@ -74,6 +86,14 @@ PRESETS: dict[str, LlamaConfig] = {
     "mistral-7b": LlamaConfig(
         vocab_size=32768, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=8192, n_experts=8, moe_top_k=2,
+    ),
+    "tiny-moe-test": LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, n_experts=4, moe_top_k=2,
     ),
 }
 
@@ -118,6 +138,8 @@ def _proj(cfg: LlamaConfig, name: str, features: int) -> LoRADense:
         lora_dropout=cfg.lora.dropout,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
+        quantize_base=cfg.quantize_base,
+        quant_block=cfg.quant_block,
     )
 
 
@@ -159,7 +181,22 @@ class Block(nn.Module):
         h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="attn_norm")(x)
         x = x + Attention(cfg, name="attn")(h, positions, segment_ids, deterministic)
         h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="mlp_norm")(x)
-        return x + MLP(cfg, name="mlp")(h, deterministic)
+        if cfg.n_experts:
+            from .moe import MoEMLP
+
+            mlp_out = MoEMLP(
+                d_model=cfg.d_model,
+                d_ff=cfg.d_ff,
+                n_experts=cfg.n_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.capacity_factor,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="moe",
+            )(h, deterministic)
+        else:
+            mlp_out = MLP(cfg, name="mlp")(h, deterministic)
+        return x + mlp_out
 
 
 class _ScanBlock(nn.Module):
@@ -202,7 +239,7 @@ class LlamaForCausalLM(nn.Module):
                 )
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "lora": 0},
+                variable_axes={"params": 0, "lora": 0, "moe_aux": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.n_layers,

@@ -51,14 +51,36 @@ class LoRADense(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     kernel_init: Any = nn.initializers.lecun_normal()
+    #: store the frozen base kernel as blockwise int4 (QLoRA — models/quant.py)
+    quantize_base: bool = False
+    quant_block: int = 64
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         in_features = x.shape[-1]
-        kernel = self.param(
-            "kernel", self.kernel_init, (in_features, self.features), self.param_dtype
-        )
-        y = x @ kernel.astype(self.dtype)
+        if self.quantize_base:
+            from .quant import dequantize_int4, quantize_int4
+
+            # quantize ONE weight draw for both params — flax folds the param
+            # name into the rng, so separate init fns would quantize two
+            # different matrices and store mismatched values/scales
+            packed0 = scales0 = None
+            if self.is_initializing():
+                w0 = self.kernel_init(
+                    self.make_rng("params"), (in_features, self.features),
+                    jnp.float32,
+                )
+                packed0, scales0 = quantize_int4(w0, self.quant_block)
+            packed = self.param("kernel_packed", lambda _rng: packed0)
+            scales = self.param("kernel_scales", lambda _rng: scales0)
+            kernel = dequantize_int4(packed, scales, dtype=self.dtype)
+            y = x @ kernel
+        else:
+            kernel = self.param(
+                "kernel", self.kernel_init, (in_features, self.features),
+                self.param_dtype,
+            )
+            y = x @ kernel.astype(self.dtype)
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype

@@ -1,0 +1,118 @@
+"""Mixture-of-Experts FFN with expert parallelism (Mixtral-style top-k routing).
+
+BASELINE config #4 (Mixtral 8x7B on v5p-64). The reference has no EP at all
+(SURVEY.md §2.3: 'new: expert mesh axis'); this is the TPU-native design:
+
+- experts are ONE stacked parameter tensor ``(E, d, f)`` sharded over the
+  ``ep`` mesh axis (``parallel/sharding.py`` rules), so expert compute is a
+  single batched matmul on the MXU and XLA inserts the all-to-alls when
+  tokens cross expert shards;
+- GShard-style dense dispatch/combine: top-k routing with a static capacity
+  per expert — no dynamic shapes, no host round-trips, everything under one
+  ``jit``. Tokens over capacity are dropped (their combine weight is zero),
+  the standard TPU trade for static shapes;
+- router in float32 (softmax numerics), experts in the model compute dtype;
+- Switch-Transformer load-balancing aux loss, sown into the ``moe_aux``
+  collection; the trainer folds it into the objective.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense SwiGLU MLP."""
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        b, s, d = x.shape
+        t = b * s
+        e, k = self.n_experts, self.top_k
+        # static per-expert capacity (tokens), padded to a lane-friendly size
+        capacity = max(8, math.ceil(t / e * self.capacity_factor * k))
+        capacity = min(capacity, t)
+
+        xt = x.reshape(t, d)
+
+        # ---- router (f32) --------------------------------------------------
+        router_kernel = self.param(
+            "router_kernel",
+            nn.initializers.normal(stddev=d ** -0.5),
+            (d, e),
+            self.param_dtype,
+        )
+        logits = jnp.einsum(
+            "td,de->te", xt.astype(jnp.float32), router_kernel.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)                     # (T, E)
+        top_w, top_idx = jax.lax.top_k(probs, k)                    # (T, k)
+        top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)  # Mixtral renorm
+
+        # ---- slot assignment (slot-major priority, static shapes) ----------
+        onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)      # (T, k, E)
+        slot_major = onehot.transpose(1, 0, 2).reshape(k * t, e)    # slot 0 first
+        position = jnp.cumsum(slot_major, axis=0) - slot_major      # rank within expert
+        position = position.reshape(k, t, e).transpose(1, 0, 2)     # (T, k, E)
+        in_cap = (position < capacity).astype(jnp.float32) * onehot
+        pos_idx = (position * onehot).sum(-1).astype(jnp.int32)     # (T, k)
+
+        # dispatch (T, E, C): one-hot of (expert, slot) per routed token
+        cap_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)  # (T, k, C)
+        dispatch = jnp.einsum("tke,tkc->tec", in_cap, cap_onehot)
+        combine = jnp.einsum("tke,tkc,tk->tec", in_cap, cap_onehot, top_w)
+
+        # ---- expert compute (batched over the ep axis) ----------------------
+        compute_dtype = self.dtype
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(compute_dtype), xt.astype(compute_dtype)
+        )
+        w_gate = self.param(
+            "experts_gate", nn.initializers.lecun_normal(),
+            (e, d, self.d_ff), self.param_dtype,
+        )
+        w_up = self.param(
+            "experts_up", nn.initializers.lecun_normal(),
+            (e, d, self.d_ff), self.param_dtype,
+        )
+        w_down = self.param(
+            "experts_down", nn.initializers.lecun_normal(),
+            (e, self.d_ff, d), self.param_dtype,
+        )
+        gate = jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(compute_dtype))
+        up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(compute_dtype))
+        h = nn.silu(gate) * up
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(compute_dtype))
+
+        out = jnp.einsum(
+            "tec,ecd->td", combine.astype(compute_dtype), expert_out
+        ).reshape(b, s, d)
+
+        # ---- load-balancing aux loss (Switch eq. 4) -------------------------
+        frac_routed = onehot.sum(1).mean(0)          # f_e: fraction per expert
+        mean_prob = probs.mean(0)                    # P_e
+        aux = e * jnp.sum(frac_routed * mean_prob)
+        self.sow("moe_aux", "load_balance", aux)
+
+        return out.astype(x.dtype)
+
+
+def moe_aux_loss(collections: dict) -> jax.Array:
+    """Sum every sown load-balance term (scan stacks them per layer)."""
+    leaves = jax.tree_util.tree_leaves(collections.get("moe_aux", {}))
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(leaf) for leaf in leaves)

@@ -1,0 +1,67 @@
+"""Blockwise int4 weight quantization — the QLoRA base-weight path.
+
+BASELINE config #3 (Mistral-7B QLoRA). TPU-first design choices:
+
+- **symmetric blockwise int4**: each ``block_size`` input-dim slice of a
+  kernel column shares one bf16 scale; values live in [-7, 7] so the scale is
+  ``absmax / 7`` and zero is exact (no zero-point tensor);
+- **two nibbles per uint8** along the input dim — a quantized ``(in, out)``
+  kernel is ``(in/2, out)`` uint8 + ``(in/block, out)`` scales: ~4.25
+  bits/weight, which is what lets a 7B base fit one v5e chip's HBM next to
+  optimizer-free LoRA adapters;
+- **dequantize-then-matmul** at apply time: the unpack + scale is elementwise
+  VPU work XLA fuses into the bf16 MXU matmul's operand load. The weights
+  never exist in f32 — params are created quantized at init.
+
+Gradients: the base kernel is intentionally non-differentiable (it lives in
+``params``, the frozen collection — only the ``lora`` collection trains), so
+no straight-through estimator is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_int4(w: jax.Array, block_size: int = 64) -> tuple[jax.Array, jax.Array]:
+    """(in, out) float → (packed (in/2, out) uint8, scales (in/block, out) bf16).
+
+    ``in`` must divide by ``block_size`` and ``block_size`` must be even.
+    """
+    in_f, out_f = w.shape
+    if in_f % block_size or block_size % 2:
+        raise ValueError(f"in={in_f} must divide by even block_size={block_size}")
+    wb = w.astype(jnp.float32).reshape(in_f // block_size, block_size, out_f)
+    absmax = jnp.max(jnp.abs(wb), axis=1, keepdims=True)          # (nb, 1, out)
+    # round the scale to its stored precision BEFORE quantizing, so the
+    # round-trip error stays <= scale/2 per element
+    scales = (absmax / 7.0).astype(jnp.bfloat16).astype(jnp.float32)
+    q = jnp.clip(jnp.round(wb / jnp.maximum(scales, 1e-12)), -7, 7).astype(jnp.int8)
+    q = q.reshape(in_f, out_f)
+    # pack consecutive input-dim pairs: low nibble = even row, high = odd row
+    lo = (q[0::2] & 0x0F).astype(jnp.uint8)
+    hi = (q[1::2] & 0x0F).astype(jnp.uint8)
+    packed = (lo | (hi << 4)).astype(jnp.uint8)                   # (in/2, out)
+    return packed, scales.reshape(in_f // block_size, out_f).astype(jnp.bfloat16)
+
+
+def dequantize_int4(
+    packed: jax.Array, scales: jax.Array, *, dtype=jnp.bfloat16
+) -> jax.Array:
+    """Inverse of :func:`quantize_int4` → (in, out) in ``dtype``."""
+    half, out_f = packed.shape
+    in_f = half * 2
+    n_blocks = scales.shape[0]
+    block_size = in_f // n_blocks
+    # unpack nibbles; sign-extend 4-bit two's complement
+    lo = (packed & 0x0F).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    q = jnp.stack([lo, hi], axis=1).reshape(in_f, out_f)          # interleave
+    qb = q.reshape(n_blocks, block_size, out_f).astype(jnp.float32)
+    w = qb * scales[:, None, :].astype(jnp.float32)
+    return w.reshape(in_f, out_f).astype(dtype)
+
+

@@ -78,9 +78,11 @@ def causal_attention(
             ) from e
         return flash_attention(q, k, v, segment_ids=segment_ids)
     if impl == "ring":
-        raise NotImplementedError(
-            "attention impl='ring' (sequence-parallel ring attention) is "
-            "selected via the trainer's sp mesh axis, not per-call; use "
-            "impl='xla' here"
-        )
+        from ..parallel.ring import get_ring_mesh, ring_attention_sharded
+
+        mesh = get_ring_mesh()
+        if mesh is None or mesh.shape.get("sp", 1) == 1:
+            # no sp axis active: plain attention is both correct and faster
+            return xla_causal_attention(q, k, v, segment_ids=segment_ids)
+        return ring_attention_sharded(q, k, v, segment_ids=segment_ids, mesh=mesh)
     raise ValueError(f"unknown attention impl: {impl!r}")

@@ -1,0 +1,7 @@
+"""Hand-written TPU Pallas kernels for the ops where XLA's defaults lose.
+
+Benchmark-first policy (SURVEY.md §7: 'benchmark first, hand-write second'):
+each kernel here exists because it beats (or bounds the memory of) the XLA
+path at the BASELINE.md shapes. Everything runs in interpreter mode on CPU so
+the test suite exercises kernel logic without TPU hardware.
+"""

@@ -1,0 +1,201 @@
+"""FlashAttention-2-style causal GQA attention as a Pallas TPU kernel.
+
+Why hand-write this (the reference delegates all kernels to the user's CUDA
+image — SURVEY.md §2.2): the XLA path materialises the (S, S) score matrix in
+HBM per head; this kernel streams K/V blocks through VMEM with an online
+softmax, so activation memory is O(S · D) instead of O(S²) and the matmuls
+stay on the MXU at (block_q × head_dim) × (head_dim × block_k) tiles.
+
+Layout: grid = (batch, q_heads, S / block_q); each instance holds one query
+block in VMEM and loops over that head's K/V blocks up to the causal
+frontier. GQA is handled in the index map (q head h reads kv head
+h // group_size), so no K/V duplication ever happens.
+
+Differentiation: the backward pass recomputes attention with the XLA
+reference implementation under ``jax.custom_vjp`` — forward gets the fused
+kernel + O(S·D) residuals; a fused Pallas backward is a later optimisation.
+
+Runs in interpreter mode off-TPU so CPU CI exercises the same kernel logic
+(SURVEY.md §4 test strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _flash_kernel(
+    q_ref,      # (1, 1, bq, d)
+    k_ref,      # (1, 1, S, d)   — this q-head's kv head
+    v_ref,      # (1, 1, S, d)
+    qseg_ref,   # (1, bq)
+    kseg_ref,   # (1, S)
+    o_ref,      # (1, 1, bq, d)
+    *,
+    block_k: int,
+    seq_len: int,
+    scale: float,
+):
+    iq = pl.program_id(2)
+    bq = q_ref.shape[2]
+    d = q_ref.shape[3]
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+    qseg = qseg_ref[0]                                   # (bq,)
+    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    num_kv = pl.cdiv(seq_len, block_k)
+    # causal frontier: kv block j is needed iff j*block_k <= last q position
+    last_q = (iq + 1) * bq - 1
+    needed = last_q // block_k + 1
+
+    def body(j, carry):
+        acc, m, l = carry
+        start = j * block_k
+        k = k_ref[0, 0, pl.ds(start, block_k), :].astype(jnp.float32)  # (bk, d)
+        v = v_ref[0, 0, pl.ds(start, block_k), :].astype(jnp.float32)
+        kseg = kseg_ref[0, pl.ds(start, block_k)]                      # (bk,)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        k_pos = start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        mask = q_pos >= k_pos
+        mask &= k_pos < seq_len  # tail block: beyond-S lanes are padding
+        mask &= qseg[:, None] == kseg[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))     # (bq, 1)
+        p = jnp.exp(s - m_new)                                          # (bq, bk)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, jnp.minimum(needed, num_kv), body, (acc0, m0, l0))
+
+    # fully-masked rows (padding segments) have l == 0: emit zeros, not NaN
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array,           # (B, S, H, D)
+    k: jax.Array,           # (B, S, Hkv, D)
+    v: jax.Array,
+    segment_ids: jax.Array,  # (B, S) int32
+    *,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    import math
+
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    scale = d ** -0.5
+
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    # pad S to a common block multiple: pl.ds/dynamic_slice CLAMP
+    # out-of-bounds starts, which would silently read the wrong K rows on a
+    # ragged tail block. Padded keys are masked via k_pos >= seq_len; padded
+    # query rows are sliced away below.
+    s_pad = math.lcm(bq, bk) * pl.cdiv(s, math.lcm(bq, bk))
+    if s_pad != s:
+        pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        segment_ids = jnp.pad(segment_ids, [(0, 0), (0, s_pad - s)])
+
+    # (B, H, S, D) — heads on the grid, sequence contiguous for tiling
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, h, pl.cdiv(s_pad, bq))
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_k=bk, seq_len=s, scale=scale
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, s_pad, d), lambda ib, ih, iq: (ib, ih // group, 0, 0)),
+            pl.BlockSpec((1, 1, s_pad, d), lambda ib, ih, iq: (ib, ih // group, 0, 0)),
+            pl.BlockSpec((1, bq), lambda ib, ih, iq: (ib, iq)),
+            pl.BlockSpec((1, s_pad), lambda ib, ih, iq: (ib, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, segment_ids, segment_ids)
+
+    return out.transpose(0, 2, 1, 3)[:, :s]  # back to (B, S, H, D), unpadded
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention(q, k, v, segment_ids, block_q, block_k, interpret):
+    return _flash_forward(
+        q, k, v, segment_ids,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret):
+    out = _flash_attention(q, k, v, segment_ids, block_q, block_k, interpret)
+    return out, (q, k, v, segment_ids)
+
+
+def _flash_bwd(block_q, block_k, interpret, residuals, g):
+    # rematerialised backward through the XLA reference path — activation
+    # memory during bwd is per-layer transient, forward residuals stay O(S·D)
+    from ..attention import xla_causal_attention
+
+    q, k, v, segment_ids = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: xla_causal_attention(q_, k_, v_, segment_ids=segment_ids),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    segment_ids: jax.Array | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal GQA flash attention. Shapes as ``ops.attention.causal_attention``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, _, _ = q.shape
+    if segment_ids is None:
+        segment_ids = jnp.zeros((b, s), jnp.int32)
+    return _flash_attention(
+        q, k, v, segment_ids.astype(jnp.int32), block_q, block_k, interpret
+    )

@@ -69,16 +69,22 @@ LLAMA_RULES = PartitionRules(
         (r"embed_tokens/embedding", P(Ax.TENSOR, Ax.FSDP)),
         # lm head kernel: (d_model, vocab)
         (r"lm_head/kernel", P(Ax.FSDP, Ax.TENSOR)),
-        # attention projections
+        # QLoRA int4 scales: (in/block, out) — the block dim is tiny, keep it
+        # whole and shard only the feature dim (must precede the kernel rules,
+        # which would otherwise also match "kernel_scales")
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel_scales", P(None, Ax.TENSOR)),
+        (r"(o_proj|down_proj)/kernel_scales", P(None, Ax.FSDP)),
+        # attention projections (kernel and int4-packed kernel share layout)
         (r"(q_proj|k_proj|v_proj)/kernel", P(Ax.FSDP, Ax.TENSOR)),
         (r"o_proj/kernel", P(Ax.TENSOR, Ax.FSDP)),
         # MLP
         (r"(gate_proj|up_proj)/kernel", P(Ax.FSDP, Ax.TENSOR)),
         (r"down_proj/kernel", P(Ax.TENSOR, Ax.FSDP)),
-        # MoE experts: (n_experts, in, out) with experts over EP
-        (r"experts/(gate_proj|up_proj)/kernel", P(Ax.EXPERT, Ax.FSDP, Ax.TENSOR)),
-        (r"experts/down_proj/kernel", P(Ax.EXPERT, Ax.TENSOR, Ax.FSDP)),
-        (r"router/kernel", P(Ax.FSDP, None)),
+        # MoE experts (models/moe.py): stacked (n_experts, in, out), experts
+        # over EP so expert matmuls are local and token exchange is all-to-all
+        (r"experts_(gate|up)", P(Ax.EXPERT, Ax.FSDP, Ax.TENSOR)),
+        (r"experts_down", P(Ax.EXPERT, Ax.TENSOR, Ax.FSDP)),
+        (r"router_kernel", P(Ax.FSDP, None)),
         # LoRA adapters: A (in, r) sharded like the frozen kernel's input dim;
         # B (r, out) over the output dim.  Rank r is tiny — keep it replicated.
         (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_a", P(Ax.FSDP, None)),

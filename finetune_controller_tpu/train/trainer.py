@@ -87,9 +87,15 @@ class Trainer:
         mesh: Mesh | None = None,
         rules: PartitionRules = LLAMA_RULES,
     ):
-        self.model_cfg = model_cfg
         self.cfg = train_cfg
         self.mesh = mesh if mesh is not None else MeshSpec(fsdp=1).build(jax.devices()[:1])
+        if self.mesh.shape.get("sp", 1) > 1 and model_cfg.attention_impl == "xla":
+            # an active sp axis means the sequence is sharded: attention must
+            # go through the ring path or XLA would all-gather S every layer
+            logger.info("sp=%d mesh axis active: attention_impl -> ring",
+                        self.mesh.shape["sp"])
+            model_cfg = model_cfg.replace(attention_impl="ring")
+        self.model_cfg = model_cfg
         self.rules = rules
         self.model = LlamaForCausalLM(model_cfg)
         self.tx, self.sched = build_optimizer(
@@ -110,6 +116,9 @@ class Trainer:
     def _split(self, variables: FrozenDict) -> tuple[Any, Any]:
         """(frozen, trainable) per the training mode."""
         variables = dict(variables)
+        # drop the init-time sown aux collection: re-feeding it to apply would
+        # make flax append to the stale tuple and double-count the MoE aux loss
+        variables.pop("moe_aux", None)
         if self.cfg.mode == "lora":
             if "lora" not in variables:
                 raise ValueError("mode='lora' but the model has no LoRA params; set lora.rank > 0")
@@ -126,7 +135,13 @@ class Trainer:
         return out
 
     def _raw_init(self, rng: jax.Array) -> TrainState:
-        tokens = jnp.zeros((1, 8), jnp.int32)
+        import math
+
+        # dummy init batch must be divisible over the batch and sp axes (ring
+        # attention shards the sequence even at init trace time)
+        b0 = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
+        s0 = math.lcm(8, self.mesh.shape.get("sp", 1))
+        tokens = jnp.zeros((b0, s0), jnp.int32)
         variables = self.model.init({"params": rng}, tokens)
         frozen, trainable = self._split(variables)
         opt_state = self.tx.init(trainable)
@@ -160,14 +175,27 @@ class Trainer:
     def _loss_fn(self, trainable, frozen, batch, dropout_rng):
         variables = self._assemble(frozen, trainable)
         rngs = {"dropout": dropout_rng} if self._use_dropout else None
-        logits = self.model.apply(
-            variables,
-            batch["tokens"],
+        apply_kw: dict[str, Any] = dict(
             segment_ids=batch.get("segment_ids"),
             deterministic=not self._use_dropout,
             rngs=rngs,
         )
-        return next_token_loss(logits, batch["tokens"], batch.get("loss_mask"))
+        if self.model_cfg.n_experts:
+            logits, collections = self.model.apply(
+                variables, batch["tokens"], mutable=("moe_aux",), **apply_kw
+            )
+            from ..models.moe import moe_aux_loss
+
+            aux_penalty = self.model_cfg.router_aux_weight * moe_aux_loss(collections)
+        else:
+            logits = self.model.apply(variables, batch["tokens"], **apply_kw)
+            aux_penalty = 0.0
+        loss, metrics = next_token_loss(
+            logits, batch["tokens"], batch.get("loss_mask")
+        )
+        if self.model_cfg.n_experts:
+            metrics = dict(metrics, moe_aux=aux_penalty)
+        return loss + aux_penalty, metrics
 
     def _train_step(self, state: TrainState, batch: dict):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), state.step)
@@ -188,12 +216,17 @@ class Trainer:
     # ---- host-side API ---------------------------------------------------
 
     def init_state(self) -> TrainState:
-        with self.mesh:
+        from ..parallel.ring import ring_mesh
+
+        with self.mesh, ring_mesh(self.mesh):
             return self._init_jit(jax.random.PRNGKey(self.cfg.seed))
 
     def step(self, state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        from ..parallel.ring import ring_mesh
+
         batch = self._shard_batch(batch)
-        with self.mesh:
+        # ring_mesh only matters at trace time (first call); harmless after
+        with self.mesh, ring_mesh(self.mesh):
             return self._step_jit(state, batch)
 
     @property

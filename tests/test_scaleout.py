@@ -1,0 +1,234 @@
+"""Tests for scale-out compute: Pallas flash attention, ring attention (SP),
+MoE expert parallelism — the strategies SURVEY.md §2.3 lists as greenfield
+obligations (SP/CP, EP) plus the hand-written kernel path.
+
+All run on the 8-virtual-device CPU mesh (Pallas in interpreter mode).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finetune_controller_tpu.models.llama import PRESETS, LlamaForCausalLM
+from finetune_controller_tpu.ops.attention import causal_attention, xla_causal_attention
+from finetune_controller_tpu.ops.pallas.flash_attention import flash_attention
+from finetune_controller_tpu.parallel.mesh import MeshSpec
+from finetune_controller_tpu.parallel.ring import ring_attention_sharded, ring_mesh
+from finetune_controller_tpu.parallel.sharding import LLAMA_RULES
+
+
+def _qkv(b=2, s=64, h=4, hkv=2, d=16, dtype=jnp.float32):
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d), dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d), dtype)
+    return q, k, v
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+
+
+def test_flash_attention_matches_xla():
+    q, k, v = _qkv()
+    seg = (jnp.arange(64)[None, :] // 32).astype(jnp.int32).repeat(2, 0)
+    ref = xla_causal_attention(q, k, v, segment_ids=seg)
+    out = flash_attention(q, k, v, segment_ids=seg, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_flash_attention_grads_match_xla():
+    q, k, v = _qkv(s=32)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, block_q=8, block_k=8) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (xla_causal_attention(q, k, v) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+def test_flash_attention_uneven_blocks():
+    # S=48 with block 32: remainder block exercises the causal frontier math
+    q, k, v = _qkv(s=48)
+    ref = xla_causal_attention(q, k, v)
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_dispatcher_pallas_path():
+    q, k, v = _qkv(s=32)
+    out = causal_attention(q, k, v, impl="pallas")
+    ref = causal_attention(q, k, v, impl="xla")
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence/context parallelism)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_attention_matches_xla(devices8):
+    mesh = MeshSpec(dp=2, fsdp=1, sp=4).build(devices8)
+    q, k, v = _qkv(b=4, s=64)
+    seg = (jnp.arange(64)[None, :] // 16).astype(jnp.int32).repeat(4, 0)
+    ref = xla_causal_attention(q, k, v, segment_ids=seg)
+    out = ring_attention_sharded(q, k, v, segment_ids=seg, mesh=mesh)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_ring_attention_grads(devices8):
+    mesh = MeshSpec(dp=1, fsdp=2, sp=4).build(devices8)
+    q, k, v = _qkv(b=2, s=32)
+
+    g1 = jax.grad(
+        lambda q, k, v: (ring_attention_sharded(q, k, v, mesh=mesh) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: (xla_causal_attention(q, k, v) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+def test_ring_dispatch_through_model_config(devices8):
+    """attention_impl='ring' + installed mesh flows through a full model."""
+    mesh = MeshSpec(dp=1, fsdp=2, sp=4).build(devices8)
+    cfg = PRESETS["tiny-test"].replace(attention_impl="ring", remat=False)
+    model = LlamaForCausalLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, cfg.vocab_size)
+    variables = model.init({"params": jax.random.PRNGKey(1)}, tokens)
+    with ring_mesh(mesh):
+        logits_ring = model.apply(variables, tokens)
+    logits_ref = model.apply(
+        variables, tokens,
+    )  # without mesh installed the ring impl falls back to plain attention
+    # bf16 compute: ring and dense paths differ by accumulation order only
+    np.testing.assert_allclose(logits_ring, logits_ref, atol=0.15)
+
+
+# ---------------------------------------------------------------------------
+# MoE expert parallelism
+# ---------------------------------------------------------------------------
+
+
+def test_moe_model_forward_and_aux():
+    cfg = PRESETS["tiny-moe-test"].replace(remat=False)
+    model = LlamaForCausalLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    variables = model.init({"params": jax.random.PRNGKey(1)}, tokens)
+    logits, collections = model.apply(tokens=tokens, variables=variables, mutable=("moe_aux",))
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    from finetune_controller_tpu.models.moe import moe_aux_loss
+
+    aux = moe_aux_loss(collections)
+    # Switch aux loss is >= 1 (equals 1 at perfectly uniform routing)
+    assert float(aux) >= 0.9 * cfg.n_layers
+
+
+def test_moe_params_have_expert_axis_sharding(devices8):
+    mesh = MeshSpec(dp=1, fsdp=2, ep=4).build(devices8)
+    cfg = PRESETS["tiny-moe-test"].replace(remat=False)
+    model = LlamaForCausalLM(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    shapes = jax.eval_shape(lambda: model.init({"params": jax.random.PRNGKey(0)}, tokens))
+    shardings = LLAMA_RULES.tree_specs(shapes)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in kp): spec
+        for kp, spec in jax.tree_util.tree_flatten_with_path(
+            shardings, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+        )[0]
+    }
+    gate_specs = [s for p, s in flat.items() if "experts_gate" in p]
+    assert gate_specs, flat.keys()
+    # leading layer-scan axis is None, then experts over 'ep'
+    assert all(s[1] == "ep" or s[0] == "ep" for s in gate_specs), gate_specs
+
+
+def test_moe_trains_end_to_end(devices8):
+    """Full trainer loop on the MoE preset over an ep mesh — loss decreases."""
+    from finetune_controller_tpu.data.synthetic import synthetic_batches
+    from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+    mesh = MeshSpec(dp=1, fsdp=2, ep=4).build(devices8)
+    cfg = PRESETS["tiny-moe-test"]
+    tcfg = TrainConfig(
+        mode="full", learning_rate=5e-2, warmup_steps=2, total_steps=12,
+        batch_size=8, seq_len=16, log_every=4, checkpoint_every=1000,
+    )
+    trainer = Trainer(cfg.replace(lora=cfg.lora), tcfg, mesh=mesh)
+    batches = synthetic_batches(
+        batch_size=tcfg.batch_size, seq_len=tcfg.seq_len,
+        vocab_size=cfg.vocab_size, task="increment", seed=0,
+    )
+    state = trainer.init_state()
+    losses = []
+    it = iter(batches)
+    for _ in range(tcfg.total_steps):
+        state, metrics = trainer.step(state, next(it))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses
+    assert "moe_aux" in metrics
+
+# ---------------------------------------------------------------------------
+# int4 QLoRA
+# ---------------------------------------------------------------------------
+
+
+def test_int4_quantization_roundtrip():
+    from finetune_controller_tpu.models.quant import dequantize_int4, quantize_int4
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (128, 32), jnp.float32) * 0.1
+    packed, scales = quantize_int4(w, block_size=64)
+    assert packed.shape == (64, 32) and packed.dtype == jnp.uint8
+    assert scales.shape == (2, 32)
+    deq = dequantize_int4(packed, scales, dtype=jnp.float32)
+    # int4 with blockwise scales: relative error bounded by scale/2 per element
+    err = np.abs(np.asarray(deq - w))
+    bound = np.asarray(scales, np.float32).repeat(64, axis=0) * 0.51
+    assert (err <= bound + 1e-6).all()
+    # memory: ~4.25 bits/weight
+    nbytes = packed.nbytes + scales.nbytes
+    assert nbytes < w.nbytes / 6
+
+
+def test_qlora_model_trains_and_shrinks_memory(devices8):
+    from finetune_controller_tpu.data.synthetic import synthetic_batches
+    from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+    from finetune_controller_tpu.models.lora import LoRAConfig
+
+    cfg = PRESETS["tiny-test"].replace(
+        quantize_base=True, lora=LoRAConfig(rank=8), remat=False
+    )
+    tcfg = TrainConfig(
+        mode="lora", learning_rate=1e-1, warmup_steps=2, total_steps=25,
+        batch_size=8, seq_len=16, log_every=5, checkpoint_every=1000,
+    )
+    mesh = MeshSpec(dp=1, fsdp=2, tp=2).build(devices8[:4])
+    trainer = Trainer(cfg, tcfg, mesh=mesh)
+    state = trainer.init_state()
+    # frozen projection kernels are stored packed uint8
+    flat = jax.tree_util.tree_flatten_with_path(state.frozen)[0]
+    packed = [v for kp, v in flat if "kernel_packed" in str(kp)]
+    assert packed and all(v.dtype == jnp.uint8 for v in packed)
+    assert not [kp for kp, _ in flat
+                if str(kp).endswith("q_proj'], key='kernel')")]
+    batches = synthetic_batches(
+        batch_size=8, seq_len=16, vocab_size=cfg.vocab_size, task="increment",
+        seed=0,
+    )
+    it = iter(batches)
+    losses = []
+    for _ in range(25):
+        state, metrics = trainer.step(state, next(it))
+        losses.append(float(metrics["loss"]))
+    # compare window means: single steps are noisy at toy scale, and rank-8
+    # adapters on a frozen random base move the loss slowly
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, losses
