@@ -139,8 +139,20 @@ def jsonl_token_batches(
     shard_index: int = 0,
     shard_count: int = 1,
 ) -> Iterator[dict]:
-    docs = load_token_documents(path, tokenizer_file)
-    tokens, segments = pack_documents(docs, seq_len)
+    tokens = segments = None
+    if tokenizer_file is None and path.endswith(".jsonl"):
+        # native C++ parse+tokenize+pack hot path (data/native_loader.py);
+        # byte-parity with the Python path, gate with FTC_NATIVE=0
+        from .native_loader import pack_jsonl_native
+
+        # malformed datasets raise ValueError — same contract as the Python path
+        packed = pack_jsonl_native(path, seq_len)
+        if packed is not None:
+            tokens, segments = packed
+            logger.debug("native packer produced %d blocks", tokens.shape[0])
+    if tokens is None:
+        docs = load_token_documents(path, tokenizer_file)
+        tokens, segments = pack_documents(docs, seq_len)
     return batches_from_tokens(
         tokens, segments, batch_size, seed=seed,
         shard_index=shard_index, shard_count=shard_count,

@@ -1,0 +1,88 @@
+"""ctypes bindings for the native JSONL packer (``native/packer.cc``).
+
+The parse+tokenize+pack hot path runs in C++ (~order-of-magnitude over the
+Python loop on large corpora); shuffling and batch assembly stay in
+``data.loader`` (numpy, already fast). Output parity with
+``loader.load_token_documents`` + ``loader.pack_documents`` for byte-level
+tokenization is enforced by tests; rows needing a real tokenizer file keep
+using the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if os.environ.get("FTC_NATIVE", "1").lower() in ("0", "false", "no"):
+            _lib_failed = True
+            return None
+        from ..native.build import ensure_built
+
+        path = ensure_built()
+        if path is None:
+            _lib_failed = True
+            return None
+        lib = ctypes.CDLL(str(path))
+        lib.ftc_pack_file.restype = ctypes.c_int64
+        lib.ftc_pack_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p)
+        ]
+        lib.ftc_copy_packed.restype = ctypes.c_int32
+        lib.ftc_copy_packed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ftc_last_error.restype = ctypes.c_char_p
+        lib.ftc_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def pack_jsonl_native(path: str, seq_len: int) -> tuple[np.ndarray, np.ndarray] | None:
+    """Native parse+tokenize+pack; None when the library is unavailable.
+
+    Raises ValueError on malformed datasets (same contract as the Python
+    loader).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    handle = ctypes.c_void_p()
+    n_blocks = lib.ftc_pack_file(path.encode(), seq_len, ctypes.byref(handle))
+    if n_blocks < 0:
+        err = lib.ftc_last_error().decode(errors="replace")
+        raise ValueError(f"native packer failed for {path}: {err}")
+    try:
+        tokens = np.empty((n_blocks, seq_len), np.int32)
+        segments = np.empty((n_blocks, seq_len), np.int32)
+        rc = lib.ftc_copy_packed(
+            handle,
+            tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            segments.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc != 0:
+            raise ValueError("native packer copy failed")
+        return tokens, segments
+    finally:
+        lib.ftc_free(handle)

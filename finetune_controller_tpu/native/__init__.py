@@ -1,0 +1,5 @@
+"""Native (C++) runtime components, built on demand with the system toolchain.
+
+See :mod:`finetune_controller_tpu.native.build` for the build entry point and
+:mod:`finetune_controller_tpu.data.native_loader` for the ctypes bindings.
+"""

@@ -1,0 +1,69 @@
+"""Build the native shared library with the system C++ toolchain.
+
+No pybind11 in the image (build brief), so the ABI is plain C consumed via
+ctypes; no cmake project needed for a single translation unit — one g++
+invocation, cached next to the source and rebuilt when the source changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+SRC = Path(__file__).with_name("packer.cc")
+
+
+def _cache_dir() -> Path:
+    root = os.environ.get("FTC_NATIVE_CACHE", "")
+    base = Path(root) if root else Path.home() / ".cache" / "finetune_controller_tpu"
+    base.mkdir(parents=True, exist_ok=True)
+    return base
+
+
+def lib_path() -> Path:
+    digest = hashlib.sha256(SRC.read_bytes()).hexdigest()[:16]
+    return _cache_dir() / f"_ftc_native_{digest}.so"
+
+
+def compiler() -> str | None:
+    for cc in (os.environ.get("CXX"), "g++", "clang++", "c++"):
+        if cc and shutil.which(cc):
+            return cc
+    return None
+
+
+def ensure_built(*, quiet: bool = True) -> Path | None:
+    """Compile (once per source hash) and return the .so path; None when no
+    toolchain is available — callers fall back to the pure-Python path."""
+    out = lib_path()
+    if out.exists():
+        return out
+    cc = compiler()
+    if cc is None:
+        if not quiet:
+            logger.warning("no C++ compiler found; native loader disabled")
+        return None
+    # compile to a process-unique temp path, then atomically rename: two
+    # concurrent cold-cache builds must never leave a half-written .so where
+    # another process will dlopen it
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
+    cmd = [
+        cc, "-O3", "-std=c++17", "-shared", "-fPIC",
+        str(SRC), "-o", str(tmp),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    except subprocess.CalledProcessError as e:
+        logger.warning("native build failed (%s); falling back to Python:\n%s",
+                       " ".join(cmd), e.stderr[-2000:])
+        tmp.unlink(missing_ok=True)
+        return None
+    logger.info("built native library: %s", out)
+    return out

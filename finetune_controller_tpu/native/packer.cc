@@ -1,0 +1,299 @@
+// Native JSONL → packed-token pipeline (the data-loader hot path).
+//
+// The reference delegates all native concerns to external systems (SURVEY.md
+// §2.2 — "no C++/Rust/CUDA code in-repo"); this framework keeps the training
+// loop in JAX and the IO-bound preprocessing here: parse a JSONL dataset,
+// tokenize "text" rows byte-level (exact parity with
+// finetune_controller_tpu/data/loader.py::_byte_tokenize, including \uXXXX
+// escapes decoded to UTF-8), accept pre-tokenized "tokens" rows, and pack
+// everything into (n_blocks, seq_len) int32 token/segment arrays with
+// per-document segment ids.
+//
+// Exposed as a tiny C ABI for ctypes (no pybind11 in the image):
+//   ftc_pack_file(path, seq_len, &handle)  -> n_blocks (<0 = error code)
+//   ftc_copy_packed(handle, tokens, segs)  -> 0 on success
+//   ftc_last_error()                       -> static error string
+//   ftc_free(handle)
+//
+// Build: finetune_controller_tpu/native/build.py (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Packed {
+  std::vector<int32_t> tokens;
+  std::vector<int32_t> segments;
+  int64_t n_blocks = 0;
+  int64_t seq_len = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Minimal JSON value scanning (only what the row schema needs)
+// ---------------------------------------------------------------------------
+
+void append_utf8(std::string* out, uint32_t cp) {
+  if (cp < 0x80) {
+    out->push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+int hex_val(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+// Parse the JSON string starting at s[i] == '"'; returns decoded UTF-8 bytes
+// and advances i past the closing quote. False on malformed input.
+bool parse_json_string(const std::string& s, size_t* i, std::string* out) {
+  if (s[*i] != '"') return false;
+  ++*i;
+  out->clear();
+  while (*i < s.size()) {
+    char c = s[*i];
+    if (c == '"') {
+      ++*i;
+      return true;
+    }
+    if (c == '\\') {
+      if (*i + 1 >= s.size()) return false;
+      char e = s[*i + 1];
+      *i += 2;
+      switch (e) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          if (*i + 4 > s.size()) return false;
+          uint32_t cp = 0;
+          for (int k = 0; k < 4; ++k) {
+            int h = hex_val(s[*i + k]);
+            if (h < 0) return false;
+            cp = (cp << 4) | static_cast<uint32_t>(h);
+          }
+          *i += 4;
+          if (cp >= 0xD800 && cp <= 0xDBFF && *i + 6 <= s.size() &&
+              s[*i] == '\\' && s[*i + 1] == 'u') {
+            uint32_t lo = 0;
+            bool ok = true;
+            for (int k = 0; k < 4; ++k) {
+              int h = hex_val(s[*i + 2 + k]);
+              if (h < 0) { ok = false; break; }
+              lo = (lo << 4) | static_cast<uint32_t>(h);
+            }
+            if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              *i += 6;
+            }
+          }
+          append_utf8(out, cp);
+          break;
+        }
+        default:
+          return false;
+      }
+      continue;
+    }
+    out->push_back(c);
+    ++*i;
+  }
+  return false;  // unterminated
+}
+
+// Find `"key"` at the object TOP LEVEL only and return the index just past
+// the ':'. Depth is tracked so nested objects/arrays can't shadow the row
+// schema (parity with the Python loader's `"tokens" in row` check, which is
+// top-level dict membership).
+bool find_key(const std::string& s, const char* key, size_t* value_start) {
+  size_t i = 0;
+  int depth = 0;
+  while (i < s.size()) {
+    char c = s[i];
+    if (c == '{' || c == '[') {
+      ++depth;
+      ++i;
+      continue;
+    }
+    if (c == '}' || c == ']') {
+      --depth;
+      ++i;
+      continue;
+    }
+    if (c == '"') {
+      std::string tmp;
+      if (!parse_json_string(s, &i, &tmp)) return false;
+      if (depth == 1 && tmp == key) {
+        while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) ++i;
+        if (i < s.size() && s[i] == ':') {
+          *value_start = i + 1;
+          return true;
+        }
+      }
+      continue;
+    }
+    ++i;
+  }
+  return false;
+}
+
+bool parse_int_array(const std::string& s, size_t i, std::vector<int32_t>* out) {
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) ++i;
+  if (i >= s.size() || s[i] != '[') return false;
+  ++i;
+  out->clear();
+  while (i < s.size()) {
+    while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == ',')) ++i;
+    if (i < s.size() && s[i] == ']') return true;
+    bool neg = false;
+    if (i < s.size() && s[i] == '-') { neg = true; ++i; }
+    if (i >= s.size() || s[i] < '0' || s[i] > '9') return false;
+    int64_t v = 0;
+    while (i < s.size() && s[i] >= '0' && s[i] <= '9') {
+      v = v * 10 + (s[i] - '0');
+      ++i;
+    }
+    out->push_back(static_cast<int32_t>(neg ? -v : v));
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* ftc_last_error() { return g_error.c_str(); }
+
+// Returns n_blocks >= 1 on success and sets *out_handle; negative on error.
+int64_t ftc_pack_file(const char* path, int64_t seq_len, void** out_handle) {
+  g_error.clear();
+  if (seq_len <= 0) {
+    g_error = "seq_len must be positive";
+    return -1;
+  }
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    g_error = std::string("cannot open ") + path;
+    return -2;
+  }
+  auto* packed = new Packed();
+  packed->seq_len = seq_len;
+  std::vector<int32_t>& stream = packed->tokens;
+  std::vector<int32_t>& segs = packed->segments;
+
+  std::string line;
+  std::vector<int32_t> tok_buf;
+  std::string text_buf;
+  int32_t doc_id = 0;
+  char buf[1 << 16];
+  line.reserve(1 << 16);
+  bool pending = false;
+  auto flush_doc = [&](const std::vector<int32_t>& toks) {
+    ++doc_id;
+    stream.insert(stream.end(), toks.begin(), toks.end());
+    segs.insert(segs.end(), toks.size(), doc_id);
+  };
+  auto process_line = [&]() -> bool {
+    // trim
+    size_t b = line.find_first_not_of(" \t\r\n");
+    if (b == std::string::npos) return true;
+    size_t e = line.find_last_not_of(" \t\r\n");
+    std::string row = line.substr(b, e - b + 1);
+    size_t vi = 0;
+    if (find_key(row, "tokens", &vi)) {
+      if (!parse_int_array(row, vi, &tok_buf)) {
+        g_error = "malformed 'tokens' array: " + row.substr(0, 80);
+        return false;
+      }
+      flush_doc(tok_buf);
+      return true;
+    }
+    if (find_key(row, "text", &vi)) {
+      while (vi < row.size() && (row[vi] == ' ' || row[vi] == '\t')) ++vi;
+      if (!parse_json_string(row, &vi, &text_buf)) {
+        g_error = "malformed 'text' string: " + row.substr(0, 80);
+        return false;
+      }
+      tok_buf.clear();
+      tok_buf.reserve(text_buf.size());
+      for (unsigned char ch : text_buf) tok_buf.push_back(ch);
+      flush_doc(tok_buf);
+      return true;
+    }
+    g_error = "jsonl rows must have a 'tokens' or 'text' field";
+    return false;
+  };
+
+  while (std::fgets(buf, sizeof(buf), f)) {
+    line.append(buf);
+    pending = true;
+    if (!line.empty() && line.back() == '\n') {
+      if (!process_line()) {
+        std::fclose(f);
+        delete packed;
+        return -3;
+      }
+      line.clear();
+      pending = false;
+    }
+  }
+  std::fclose(f);
+  if (pending && !process_line()) {
+    delete packed;
+    return -3;
+  }
+  if (doc_id == 0) {
+    g_error = "no documents found";
+    delete packed;
+    return -4;
+  }
+
+  // block math identical to data/loader.py::pack_documents
+  int64_t n_blocks = static_cast<int64_t>(stream.size()) / seq_len;
+  if (n_blocks < 1) n_blocks = 1;
+  if (static_cast<int64_t>(stream.size()) < seq_len) {
+    stream.resize(seq_len, 0);
+    segs.resize(seq_len, 0);
+  }
+  stream.resize(n_blocks * seq_len);
+  segs.resize(n_blocks * seq_len);
+  packed->n_blocks = n_blocks;
+  *out_handle = packed;
+  return n_blocks;
+}
+
+int32_t ftc_copy_packed(void* handle, int32_t* tokens, int32_t* segments) {
+  auto* p = static_cast<Packed*>(handle);
+  if (!p) return -1;
+  std::memcpy(tokens, p->tokens.data(), p->tokens.size() * sizeof(int32_t));
+  std::memcpy(segments, p->segments.data(), p->segments.size() * sizeof(int32_t));
+  return 0;
+}
+
+void ftc_free(void* handle) { delete static_cast<Packed*>(handle); }
+
+}  // extern "C"

@@ -1,0 +1,114 @@
+"""Native C++ packer: build, parity with the Python loader, error contract.
+
+The reference keeps zero native code in-repo (SURVEY.md §2.2); this framework
+owns its data-path hot loop in C++ — these tests pin byte-exact parity
+between the two implementations so the native path can never silently drift.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from finetune_controller_tpu.data.loader import (
+    jsonl_token_batches,
+    load_token_documents,
+    pack_documents,
+)
+from finetune_controller_tpu.data.native_loader import available, pack_jsonl_native
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="no C++ toolchain available for the native loader"
+)
+
+
+TRICKY_ROWS = [
+    {"text": "plain ascii text"},
+    {"text": 'quotes " and \\ backslashes \\" mixed'},
+    {"text": "tabs\tnewlines\nand\rcontrol \b\f chars"},
+    {"text": "unicodé café ♞ \U0001f600 mixed"},
+    {"tokens": [1, 2, 3, 500, 65535, 0]},
+    {"text": ""},
+    {"text": "x" * 300},
+]
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def test_native_pack_parity_with_python(tmp_path):
+    p = tmp_path / "data.jsonl"
+    _write_jsonl(p, TRICKY_ROWS)
+    for seq_len in (16, 64, 1024):
+        docs = load_token_documents(str(p))
+        py_tokens, py_segs = pack_documents(docs, seq_len)
+        nat = pack_jsonl_native(str(p), seq_len)
+        assert nat is not None
+        np.testing.assert_array_equal(nat[0], py_tokens)
+        np.testing.assert_array_equal(nat[1], py_segs)
+
+
+def test_native_pack_parity_ensure_ascii_false(tmp_path):
+    # raw (non-escaped) UTF-8 in the file
+    p = tmp_path / "raw.jsonl"
+    with open(p, "w") as f:
+        for row in [{"text": "café ♞ emoji 😀"}, {"text": "δοκιμή"}]:
+            f.write(json.dumps(row, ensure_ascii=False) + "\n")
+    docs = load_token_documents(str(p))
+    py_tokens, py_segs = pack_documents(docs, 32)
+    nat = pack_jsonl_native(str(p), 32)
+    np.testing.assert_array_equal(nat[0], py_tokens)
+    np.testing.assert_array_equal(nat[1], py_segs)
+
+
+def test_native_error_contract(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"neither": 1}\n')
+    with pytest.raises(ValueError):
+        pack_jsonl_native(str(p), 16)
+    missing = tmp_path / "nope.jsonl"
+    with pytest.raises(ValueError):
+        pack_jsonl_native(str(missing), 16)
+
+
+def test_jsonl_token_batches_uses_native(tmp_path, caplog):
+    p = tmp_path / "data.jsonl"
+    _write_jsonl(p, [{"text": "hello world, a training document"}] * 8)
+    it = jsonl_token_batches(str(p), batch_size=2, seq_len=16)
+    batch = next(it)
+    assert batch["tokens"].shape == (2, 16)
+    assert batch["segment_ids"].shape == (2, 16)
+    assert (batch["loss_mask"] <= 1).all()
+
+
+def test_native_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("FTC_NATIVE", "0")
+    import importlib
+
+    from finetune_controller_tpu.data import native_loader
+
+    importlib.reload(native_loader)
+    try:
+        assert native_loader.available() is False
+        assert native_loader.pack_jsonl_native("x.jsonl", 16) is None
+    finally:
+        monkeypatch.delenv("FTC_NATIVE")
+        importlib.reload(native_loader)
+
+
+def test_native_top_level_key_matching(tmp_path):
+    """Nested 'tokens'/'text' keys must not shadow the top-level row schema."""
+    p = tmp_path / "nested.jsonl"
+    rows = [
+        {"id": "a", "text": "hello world", "meta": {"tokens": [9, 9, 9]}},
+        {"meta": {"tokens": 5}, "text": "hi"},
+    ]
+    _write_jsonl(p, rows)
+    docs = load_token_documents(str(p))
+    py_tokens, py_segs = pack_documents(docs, 16)
+    nat = pack_jsonl_native(str(p), 16)
+    np.testing.assert_array_equal(nat[0], py_tokens)
+    np.testing.assert_array_equal(nat[1], py_segs)
