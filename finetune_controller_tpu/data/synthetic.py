@@ -19,7 +19,11 @@ def synthetic_batches(
     vocab_size: int,
     task: str = "increment",
     seed: int = 0,
+    image_size: int = 0,
 ) -> Iterator[dict]:
+    """``image_size > 0`` adds a ``pixels`` field (multimodal smoke data):
+    the image's mean brightness picks the caption's start token, so a model
+    that wires vision → text at all can beat the text-only loss floor."""
     rng = np.random.default_rng(seed)
     while True:
         if task == "increment":
@@ -30,7 +34,14 @@ def synthetic_batches(
             tokens = rng.integers(0, vocab_size, (batch_size, seq_len))
         else:
             raise ValueError(f"unknown synthetic task {task!r}")
-        yield {
+        batch = {
             "tokens": tokens.astype(np.int32),
             "loss_mask": np.ones((batch_size, seq_len), np.float32),
         }
+        if image_size:
+            brightness = (tokens[:, 0].astype(np.float32) / vocab_size)[:, None, None, None]
+            pixels = brightness + 0.1 * rng.standard_normal(
+                (batch_size, image_size, image_size, 3)
+            )
+            batch["pixels"] = pixels.astype(np.float32)
+        yield batch

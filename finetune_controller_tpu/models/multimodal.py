@@ -1,0 +1,247 @@
+"""LLaVA-style multimodal SFT model: ViT encoder → projector → Llama decoder.
+
+BASELINE config #5 (LLaVA-1.5 multimodal SFT). Architecture follows the
+public LLaVA recipe — a vision transformer encodes the image into patch
+embeddings, a 2-layer MLP projects them into the LM's embedding space, and
+the projected patch tokens are *prepended* to the text embeddings so the
+decoder attends to the image as a prefix. TPU-first notes:
+
+- the ViT is plain bidirectional attention over a static patch grid (no
+  masking, no ragged shapes) — pure MXU work XLA fuses well;
+- the combined sequence is static: ``n_patches + text_len`` every step, so
+  one compiled program serves the whole run;
+- loss positions: only text-token targets count; the caller's ``loss_mask``
+  is extended with zeros over the image prefix inside the model wrapper.
+
+The reference has no model code at all (SURVEY.md §2.2); multimodal here is
+a first-class model family beside Llama/Mixtral.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, RMSNorm, _proj
+from .lora import LoRAConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 336
+    patch_size: int = 14
+    d_model: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def replace(self, **kw) -> "ViTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlavaConfig:
+    vision: ViTConfig = dataclasses.field(default_factory=ViTConfig)
+    text: LlamaConfig = dataclasses.field(default_factory=LlamaConfig)
+    projector_hidden: int = 4096
+
+    # trainer duck-type surface (mirrors LlamaConfig)
+    @property
+    def vocab_size(self) -> int:
+        return self.text.vocab_size
+
+    @property
+    def lora(self) -> LoRAConfig:
+        return self.text.lora
+
+    @property
+    def n_experts(self) -> int:
+        return self.text.n_experts
+
+    @property
+    def router_aux_weight(self) -> float:
+        return self.text.router_aux_weight
+
+    @property
+    def attention_impl(self) -> str:
+        return self.text.attention_impl
+
+    def replace(self, **kw) -> "LlavaConfig":
+        # route llama-level overrides (lora=...) into the text config
+        text_keys = {f.name for f in dataclasses.fields(LlamaConfig)}
+        text_kw = {k: v for k, v in kw.items() if k in text_keys}
+        top_kw = {k: v for k, v in kw.items() if k not in text_keys}
+        cfg = self
+        if text_kw:
+            cfg = dataclasses.replace(cfg, text=cfg.text.replace(**text_kw))
+        if top_kw:
+            cfg = dataclasses.replace(cfg, **top_kw)
+        return cfg
+
+    def param_count(self) -> int:
+        v = self.vision
+        vit = v.n_layers * (4 * v.d_model * v.d_model + 2 * v.d_model * v.d_ff)
+        proj = v.d_model * self.projector_hidden + self.projector_hidden * self.text.d_model
+        return vit + proj + self.text.param_count()
+
+
+class ViTBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.n_heads, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="attn",
+        )(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=cfg.param_dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype)(h)
+        return x + h
+
+
+class ViTEncoder(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array) -> jax.Array:
+        """pixels (B, H, W, 3) → (B, n_patches, d_model)."""
+        cfg = self.cfg
+        x = nn.Conv(
+            cfg.d_model,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="patch_embed",
+        )(pixels.astype(cfg.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.d_model)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, cfg.n_patches, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = ViTBlock(cfg, name=f"block_{i}")(x)
+        return nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            name="final_norm")(x)
+
+
+class LlavaForCausalLM(nn.Module):
+    """Image-prefix causal LM. Call with (tokens, pixels)."""
+
+    cfg: LlavaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,               # (B, S)
+        pixels: jax.Array | None = None,  # (B, H, W, 3)
+        segment_ids: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        cfg = self.cfg
+        tcfg = cfg.text
+        b, s = tokens.shape
+
+        embed = nn.Embed(
+            tcfg.vocab_size, tcfg.d_model,
+            dtype=tcfg.dtype, param_dtype=tcfg.param_dtype, name="embed_tokens",
+        )
+        text_emb = embed(tokens)                         # (B, S, d)
+
+        n_img = 0
+        if pixels is not None:
+            patches = ViTEncoder(cfg.vision, name="vision_tower")(pixels)
+            # 2-layer MLP projector (LLaVA-1.5 recipe)
+            h = nn.Dense(cfg.projector_hidden, dtype=tcfg.dtype,
+                         param_dtype=tcfg.param_dtype, name="projector_fc1")(patches)
+            h = nn.gelu(h)
+            img_emb = nn.Dense(tcfg.d_model, dtype=tcfg.dtype,
+                               param_dtype=tcfg.param_dtype, name="projector_fc2")(h)
+            n_img = img_emb.shape[1]
+            x = jnp.concatenate([img_emb, text_emb], axis=1)
+        else:
+            x = text_emb
+
+        total = n_img + s
+        positions = jnp.broadcast_to(jnp.arange(total), (b, total))
+        if segment_ids is not None and n_img:
+            # image prefix joins the first text segment so text can attend to it
+            first = segment_ids[:, :1]
+            segment_ids = jnp.concatenate(
+                [jnp.broadcast_to(first, (b, n_img)), segment_ids], axis=1
+            )
+
+        # reuse the Llama decoder stack over the combined sequence
+        from .llama import Block, _ScanBlock
+
+        if tcfg.scan_layers:
+            block_cls = _ScanBlock
+            if tcfg.remat:
+                block_cls = nn.remat(
+                    _ScanBlock, prevent_cse=False, static_argnums=(4,),
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "lora": 0, "moe_aux": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=tcfg.n_layers,
+            )(tcfg, name="blocks")
+            x, _ = stack(x, positions, segment_ids, deterministic)
+        else:
+            for i in range(tcfg.n_layers):
+                x = Block(tcfg, name=f"layer_{i}")(
+                    x, positions, segment_ids, deterministic
+                )
+
+        x = RMSNorm(tcfg.rms_eps, tcfg.dtype, tcfg.param_dtype, name="final_norm")(x)
+        x = x[:, n_img:]                                 # logits for text positions only
+        logits = _proj(tcfg.replace(lora=LoRAConfig()), "lm_head", tcfg.vocab_size)(x)
+        return logits.astype(jnp.float32)
+
+    def init_variables(self, rng: jax.Array, batch: int = 1, seq: int = 8):
+        tokens = jnp.zeros((batch, seq), jnp.int32)
+        size = self.cfg.vision.image_size
+        pixels = jnp.zeros((batch, size, size, 3), jnp.float32)
+        return self.init({"params": rng}, tokens, pixels)
+
+
+MM_PRESETS: dict[str, LlavaConfig] = {
+    "llava-1.5-7b": LlavaConfig(
+        vision=ViTConfig(),  # ViT-L/14-ish at 336px
+        text=LlamaConfig(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, d_ff=11008, max_seq_len=4096,
+        ),
+        projector_hidden=4096,
+    ),
+    "tiny-mm-test": LlavaConfig(
+        vision=ViTConfig(image_size=16, patch_size=8, d_model=32, n_layers=2,
+                         n_heads=2, d_ff=64),
+        text=LlamaConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128,
+        ),
+        projector_hidden=64,
+    ),
+}
