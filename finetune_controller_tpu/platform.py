@@ -1,0 +1,28 @@
+"""Platform-resolution helpers shared by every JAX entrypoint.
+
+A site TPU plugin may call ``jax.config.update("jax_platforms", ...)`` at
+interpreter startup, and an explicit config update outranks the
+``JAX_PLATFORMS`` env var in JAX's resolution order — so entrypoints that
+must honour the env var (tests on a virtual CPU mesh, CI bench runs) have to
+re-assert it through the config API after importing jax.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def assert_platform_env() -> None:
+    """Make the ``JAX_PLATFORMS`` env var authoritative, if set."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Parse a boolean env var: '', '0', 'false', 'no', 'off' are false."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")

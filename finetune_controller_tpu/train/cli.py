@@ -104,11 +104,9 @@ def run_job(spec: dict) -> None:
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # Make the env var authoritative even if a site plugin updated the
-        # config at interpreter startup (an explicit config.update outranks
-        # the env var in JAX's resolution order).
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from ..platform import assert_platform_env
+
+    assert_platform_env()
     maybe_initialize_distributed()
 
     model_cfg = build_model_config(spec)
