@@ -191,8 +191,9 @@ class JobMonitor:
             return
         records, source_uri = result
         existing = await self.state.get_metrics(job.job_id)
-        if existing is not None and len(existing.records) == len(records):
-            return  # unchanged
+        if existing is not None and existing.records == records:
+            return  # unchanged (content compare: rewritten rows with the same
+            # count must still propagate)
         await self.state.upsert_metrics(
             MetricsDocument(
                 job_id=job.job_id,

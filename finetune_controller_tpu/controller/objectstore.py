@@ -85,6 +85,13 @@ class ObjectStore:
     async def copy_prefix(self, src_uri: str, dst_uri: str) -> int:
         raise NotImplementedError
 
+    async def get_chunks(self, uri: str, chunk_size: int = 1 << 20) -> AsyncIterator[bytes]:
+        """Stream an object's bytes in chunks. Default materializes the whole
+        object (backends override with true streaming)."""
+        data = await self.get_bytes(uri)
+        for i in range(0, len(data), chunk_size):
+            yield data[i : i + chunk_size]
+
     # -- shared higher-level helpers -----------------------------------------
 
     async def get_metrics_records(self, artifacts_uri: str) -> tuple[list[dict[str, Any]], str] | None:
@@ -114,8 +121,9 @@ class ObjectStore:
         return buf.getvalue()
 
     async def zip_prefix_to_path(self, prefix_uri: str, dest: Path | str) -> int:
-        """Zip a prefix to a file on disk, one object at a time — bounded
-        memory for arbitrarily large artifact prefixes. Returns object count."""
+        """Zip a prefix to a file on disk, streaming each object in chunks —
+        bounded memory even when a single object (e.g. a checkpoint shard) is
+        multi-GB. Returns object count."""
         objs = await self.list_prefix(prefix_uri)
         _, prefix_key = parse_uri(prefix_uri)
         with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as zf:
@@ -125,7 +133,11 @@ class ObjectStore:
                     key[len(prefix_key) :].lstrip("/")
                     if key.startswith(prefix_key) else key
                 )
-                zf.writestr(arcname, await self.get_bytes(o["uri"]))
+                zi = zipfile.ZipInfo(arcname)
+                zi.compress_type = zipfile.ZIP_DEFLATED
+                with zf.open(zi, "w") as entry:
+                    async for chunk in self.get_chunks(o["uri"]):
+                        await asyncio.to_thread(entry.write, chunk)
         return len(objs)
 
 
@@ -188,6 +200,15 @@ class LocalObjectStore(ObjectStore):
             return dest_p.stat().st_size
 
         return await asyncio.to_thread(copy)
+
+    async def get_chunks(self, uri: str, chunk_size: int = 1 << 20) -> AsyncIterator[bytes]:
+        p = self.path_for(uri)
+        with p.open("rb") as f:
+            while True:
+                chunk = await asyncio.to_thread(f.read, chunk_size)
+                if not chunk:
+                    return
+                yield chunk
 
     async def exists(self, uri: str) -> bool:
         return await asyncio.to_thread(self.path_for(uri).exists)

@@ -502,6 +502,15 @@ async def promote_job(request: web.Request) -> web.Response:
         rt.settings.deploy_bucket, promotion_path, job.job_id
     )
     promo = request.app[PROMOTION_KEY]
+    # Compare-and-set claim: concurrent promote requests race on the awaits
+    # between the guard above and here, so the IN_PROGRESS transition itself
+    # must be atomic — only the request that wins the CAS spawns the copy.
+    if not await rt.state.begin_promotion(
+        job.job_id, PromotionStatus.IN_PROGRESS, destination
+    ):
+        return web.json_response(
+            {"detail": "promotion already in progress"}, status=202
+        )
     _spawn_bg(
         request.app,
         promo.promote_job_task(job.job_id, job.artifacts_uri, destination),
@@ -521,6 +530,13 @@ async def unpromote_job(request: web.Request) -> web.Response:
     if not job.promotion_uri:
         return _json_error(404, "no promotion destination recorded")
     promo = request.app[PROMOTION_KEY]
+    # Same CAS claim as promote: only the winning request spawns the cleanup.
+    if not await rt.state.begin_promotion(
+        job.job_id, PromotionStatus.DELETING, job.promotion_uri
+    ):
+        return web.json_response(
+            {"detail": "unpromotion already in progress"}, status=202
+        )
     _spawn_bg(request.app, promo.unpromote_job_task(job.job_id, job.promotion_uri))
     return web.json_response({"message": "unpromotion started"}, status=202)
 

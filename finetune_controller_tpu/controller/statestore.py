@@ -119,6 +119,25 @@ class Collection:
             await asyncio.to_thread(self._append, doc)
             return True
 
+    async def update_if(
+        self,
+        key: str,
+        fields: dict[str, Any],
+        predicate: Callable[[dict[str, Any]], bool],
+    ) -> bool:
+        """Compare-and-set: apply ``fields`` only when ``predicate(doc)`` holds,
+        read and write under the collection lock (the guard+transition pattern
+        concurrent HTTP handlers need — a bare read-then-update has an await
+        window where a second request slips through)."""
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = self._docs.get(key)
+            if doc is None or not predicate(doc):
+                return False
+            doc.update(fields)
+            await asyncio.to_thread(self._append, doc)
+            return True
+
     async def merge_subdoc(self, key: str, field: str, patch: dict[str, Any]) -> bool:
         """Last-writer-wins merge into a dict field (reference metadata merge,
         ``db.py:206-215``)."""
@@ -238,6 +257,27 @@ class StateStore:
         if promotion_uri is not None:
             fields["promotion_uri"] = promotion_uri
         return await self.jobs.update(job_id, fields)
+
+    async def begin_promotion(
+        self,
+        job_id: str,
+        promotion_status: PromotionStatus,
+        promotion_uri: str,
+    ) -> bool:
+        """Atomically claim a promote/unpromote transition: succeeds only if no
+        transition is already in flight. Returns False when another request won."""
+        in_flight = {
+            PromotionStatus.IN_PROGRESS.value,
+            PromotionStatus.DELETING.value,
+        }
+        return await self.jobs.update_if(
+            job_id,
+            {
+                "promotion_status": PromotionStatus(promotion_status).value,
+                "promotion_uri": promotion_uri,
+            },
+            lambda doc: doc.get("promotion_status") not in in_flight,
+        )
 
     async def update_job_fields(self, job_id: str, **fields: Any) -> bool:
         return await self.jobs.update(job_id, _jsonify(fields))

@@ -99,6 +99,18 @@ def run_job(spec: dict) -> None:
     from ..parallel.distributed import maybe_initialize_distributed, is_rank_zero
     from .trainer import Trainer
 
+    # A job spec's ``build_trainer_spec`` stows user arguments it did not map
+    # into trainer knobs under ``extra_arguments``. Silently ignoring them
+    # would mean a user's hyperparameter never reaches the run — fail loudly
+    # so plugin spec authors consume every argument they declare.
+    extra = spec.get("extra_arguments")
+    if extra:
+        raise ValueError(
+            f"unconsumed extra_arguments {sorted(extra)}: the job spec must map "
+            "every user argument into the trainer spec (override "
+            "build_trainer_spec in the spec class)"
+        )
+
     artifacts_dir = spec["artifacts_dir"]
     os.makedirs(artifacts_dir, exist_ok=True)
 

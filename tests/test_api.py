@@ -298,6 +298,35 @@ def test_api_full_lifecycle(tmp_path):
     run_async(main())
 
 
+def test_api_concurrent_promote_single_winner(tmp_path):
+    """Two promote requests racing through the guard must spawn exactly one
+    copy task (CAS in the statestore — round-1 ADVICE finding)."""
+
+    async def main():
+        client = await _client(_runtime(tmp_path))
+        r = await client.post("/api/v1/jobs", json=SUBMIT_BODY)
+        job_id = (await r.json())["job_id"]
+        await _wait_final(client, job_id)
+
+        r1, r2 = await asyncio.gather(
+            client.post(f"/api/v1/jobs/{job_id}/promote"),
+            client.post(f"/api/v1/jobs/{job_id}/promote"),
+        )
+        bodies = [await r1.json(), await r2.json()]
+        started = [b for b in bodies if b.get("message") == "promotion started"]
+        raced = [b for b in bodies if "already in progress" in b.get("detail", "")]
+        assert len(started) == 1 and len(raced) == 1, bodies
+        for _ in range(100):
+            await asyncio.sleep(0.1)
+            job = await (await client.get(f"/api/v1/jobs/{job_id}")).json()
+            if job["promotion_status"] == "completed":
+                break
+        assert job["promotion_status"] == "completed"
+        await client.close()
+
+    run_async(main())
+
+
 def test_api_cancel_and_promote_guards(tmp_path):
     async def main():
         client = await _client(_runtime(tmp_path))

@@ -47,6 +47,18 @@ def test_cli_subprocess(tmp_path):
     assert (tmp_path / "artifacts" / "done.txt").exists()
 
 
+def test_unconsumed_extra_arguments_rejected(tmp_path):
+    """A user argument the spec class never mapped must fail loudly, not be
+    silently dropped (round-1 weak spot)."""
+    spec = _spec(tmp_path)
+    spec["extra_arguments"] = {"my_custom_knob": 3}
+    try:
+        cli.run_job(spec)
+        raise AssertionError("should have raised")
+    except ValueError as e:
+        assert "my_custom_knob" in str(e)
+
+
 def test_bad_spec_rejected(tmp_path):
     spec = _spec(tmp_path)
     spec["training"]["bogus_field"] = 1

@@ -271,6 +271,29 @@ def test_metrics_csv_and_zip_and_copy(tmp_path):
     run(go())
 
 
+def test_zip_prefix_to_path_streams(tmp_path):
+    """Disk-targeted zip streams objects chunk-by-chunk (bounded memory) and
+    produces a byte-correct archive."""
+    store = LocalObjectStore(tmp_path / "obj")
+
+    async def go():
+        prefix = artifacts_prefix("artifacts", "a", "big")
+        big = bytes(range(256)) * 8192  # 2 MiB, crosses the 1 MiB chunk size
+        await store.put_bytes(f"{prefix}/shard.bin", big)
+        await store.put_bytes(f"{prefix}/metrics.csv", b"step,loss\n1,2.0\n")
+        dest = tmp_path / "out.zip"
+        n = await store.zip_prefix_to_path(prefix, dest)
+        assert n == 2
+        import zipfile
+        with zipfile.ZipFile(dest) as zf:
+            assert sorted(zf.namelist()) == ["metrics.csv", "shard.bin"]
+            assert zf.read("shard.bin") == big
+            info = zf.getinfo("shard.bin")
+            assert info.compress_type == zipfile.ZIP_DEFLATED
+
+    run(go())
+
+
 def test_object_store_rejects_path_escape(tmp_path):
     store = LocalObjectStore(tmp_path / "obj")
 

@@ -157,6 +157,45 @@ def test_monitor_failed_jobs_kept_for_forensics(tmp_path):
     run(main())
 
 
+def test_monitor_metrics_update_on_content_change(tmp_path):
+    """Rewritten metrics rows with the SAME row count must still propagate
+    (round-1 weak spot: the monitor skipped the upsert on unchanged len)."""
+
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        await state.connect()
+        await task_builder(
+            JobInput(job_id="mm-1", user_id="u", model_name="tiny-test-lora",
+                     device="chip-1", arguments={}),
+            _spec(), DatasetInput(),
+            state=state, store=store, backend=backend, catalog=_catalog(),
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        backend.reports["mm-1"] = BackendJobReport(
+            job_id="mm-1", state=BackendJobState.RUNNING, start_time=1.0
+        )
+        rec = await state.get_job("mm-1")
+        await store.put_bytes(
+            f"{rec.artifacts_uri}/metrics.csv", b"step,loss\n1,2.0\n2,1.5\n"
+        )
+        await monitor.tick()
+        doc = await state.get_metrics("mm-1")
+        assert doc is not None and doc.records[1]["loss"] == 1.5
+
+        # same row count, corrected content — must be picked up
+        await store.put_bytes(
+            f"{rec.artifacts_uri}/metrics.csv", b"step,loss\n1,2.0\n2,1.25\n"
+        )
+        await monitor.tick()
+        doc = await state.get_metrics("mm-1")
+        assert doc.records[1]["loss"] == 1.25
+
+    run(main())
+
+
 def test_monitor_cleans_cancelled_jobs_backend_half(tmp_path):
     async def main():
         state = StateStore(tmp_path / "state")
