@@ -103,6 +103,11 @@ class BaseFineTuneJob(BaseModel):
     ]
     #: deploy-bucket prefix used on promotion (reference: ``finetuning.py:75-78``)
     promotion_path: ClassVar[str] = "models"
+    #: intra-slice mesh-axis declaration (fsdp/ep/pp/sp/tp; one axis may be -1
+    #: = "all remaining chips"); resolved against the device flavor at submit
+    #: by :func:`finetune_controller_tpu.controller.devices.default_mesh_for`.
+    #: MoE families set ``{"ep": N, "fsdp": -1}``, long-context ones add sp.
+    mesh_policy: ClassVar[dict[str, int]] = {"fsdp": -1}
 
     # ---- instance-level (validated user input) ----
     training_arguments: TrainingArguments
@@ -122,6 +127,7 @@ class BaseFineTuneJob(BaseModel):
         "checkpoint_mount": str,
         "store_asset_patterns": list,
         "promotion_path": str,
+        "mesh_policy": dict,
     }
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
@@ -190,7 +196,12 @@ class BaseFineTuneJob(BaseModel):
         if dataset_path:
             spec["dataset"] = {"path": dataset_path}
         else:
-            spec["dataset"] = {"synthetic": {"task": "increment"}}
+            # multimodal smoke jobs get the vision-wiring probe task; text
+            # jobs the increment task (data/synthetic.py)
+            task_name = (
+                "brightness" if self.task is TrainingTask.MULTIMODAL else "increment"
+            )
+            spec["dataset"] = {"synthetic": {"task": task_name}}
         if args:
             spec["extra_arguments"] = args
         return spec

@@ -85,6 +85,11 @@ LLAMA_RULES = PartitionRules(
         (r"experts_(gate|up)", P(Ax.EXPERT, Ax.FSDP, Ax.TENSOR)),
         (r"experts_down", P(Ax.EXPERT, Ax.TENSOR, Ax.FSDP)),
         (r"router_kernel", P(Ax.FSDP, None)),
+        # multimodal projector (models/multimodal.py): fc1 (d_vision, hidden)
+        # column-parallel, fc2 (hidden, d_model) row-parallel; ViT tower params
+        # fall through to the replicate catch-all (the encoder is small)
+        (r"projector_fc1/kernel", P(Ax.FSDP, Ax.TENSOR)),
+        (r"projector_fc2/kernel", P(Ax.TENSOR, Ax.FSDP)),
         # LoRA adapters: A (in, r) sharded like the frozen kernel's input dim;
         # B (r, out) over the output dim.  Rank r is tiny — keep it replicated.
         (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_a", P(Ax.FSDP, None)),

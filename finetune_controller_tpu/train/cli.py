@@ -35,12 +35,19 @@ logger = logging.getLogger(__name__)
 def build_model_config(spec: dict):
     from ..models.llama import PRESETS
     from ..models.lora import LoRAConfig
+    from ..models.multimodal import MM_PRESETS
 
     model_spec = spec.get("model", {})
     preset = model_spec.get("preset", "tiny-test")
-    if preset not in PRESETS:
-        raise ValueError(f"unknown model preset {preset!r}; have {sorted(PRESETS)}")
-    cfg = PRESETS[preset]
+    if preset in PRESETS:
+        cfg = PRESETS[preset]
+    elif preset in MM_PRESETS:
+        cfg = MM_PRESETS[preset]
+    else:
+        raise ValueError(
+            f"unknown model preset {preset!r}; have "
+            f"{sorted(PRESETS) + sorted(MM_PRESETS)}"
+        )
     overrides = dict(model_spec.get("overrides", {}))
     if overrides:
         cfg = cfg.replace(**overrides)
@@ -86,12 +93,15 @@ def build_batches(
             shard_count=shard_count,
         )
     synth = ds.get("synthetic", {})
+    # multimodal configs get pixels sized to their vision tower automatically
+    image_size = getattr(getattr(model_cfg, "vision", None), "image_size", 0)
     return synthetic_batches(
         batch_size=local_batch_size,
         seq_len=train_cfg.seq_len,
         vocab_size=model_cfg.vocab_size,
-        task=synth.get("task", "increment"),
+        task=synth.get("task", "brightness" if image_size else "increment"),
         seed=train_cfg.seed + shard_index,
+        image_size=image_size,
     )
 
 

@@ -97,7 +97,16 @@ class Trainer:
             model_cfg = model_cfg.replace(attention_impl="ring")
         self.model_cfg = model_cfg
         self.rules = rules
-        self.model = LlamaForCausalLM(model_cfg)
+        # Model family is selected by config type (the duck-type surface the
+        # multimodal config mirrors) — BASELINE #5 trains through the same
+        # trainer as the text families.
+        from ..models.multimodal import LlavaConfig, LlavaForCausalLM
+
+        self._is_multimodal = isinstance(model_cfg, LlavaConfig)
+        if self._is_multimodal:
+            self.model = LlavaForCausalLM(model_cfg)
+        else:
+            self.model = LlamaForCausalLM(model_cfg)
         self.tx, self.sched = build_optimizer(
             learning_rate=train_cfg.learning_rate,
             warmup_steps=train_cfg.warmup_steps,
@@ -108,10 +117,13 @@ class Trainer:
         )
         self._state_shardings = None
         self._init_jit = None
-        self._step_jit = None
         self._build()
 
     # ---- construction ----------------------------------------------------
+
+    # params trained alongside LoRA adapters on multimodal models: the LLaVA
+    # recipe always trains the vision→text projector, adapters or not
+    _MM_TRAINED_PARAMS = ("projector_fc1", "projector_fc2")
 
     def _split(self, variables: FrozenDict) -> tuple[Any, Any]:
         """(frozen, trainable) per the training mode."""
@@ -122,8 +134,15 @@ class Trainer:
         if self.cfg.mode == "lora":
             if "lora" not in variables:
                 raise ValueError("mode='lora' but the model has no LoRA params; set lora.rank > 0")
-            trainable = variables.pop("lora")
-            return variables, trainable
+            lora = variables.pop("lora")
+            if not self._is_multimodal:
+                return variables, lora
+            params = dict(variables["params"])
+            projector = {
+                k: params.pop(k) for k in self._MM_TRAINED_PARAMS if k in params
+            }
+            variables["params"] = params
+            return variables, {"lora": lora, "projector": projector}
         if self.cfg.mode == "full":
             trainable = variables.pop("params")
             return variables, trainable
@@ -131,7 +150,14 @@ class Trainer:
 
     def _assemble(self, frozen: Any, trainable: Any) -> dict:
         out = dict(frozen)
-        out["lora" if self.cfg.mode == "lora" else "params"] = trainable
+        if self.cfg.mode != "lora":
+            out["params"] = trainable
+            return out
+        if self._is_multimodal:
+            out["lora"] = trainable["lora"]
+            out["params"] = {**dict(out["params"]), **trainable["projector"]}
+        else:
+            out["lora"] = trainable
         return out
 
     def _raw_init(self, rng: jax.Array) -> TrainState:
@@ -142,7 +168,12 @@ class Trainer:
         b0 = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
         s0 = math.lcm(8, self.mesh.shape.get("sp", 1))
         tokens = jnp.zeros((b0, s0), jnp.int32)
-        variables = self.model.init({"params": rng}, tokens)
+        if self._is_multimodal:
+            size = self.model_cfg.vision.image_size
+            pixels = jnp.zeros((b0, size, size, 3), jnp.float32)
+            variables = self.model.init({"params": rng}, tokens, pixels)
+        else:
+            variables = self.model.init({"params": rng}, tokens)
         frozen, trainable = self._split(variables)
         opt_state = self.tx.init(trainable)
         return TrainState(
@@ -157,13 +188,35 @@ class Trainer:
         shapes = jax.eval_shape(self._raw_init, rng)
         self._state_shardings = sharding_for_tree(shapes, self.mesh, self.rules)
         self._batch_sharding = batch_sharding(self.mesh)
+        from ..parallel.mesh import AxisNames as Ax
+
+        self._pixel_sharding = NamedSharding(self.mesh, P(Ax.BATCH_AXES))
         self._init_jit = jax.jit(self._raw_init, out_shardings=self._state_shardings)
-        self._step_jit = jax.jit(
-            self._train_step,
-            in_shardings=(self._state_shardings, self._batch_sharding),
-            out_shardings=(self._state_shardings, None),
-            donate_argnums=(0,),
-        )
+        # jitted steps are cached per batch structure (multimodal batches add
+        # a rank-4 pixels leaf whose sharding differs from token arrays)
+        self._step_jits: dict[tuple[str, ...], Any] = {}
+
+    def _batch_leaf_sharding(self, x: Any) -> NamedSharding:
+        """Token-like (B, S) leaves shard batch+seq; higher-rank leaves (e.g.
+        pixels (B, H, W, 3)) shard the batch dim only — the sequence axis of an
+        image is not the token sequence the sp ring shards."""
+        if getattr(x, "ndim", 2) == 2:
+            return self._batch_sharding
+        return self._pixel_sharding
+
+    def _get_step_jit(self, batch: dict):
+        key = tuple(sorted(batch))
+        fn = self._step_jits.get(key)
+        if fn is None:
+            batch_sh = {k: self._batch_leaf_sharding(batch[k]) for k in batch}
+            fn = jax.jit(
+                self._train_step,
+                in_shardings=(self._state_shardings, batch_sh),
+                out_shardings=(self._state_shardings, None),
+                donate_argnums=(0,),
+            )
+            self._step_jits[key] = fn
+        return fn
 
     # ---- device-side fns -------------------------------------------------
 
@@ -180,6 +233,8 @@ class Trainer:
             deterministic=not self._use_dropout,
             rngs=rngs,
         )
+        if self._is_multimodal:
+            apply_kw["pixels"] = batch.get("pixels")
         if self.model_cfg.n_experts:
             logits, collections = self.model.apply(
                 variables, batch["tokens"], mutable=("moe_aux",), **apply_kw
@@ -225,9 +280,10 @@ class Trainer:
         from ..parallel.ring import ring_mesh
 
         batch = self._shard_batch(batch)
+        step_fn = self._get_step_jit(batch)
         # ring_mesh only matters at trace time (first call); harmless after
         with self.mesh, ring_mesh(self.mesh):
-            return self._step_jit(state, batch)
+            return step_fn(state, batch)
 
     @property
     def local_batch_size(self) -> int:
@@ -248,9 +304,10 @@ class Trainer:
     def _shard_batch(self, batch: dict) -> dict:
         def put(x):
             x = np.asarray(x)
+            sh = self._batch_leaf_sharding(x)
             if jax.process_count() > 1:
-                return jax.make_array_from_process_local_data(self._batch_sharding, x)
-            return jax.device_put(x, self._batch_sharding)
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
 
         return jax.tree.map(put, batch)
 

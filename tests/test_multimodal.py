@@ -1,0 +1,99 @@
+"""Multimodal (LLaVA-style) model family tests — BASELINE config #5.
+
+The reference has no model code at all (SURVEY.md §2.2); these tests cover the
+greenfield multimodal compute path: forward shape, the vision→text wiring probe
+(brightness task — the target token is predictable only through pixels), the
+projector-trains-with-LoRA split, and the e2e control-plane lifecycle.
+"""
+
+import numpy as np
+
+import jax
+
+from conftest import run_async
+from finetune_controller_tpu.data.synthetic import BRIGHTNESS_LEVELS, synthetic_batches
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.models.multimodal import MM_PRESETS, LlavaForCausalLM
+from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+TINY = MM_PRESETS["tiny-mm-test"]
+
+
+def test_llava_forward_shape():
+    cfg = TINY
+    model = LlavaForCausalLM(cfg)
+    variables = model.init_variables(jax.random.PRNGKey(0), batch=2, seq=8)
+    tokens = np.zeros((2, 8), np.int32)
+    pixels = np.zeros((2, cfg.vision.image_size, cfg.vision.image_size, 3), np.float32)
+    logits = model.apply(variables, tokens, pixels)
+    # logits cover text positions only (image prefix sliced off), f32
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert logits.dtype == np.float32
+
+    # text-only call works too (pixels optional)
+    logits_text = model.apply(variables, tokens)
+    assert logits_text.shape == (2, 8, cfg.vocab_size)
+
+
+def test_projector_trains_with_lora():
+    cfg = TINY.replace(lora=LoRAConfig(rank=4))
+    trainer = Trainer(cfg, TrainConfig(mode="lora", total_steps=2, batch_size=2, seq_len=16))
+    state = trainer.init_state()
+    # trainable: LoRA adapters + the projector; frozen params exclude the projector
+    assert set(state.trainable) == {"lora", "projector"}
+    assert set(state.trainable["projector"]) == {"projector_fc1", "projector_fc2"}
+    assert "projector_fc1" not in state.frozen["params"]
+    assert "vision_tower" in state.frozen["params"]  # ViT stays frozen
+
+
+def test_brightness_task_vision_wiring():
+    """Loss on the brightness token falls well below the text-only floor
+    log(BRIGHTNESS_LEVELS) — impossible unless pixels reach the decoder."""
+    cfg = TINY.replace(lora=LoRAConfig(rank=4))
+    tc = TrainConfig(
+        mode="lora", learning_rate=0.01, total_steps=300, batch_size=16,
+        seq_len=16, log_every=10**9, checkpoint_every=10**9,
+    )
+    trainer = Trainer(cfg, tc)
+    state = trainer.init_state()
+    batches = synthetic_batches(
+        16, 16, cfg.vocab_size, task="brightness", seed=0,
+        image_size=cfg.vision.image_size,
+    )
+    losses = []
+    for _ in range(300):
+        state, metrics = trainer.step(state, next(batches))
+        losses.append(float(metrics["loss"]))
+    text_only_floor = np.log(BRIGHTNESS_LEVELS)
+    final = np.mean(losses[-25:])
+    assert final < text_only_floor - 0.5, (
+        f"final loss {final:.2f} vs text-only floor {text_only_floor:.2f}: "
+        "vision path is not wired"
+    )
+
+
+def test_multimodal_e2e_lifecycle(tmp_path):
+    """Submit a tiny multimodal job through the API → SUCCEEDED with metrics
+    (VERDICT round-1: multimodal must train end-to-end to count)."""
+    from test_api import _client, _runtime, _wait_final
+
+    async def main():
+        client = await _client(_runtime(tmp_path))
+        body = {
+            "model_name": "tiny-mm-test-lora",
+            "device": "chip-1",
+            "arguments": {"total_steps": 3, "warmup_steps": 1, "batch_size": 2,
+                          "seq_len": 16, "lora_rank": 2},
+        }
+        r = await client.post("/api/v1/jobs", json=body)
+        assert r.status == 200, await r.text()
+        job_id = (await r.json())["job_id"]
+        job = await _wait_final(client, job_id)
+        assert job["status"] == "succeeded", job
+
+        r = await client.get(f"/api/v1/jobs/{job_id}/metrics")
+        records = (await r.json())["records"]
+        assert records and "loss" in records[0]
+        await client.close()
+
+    run_async(main())
