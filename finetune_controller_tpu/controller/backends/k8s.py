@@ -91,7 +91,7 @@ def render_trainer_spec(
         job.job_id,
         artifacts_dir,
         dataset_path=dataset_path,
-        mesh=default_mesh_for(flavor, job.num_slices),
+        mesh=default_mesh_for(flavor, job.num_slices, policy=spec.mesh_policy),
     )
 
 

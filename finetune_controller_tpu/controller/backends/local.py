@@ -137,7 +137,7 @@ class LocalProcessBackend(TrainingBackend):
                 dataset_path = str(local)
                 handle.event("DatasetStaged", dataset_uri)
 
-            mesh = default_mesh_for(flavor, job.num_slices)
+            mesh = default_mesh_for(flavor, job.num_slices, policy=spec.mesh_policy)
             trainer_spec = spec.build_trainer_spec(
                 job.job_id,
                 str(handle.artifacts_dir),

@@ -137,6 +137,11 @@ def default_catalog() -> DeviceCatalog:
                 queue="cpu-queue", cpu="2", memory="4Gi", runtime="cpu",
             ),
             DeviceFlavor(
+                name="cpu-test-2", description="2-device virtual CPU mesh (ep/tp smoke)",
+                generation="cpu", topology="", hosts=1, chips_per_host=2,
+                queue="cpu-queue", cpu="4", memory="8Gi", runtime="cpu",
+            ),
+            DeviceFlavor(
                 name="v5e-4", description="single-host v5e slice",
                 generation="v5e", topology="2x2", hosts=1, chips_per_host=4,
                 queue="tpu-small-queue",
@@ -159,6 +164,7 @@ def default_catalog() -> DeviceCatalog:
         ],
         quotas=[
             FlavorQuota(flavor="cpu-test", nominal_chips=2),
+            FlavorQuota(flavor="cpu-test-2", nominal_chips=4),
             FlavorQuota(flavor="v5e-4", nominal_chips=8),
             FlavorQuota(flavor="v5e-8", nominal_chips=16),
             FlavorQuota(flavor="v5e-16", nominal_chips=32),
@@ -185,11 +191,48 @@ def load_catalog(path: Path | str | None) -> DeviceCatalog:
     return DeviceCatalog.model_validate(json.loads(text))
 
 
-def default_mesh_for(flavor: DeviceFlavor, num_slices: int = 1) -> dict[str, int]:
+#: axes a mesh policy may declare (trainer MeshSpec axis names)
+_POLICY_AXES = ("fsdp", "ep", "pp", "sp", "tp")
+
+
+def default_mesh_for(
+    flavor: DeviceFlavor,
+    num_slices: int = 1,
+    policy: dict[str, int] | None = None,
+) -> dict[str, int]:
     """Map a slice request to trainer MeshSpec axis sizes.
 
-    Policy: FSDP over all chips in a slice (the north-star strategy,
-    SURVEY.md §2.3 FSDP row), DP over slices (DCN axis). Model families that
-    need TP/EP override this in their job spec.
+    ``policy`` is the job spec's intra-slice axis declaration (reference
+    pattern: per-model resource declaration, ``finetuning.py:51-104`` — here
+    it declares *parallelism*, which the reference never could):
+
+    * keys are intra-slice axes (fsdp/ep/pp/sp/tp); at most one value may be
+      ``-1``, meaning "all remaining chips";
+    * the default policy ``{"fsdp": -1}`` is FSDP over the whole slice (the
+      north-star strategy, SURVEY.md §2.3);
+    * DP always runs over slices (the DCN axis): ``dp = num_slices``.
+
+    Raises ``ValueError`` when the flavor's chip count cannot satisfy the
+    policy — surfaced at submit time as a 400, not at train time on-device.
     """
-    return {"dp": num_slices, "fsdp": flavor.total_chips}
+    from ..parallel.mesh import MeshSpec
+
+    policy = dict(policy) if policy else {"fsdp": -1}
+    unknown = set(policy) - set(_POLICY_AXES)
+    if unknown:
+        raise ValueError(f"mesh policy axes {sorted(unknown)} not in {_POLICY_AXES}")
+    for a, v in policy.items():
+        if v != -1 and v < 1:
+            raise ValueError(f"mesh policy axis {a}={v} must be >= 1 or -1")
+    # One source of truth for -1-fill/divisibility/exact-coverage: the
+    # trainer's own MeshSpec.resolve. fsdp is pinned to 1 unless the policy
+    # says otherwise — MeshSpec's fsdp=-1 default ("absorb everything") must
+    # not kick in when a policy chose other axes.
+    try:
+        sizes = MeshSpec(dp=1, **{"fsdp": 1, **policy}).resolve(flavor.total_chips)
+    except ValueError as exc:
+        raise ValueError(
+            f"device {flavor.name!r} ({flavor.total_chips} chips) cannot "
+            f"satisfy the model's mesh policy {policy}: {exc}"
+        ) from None
+    return {"dp": num_slices, **{a: sizes[a] for a in _POLICY_AXES}}

@@ -82,6 +82,70 @@ class Mistral7B_QLoRA(BaseFineTuneJob):
     training_arguments: LoRASFTArguments
 
 
+class Mixtral8x7B_MoE_LoRA(BaseFineTuneJob):
+    """BASELINE config #4 — MoE LoRA with expert parallelism on v5p-64.
+
+    The mesh policy puts the 8 experts on the ``ep`` axis (expert matmuls stay
+    chip-local, token exchange is an all-to-all over ICI) and FSDP-shards the
+    rest of the slice.
+    """
+
+    model_name = "mixtral-8x7b-moe-lora"
+    description = "Mixtral 8x7B MoE LoRA, expert-parallel over a v5p-64 slice"
+    task = TrainingTask.CAUSAL_LM
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "mixtral-8x7b"
+    default_device = "v5p-64"
+    promotion_path = "models/mixtral-8x7b"
+    mesh_policy = {"ep": 8, "fsdp": -1}
+
+    training_arguments: LoRASFTArguments
+
+
+class TinyMoETestLoRA(BaseFineTuneJob):
+    """Milliseconds-scale MoE spec — proves a submitted job trains with
+    ``ep > 1`` on the virtual CPU mesh (the Mixtral path's e2e smoke)."""
+
+    model_name = "tiny-moe-test-lora"
+    description = "2-layer 4-expert test model; expert-parallel e2e smoke spec"
+    model_preset = "tiny-moe-test"
+    default_device = "cpu-test-2"  # ep=2 needs 2 chips even for the smoke run
+    promotion_path = "models/tiny-moe-test"
+    mesh_policy = {"ep": 2, "fsdp": -1}
+    dataset = TrainingDataset(required=False, description="optional jsonl")
+
+    training_arguments: LoRASFTArguments
+
+
+class Llava15LoRA(BaseFineTuneJob):
+    """BASELINE config #5 — LLaVA-1.5 multimodal SFT (ViT → projector →
+    Llama decoder; the projector trains alongside the LoRA adapters)."""
+
+    model_name = "llava-1.5-lora"
+    description = "LLaVA-1.5 7B multimodal SFT (LoRA + projector) on TPU"
+    task = TrainingTask.MULTIMODAL
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "llava-1.5-7b"
+    default_device = "v5e-16"
+    promotion_path = "models/llava-1.5"
+
+    training_arguments: LoRASFTArguments
+
+
+class TinyMMTestLoRA(BaseFineTuneJob):
+    """Milliseconds-scale multimodal spec for the e2e lifecycle tests."""
+
+    model_name = "tiny-mm-test-lora"
+    description = "2-layer ViT + 2-layer decoder; multimodal e2e smoke spec"
+    task = TrainingTask.MULTIMODAL
+    model_preset = "tiny-mm-test"
+    default_device = "cpu-test"
+    promotion_path = "models/tiny-mm-test"
+    dataset = TrainingDataset(required=False, description="optional jsonl")
+
+    training_arguments: LoRASFTArguments
+
+
 class TinyTestLoRA(BaseFineTuneJob):
     """Milliseconds-scale spec used by the e2e lifecycle tests."""
 
@@ -100,7 +164,11 @@ BUILTIN_JOB_SPECS: list[type[BaseFineTuneJob]] = [
     TinyLlamaLoRA,
     Llama3_8B_LoRA,
     Mistral7B_QLoRA,
+    Mixtral8x7B_MoE_LoRA,
+    Llava15LoRA,
     TinyTestLoRA,
+    TinyMoETestLoRA,
+    TinyMMTestLoRA,
 ]
 
 

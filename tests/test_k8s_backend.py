@@ -99,7 +99,9 @@ def test_render_trainer_spec_mesh_covers_slice():
     flavor = CATALOG.get("v5e-16")
     spec = render_trainer_spec(_job(num_slices=2), tiny_job_spec(), flavor,
                                dataset_uri=None)
-    assert spec["mesh"] == {"dp": 2, "fsdp": 16}
+    mesh = spec["mesh"]
+    assert mesh["dp"] == 2 and mesh["fsdp"] == 16
+    assert all(mesh.get(a, 1) == 1 for a in ("ep", "pp", "sp", "tp"))
 
 
 def test_spec_configmap_roundtrip():
