@@ -78,18 +78,21 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=128,
     ),
-    "tinyllama-1.1b": LlamaConfig(),
+    # real model families use the measured attention dispatch ("auto": Pallas
+    # flash on TPU past the kernel_bench crossover, XLA otherwise)
+    "tinyllama-1.1b": LlamaConfig(attention_impl="auto"),
     "llama3-8b": LlamaConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
-        d_ff=14336, rope_theta=500000.0, max_seq_len=8192,
+        d_ff=14336, rope_theta=500000.0, max_seq_len=8192, attention_impl="auto",
     ),
     "mistral-7b": LlamaConfig(
         vocab_size=32768, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
-        d_ff=14336, max_seq_len=8192,
+        d_ff=14336, max_seq_len=8192, attention_impl="auto",
     ),
     "mixtral-8x7b": LlamaConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192, n_experts=8, moe_top_k=2,
+        attention_impl="auto",
     ),
     "tiny-moe-test": LlamaConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,

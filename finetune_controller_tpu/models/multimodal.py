@@ -231,7 +231,7 @@ MM_PRESETS: dict[str, LlavaConfig] = {
         vision=ViTConfig(),  # ViT-L/14-ish at 336px
         text=LlamaConfig(
             vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
-            n_kv_heads=32, d_ff=11008, max_seq_len=4096,
+            n_kv_heads=32, d_ff=11008, max_seq_len=4096, attention_impl="auto",
         ),
         projector_hidden=4096,
     ),

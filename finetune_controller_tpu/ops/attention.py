@@ -1,10 +1,12 @@
 """Attention implementations with a single dispatch point.
 
 ``impl``:
+  * ``"auto"``   — measured dispatch: Pallas flash on TPU at long sequence
+                   (crossover from ``ops.kernel_bench``), XLA otherwise.
   * ``"xla"``    — einsum + masked softmax; XLA fuses this well on TPU and it
                    runs everywhere (CPU tests).  Default.
-  * ``"pallas"`` — hand-written TPU flash attention (``ops.pallas``); used when
-                   it beats the XLA default at the benchmark shapes.
+  * ``"pallas"`` — hand-written TPU flash attention (``ops.pallas``); wins
+                   at the benchmark shapes by never materialising (S, S).
   * ``"ring"``   — ring attention over the ``sp`` mesh axis for long context
                    (``parallel.ring``); requires shard_map.
 
@@ -66,6 +68,12 @@ def causal_attention(
     impl: str = "xla",
     segment_ids: jax.Array | None = None,
 ) -> jax.Array:
+    if impl == "auto":
+        # measured dispatch gate (ops/kernel_bench.py): Pallas flash on TPU
+        # at long sequence, XLA otherwise
+        from .kernel_bench import preferred_impl
+
+        impl = preferred_impl(q.shape[1])
     if impl == "xla":
         return xla_causal_attention(q, k, v, segment_ids=segment_ids)
     if impl == "pallas":

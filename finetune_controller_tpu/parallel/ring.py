@@ -104,7 +104,10 @@ def _ring_attention_local(
         s_scores = jnp.where(full_mask, s_scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s_scores, axis=-1, keepdims=True))
-        p = jnp.exp(s_scores - m_new)
+        # zero p under the mask explicitly: a fully-masked row (e.g. a step
+        # whose whole K/V shard is in the future) keeps m_new == NEG_INF, so
+        # exp(s - m_new) would be exp(0) = 1 per lane and corrupt l
+        p = jnp.where(full_mask, jnp.exp(s_scores - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
