@@ -20,6 +20,11 @@ from pathlib import Path
 
 from pydantic import BaseModel, Field
 
+#: the well-known dev secret — treated as UNSET by the auth startup guard:
+#: a deployment that enables auth outside `local` with only this secret would
+#: let anyone who read the source forge admin tokens
+DEFAULT_JWT_SECRET = "dev-secret-do-not-use-in-prod"
+
 
 class Settings(BaseModel):
     """Environment-driven configuration (reference: ``app/core/config.py:16-58``)."""
@@ -37,15 +42,23 @@ class Settings(BaseModel):
     introspection_client_id: str = ""
     introspection_client_secret: str = ""
     jwks_url: str = ""  # JWKS endpoint for RS256 validation
-    jwt_secret: str = "dev-secret-do-not-use-in-prod"  # HS256 dev mint/verify
-    jwt_audience: str = "finetune-controller-tpu"
+    jwt_secret: str = DEFAULT_JWT_SECRET  # HS256 dev mint/verify
+    #: RS256 audience enforcement is opt-in: set it and tokens must carry a
+    #: matching `aud` (string or array); empty = no audience check
+    jwt_audience: str = ""
     dev_disable_introspection: bool = True
 
     # --- State store (reference: Mongo URL/creds, app/core/config.py:44-49) ---
     state_dir: str = "~/.finetune_controller_tpu/state"
 
     # --- Object store (reference: S3 buckets, app/core/config.py:53-58) ---
+    #: "local" (filesystem root, hermetic CI) | "gcs" (cloud buckets)
+    object_store_backend: str = "local"
     object_store_root: str = "~/.finetune_controller_tpu/objects"
+    #: GCS: endpoint override (fake server in tests) + real-bucket prefix so
+    #: one project hosts the datasets/artifacts/deploy logical buckets
+    gcs_endpoint: str = "https://storage.googleapis.com"
+    gcs_bucket_prefix: str = ""
     datasets_bucket: str = "datasets"
     artifacts_bucket: str = "artifacts"
     deploy_bucket: str = "deploy"

@@ -125,7 +125,128 @@ def user_from_claims(claims: dict[str, Any]) -> UserJWT:
 
 
 # ---------------------------------------------------------------------------
-# Token validation (introspection or local verify)
+# RS256 / JWKS validation (reference: security.py:66-189 — python-jose JWKS;
+# here via `cryptography`, with the same fetched-key cache)
+# ---------------------------------------------------------------------------
+
+
+def jwt_header(token: str) -> dict[str, Any]:
+    try:
+        header_s = token.split(".")[0]
+        return json.loads(_b64url_decode(header_s))
+    except (ValueError, IndexError, json.JSONDecodeError) as e:
+        raise AuthError("malformed token") from e
+
+
+class JWKSClient:
+    """Fetches and caches a JWKS document (reference caches fetched keys,
+    ``security.py:108-116``). The fetch is injectable for tests."""
+
+    def __init__(
+        self,
+        url: str,
+        *,
+        fetch_fn: Callable[[str], Awaitable[dict[str, Any]]] | None = None,
+        cache_ttl_s: float = 3600.0,
+    ):
+        self.url = url
+        self._fetch_fn = fetch_fn
+        self._cache_ttl_s = cache_ttl_s
+        self._keys: dict[str, dict[str, Any]] = {}
+        self._fetched_at = 0.0
+
+    async def _fetch(self) -> dict[str, Any]:
+        if self._fetch_fn is not None:
+            return await self._fetch_fn(self.url)
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(self.url) as resp:
+                if resp.status != 200:
+                    raise AuthError(f"JWKS fetch failed ({resp.status})", 503)
+                return await resp.json()
+
+    #: minimum spacing between JWKS fetches — an unknown ``kid`` must not
+    #: turn into request-for-request amplification against the IdP
+    MIN_REFETCH_S = 30.0
+
+    async def get_key(self, kid: str | None) -> dict[str, Any]:
+        now = time.time()
+        stale = now - self._fetched_at > self._cache_ttl_s
+        missing = kid is not None and kid not in self._keys
+        throttled = now - self._fetched_at < self.MIN_REFETCH_S
+        if (stale or missing) and not (missing and not stale and throttled):
+            doc = await self._fetch()
+            self._keys = {k.get("kid", ""): k for k in doc.get("keys", [])}
+            self._fetched_at = now
+        if kid is None:
+            if len(self._keys) == 1:
+                return next(iter(self._keys.values()))
+            raise AuthError("token has no kid and JWKS has multiple keys")
+        key = self._keys.get(kid)
+        if key is None:
+            raise AuthError(f"unknown signing key {kid!r}")
+        return key
+
+
+def rsa_public_key_from_jwk(jwk: dict[str, Any]):
+    """Build an RSA public key from a JWK dict (kty=RSA, base64url n/e)."""
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    if jwk.get("kty") != "RSA":
+        raise AuthError(f"unsupported key type {jwk.get('kty')!r}")
+    n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
+    e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
+    return rsa.RSAPublicNumbers(e, n).public_key()
+
+
+async def decode_jwt_rs256(
+    token: str,
+    jwks: JWKSClient,
+    *,
+    verify_exp: bool = True,
+    audience: str | None = None,
+) -> dict[str, Any]:
+    """Verify an RS256 JWT against a JWKS endpoint and return its claims."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    header = jwt_header(token)
+    if header.get("alg") != "RS256":
+        raise AuthError(f"unsupported algorithm {header.get('alg')!r}")
+    try:
+        header_s, payload_s, sig_s = token.split(".")
+    except ValueError as e:
+        raise AuthError("malformed token") from e
+    key = rsa_public_key_from_jwk(await jwks.get_key(header.get("kid")))
+    try:
+        key.verify(
+            _b64url_decode(sig_s),
+            f"{header_s}.{payload_s}".encode(),
+            padding.PKCS1v15(),
+            hashes.SHA256(),
+        )
+    except InvalidSignature as e:
+        raise AuthError("invalid token signature") from e
+    try:
+        claims = json.loads(_b64url_decode(payload_s))
+    except (ValueError, json.JSONDecodeError) as e:
+        raise AuthError("malformed token payload") from e
+    if verify_exp and "exp" in claims and time.time() > float(claims["exp"]):
+        raise AuthError("token expired")
+    if audience:
+        # enforced only when the deployment configures an audience; RFC 7519
+        # allows both string and array `aud`
+        aud = claims.get("aud")
+        auds = aud if isinstance(aud, list) else [aud] if aud else []
+        if audience not in auds:
+            raise AuthError("token audience mismatch")
+    return claims
+
+
+# ---------------------------------------------------------------------------
+# Token validation (introspection, JWKS/RS256, or local HS256)
 # ---------------------------------------------------------------------------
 
 IntrospectFn = Callable[[str], Awaitable[dict[str, Any]]]
@@ -145,7 +266,9 @@ class TokenValidator:
 
     Strategies, tried in order:
     1. injected/remote **introspection** (OAuth RFC 7662-style endpoint);
-    2. local **HS256 verification** against the configured secret.
+    2. **JWKS/RS256** verification when a JWKS URL is configured and the
+       token's header says RS256 (reference: ``security.py:66-189``);
+    3. local **HS256 verification** against the configured secret.
     """
 
     def __init__(
@@ -156,6 +279,9 @@ class TokenValidator:
         introspection_client_id: str = "",
         introspection_client_secret: str = "",
         introspect_fn: IntrospectFn | None = None,
+        jwks_url: str = "",
+        jwks_client: JWKSClient | None = None,
+        audience: str = "",
         cache_ttl_s: float = 60.0,
     ):
         self._jwt_secret = jwt_secret
@@ -163,6 +289,8 @@ class TokenValidator:
         self._client_id = introspection_client_id
         self._client_secret = introspection_client_secret
         self._introspect_fn = introspect_fn
+        self._jwks = jwks_client or (JWKSClient(jwks_url) if jwks_url else None)
+        self._audience = audience
         self._cache: dict[str, tuple[float, UserJWT]] = {}
         self._cache_ttl_s = cache_ttl_s
 
@@ -197,7 +325,17 @@ class TokenValidator:
             if not data.get("active", False):
                 raise AuthError("token not active")
             user = user_from_claims(data)
+        elif self._jwks is not None and jwt_header(token).get("alg") == "RS256":
+            claims = await decode_jwt_rs256(
+                token, self._jwks, audience=self._audience or None
+            )
+            user = user_from_claims(claims)
         else:
+            if not self._jwt_secret:
+                # no HS256 secret configured (e.g. JWKS-only deployment with
+                # the default secret neutralised): a non-RS256 token has no
+                # valid verification path — never fall back to a known secret
+                raise AuthError("no local token verification configured")
             claims = decode_jwt(token, self._jwt_secret)
             user = user_from_claims(claims)
         if not user.user_id:
@@ -224,6 +362,48 @@ def extract_bearer(request: Any) -> str | None:
         return auth[7:].strip()
     cookie = request.cookies.get("ftc_token")
     return cookie or None
+
+
+def build_cors_middleware(origins: list[str]):
+    """CORS for browser frontends (reference: CORSMiddleware from
+    ``settings.cors_origins``, ``app/api/middleware.py:59-66``). Handles the
+    OPTIONS preflight and stamps Access-Control headers on every response
+    whose Origin is allowed ("*" allows any)."""
+    from aiohttp import web
+
+    allow_any = "*" in origins
+    allowed = set(origins)
+
+    def _origin_ok(origin: str) -> bool:
+        return bool(origin) and (allow_any or origin in allowed)
+
+    def _stamp(resp, origin: str):
+        resp.headers["Access-Control-Allow-Origin"] = "*" if allow_any else origin
+        resp.headers["Vary"] = "Origin"
+        return resp
+
+    @web.middleware
+    async def cors_middleware(request, handler):
+        origin = request.headers.get("Origin", "")
+        if request.method == "OPTIONS" and "Access-Control-Request-Method" in request.headers:
+            if not _origin_ok(origin):
+                return web.Response(status=403)
+            resp = web.Response(status=204)
+            resp.headers["Access-Control-Allow-Methods"] = (
+                "GET, POST, PUT, DELETE, OPTIONS"
+            )
+            resp.headers["Access-Control-Allow-Headers"] = (
+                request.headers.get("Access-Control-Request-Headers")
+                or "Authorization, Content-Type"
+            )
+            resp.headers["Access-Control-Max-Age"] = "600"
+            return _stamp(resp, origin)
+        resp = await handler(request)
+        if _origin_ok(origin):
+            _stamp(resp, origin)
+        return resp
+
+    return cors_middleware
 
 
 def build_auth_middleware(

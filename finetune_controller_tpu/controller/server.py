@@ -31,7 +31,13 @@ from .config import Settings
 from .promotion import PromotionTask, promotion_destination
 from .runtime import Runtime, build_runtime
 from .schemas import DatabaseStatus, JobInput, PromotionStatus
-from .security import TokenValidator, build_auth_middleware, dev_generate_token
+from .config import DEFAULT_JWT_SECRET
+from .security import (
+    TokenValidator,
+    build_auth_middleware,
+    build_cors_middleware,
+    dev_generate_token,
+)
 from .statestore import generate_short_uuid
 from .stream_logger import LogStreamManager
 from .task_builder import DatasetInput, TaskBuildError, task_builder
@@ -431,6 +437,19 @@ async def get_job_artifacts(request: web.Request) -> web.Response:
     objs = await rt.store.list_prefix(job.artifacts_uri)
     if not objs:
         return _json_error(404, "no artifacts found")
+    if request.query.get("list"):
+        # JSON inventory instead of the zip — how clients discover e.g. the
+        # profiler trace under profile/ without downloading everything
+        prefix_len = len(job.artifacts_uri.rstrip("/")) + 1
+        return web.json_response(
+            {
+                "job_id": job.job_id,
+                "artifacts": [
+                    {"path": o["uri"][prefix_len:], "size": o["size"]}
+                    for o in objs
+                ],
+            }
+        )
     # spool the zip to disk and stream it out — multi-GB checkpoint prefixes
     # must not be materialised in RAM per download
     with tempfile.NamedTemporaryFile(suffix=".zip", delete=False) as tmp:
@@ -837,20 +856,35 @@ async def openapi_json(request: web.Request) -> web.Response:
 
 def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Application:
     settings = runtime.settings
-    if settings.auth_enabled and not (
-        settings.introspection_url or settings.jwt_secret
-    ):
-        # reference warns loudly when prod auth is unconfigured
-        # (app/api/middleware.py:28-30)
-        logger.warning("auth enabled but no introspection URL or JWT secret set")
+    # The default jwt_secret is a PUBLIC string: with auth enabled and no
+    # real validation source configured, anyone could forge admin tokens.
+    # Refuse to start outside the local environment; warn inside it.
+    # (reference warns when prod auth is unconfigured, middleware.py:28-30)
+    secret_unset = settings.jwt_secret in ("", DEFAULT_JWT_SECRET)
+    real_source = bool(settings.introspection_url or settings.jwks_url)
+    if settings.auth_enabled and secret_unset and not real_source:
+        msg = (
+            "auth_enabled=True but no introspection URL, no JWKS URL, and the "
+            "JWT secret is the well-known default — tokens would be forgeable"
+        )
+        if settings.environment != "local":
+            raise RuntimeError(msg)
+        logger.warning("%s (allowed only because environment=local)", msg)
+    # With a real validation source configured, the well-known default secret
+    # must not remain a valid HS256 fallback — neutralise it so only the real
+    # source can authenticate tokens.
+    effective_secret = "" if (secret_unset and real_source) else settings.jwt_secret
     validator = TokenValidator(
-        jwt_secret=settings.jwt_secret,
+        jwt_secret=effective_secret,
         introspection_url=settings.introspection_url,
         introspection_client_id=settings.introspection_client_id,
         introspection_client_secret=settings.introspection_client_secret,
+        jwks_url=settings.jwks_url,
+        audience=settings.jwt_audience,
     )
     app = web.Application(
         middlewares=[
+            build_cors_middleware(settings.cors_origins),
             error_middleware,
             build_auth_middleware(
                 validator,

@@ -359,6 +359,64 @@ def test_api_cancel_and_promote_guards(tmp_path):
     run_async(main())
 
 
+def test_cors_preflight_and_headers(tmp_path):
+    async def main():
+        rt = _runtime(tmp_path)
+        rt.settings.cors_origins = ["https://ui.example.com"]
+        client = await _client(rt, with_monitor=False)
+
+        # preflight from an allowed origin
+        r = await client.options(
+            "/api/v1/jobs",
+            headers={
+                "Origin": "https://ui.example.com",
+                "Access-Control-Request-Method": "POST",
+                "Access-Control-Request-Headers": "authorization",
+            },
+        )
+        assert r.status == 204
+        assert r.headers["Access-Control-Allow-Origin"] == "https://ui.example.com"
+        assert "POST" in r.headers["Access-Control-Allow-Methods"]
+        assert "authorization" in r.headers["Access-Control-Allow-Headers"].lower()
+
+        # preflight from a disallowed origin is refused
+        r = await client.options(
+            "/api/v1/jobs",
+            headers={"Origin": "https://evil.example.com",
+                     "Access-Control-Request-Method": "POST"},
+        )
+        assert r.status == 403
+
+        # normal responses carry the CORS header for allowed origins only
+        r = await client.get("/api/v1/health",
+                             headers={"Origin": "https://ui.example.com"})
+        assert r.headers["Access-Control-Allow-Origin"] == "https://ui.example.com"
+        r = await client.get("/api/v1/health",
+                             headers={"Origin": "https://evil.example.com"})
+        assert "Access-Control-Allow-Origin" not in r.headers
+        await client.close()
+
+    run_async(main())
+
+
+def test_default_jwt_secret_refused_outside_local(tmp_path):
+    """ADVICE r1 (medium): auth enabled + well-known default secret + no
+    introspection/JWKS must refuse to start outside environment=local."""
+    from finetune_controller_tpu.controller.server import build_app
+
+    rt = _runtime(tmp_path, auth_enabled=True)
+    rt.settings.environment = "production"
+    with pytest.raises(RuntimeError, match="forgeable"):
+        build_app(rt)
+    # a real secret is accepted
+    rt.settings.jwt_secret = "an-actually-configured-secret"
+    build_app(rt)
+    # and local keeps working with the default (warn only)
+    rt2 = _runtime(tmp_path, auth_enabled=True)
+    assert rt2.settings.environment == "local"
+    build_app(rt2)
+
+
 def test_api_job_isolation_between_users(tmp_path):
     async def main():
         rt = _runtime(tmp_path, auth_enabled=True)

@@ -173,3 +173,99 @@ def test_entitlements():
     assert admin.entitled_models(["m1", "m2"]) == ["m1", "m2"]
     open_user = user_from_claims({"sub": "u2"})
     assert open_user.entitled_models(["m1", "m2"]) == ["m1", "m2"]
+
+
+# ---------------------------------------------------------------------------
+# JWKS / RS256 (reference: security.py:66-189)
+# ---------------------------------------------------------------------------
+
+
+def _rsa_keypair():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def b64u(i: int, length: int) -> str:
+        import base64
+
+        return base64.urlsafe_b64encode(i.to_bytes(length, "big")).rstrip(b"=").decode()
+
+    jwk = {
+        "kty": "RSA",
+        "kid": "test-key",
+        "alg": "RS256",
+        "n": b64u(pub.n, 256),
+        "e": b64u(pub.e, 3),
+    }
+    return key, jwk
+
+
+def _mint_rs256(key, claims: dict, kid: str = "test-key") -> str:
+    import base64
+    import json as _json
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    def b64(b: bytes) -> str:
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    header = b64(_json.dumps({"alg": "RS256", "typ": "JWT", "kid": kid}).encode())
+    payload = b64(_json.dumps(claims).encode())
+    sig = key.sign(
+        f"{header}.{payload}".encode(), padding.PKCS1v15(), hashes.SHA256()
+    )
+    return f"{header}.{payload}.{b64(sig)}"
+
+
+def test_rs256_jwks_validation():
+    import time as _time
+
+    from finetune_controller_tpu.controller.security import JWKSClient
+
+    key, jwk = _rsa_keypair()
+    fetches = []
+
+    async def fake_fetch(url):
+        fetches.append(url)
+        return {"keys": [jwk]}
+
+    async def go():
+        jwks = JWKSClient("https://idp/jwks", fetch_fn=fake_fetch)
+        v = TokenValidator(jwt_secret="unused", jwks_client=jwks)
+
+        tok = _mint_rs256(key, {"sub": "carol", "scp": ["m1"],
+                                "exp": _time.time() + 60})
+        user = await v.validate(tok)
+        assert user.user_id == "carol" and user.scopes == ["m1"]
+
+        # key cache: a second token does not refetch the JWKS
+        n_fetches = len(fetches)
+        tok2 = _mint_rs256(key, {"sub": "dave", "exp": _time.time() + 60})
+        assert (await v.validate(tok2)).user_id == "dave"
+        assert len(fetches) == n_fetches
+
+        # tampered signature rejected
+        other_key, _ = _rsa_keypair()
+        forged = _mint_rs256(other_key, {"sub": "mallory",
+                                         "exp": _time.time() + 60})
+        with pytest.raises(AuthError, match="signature"):
+            await v.validate(forged)
+
+        # unknown kid rejected (after refetch attempt)
+        bad_kid = _mint_rs256(key, {"sub": "x", "exp": _time.time() + 60},
+                              kid="nope")
+        with pytest.raises(AuthError, match="unknown signing key"):
+            await v.validate(bad_kid)
+
+        # expired rejected
+        old = _mint_rs256(key, {"sub": "y", "exp": 1.0})
+        with pytest.raises(AuthError, match="expired"):
+            await v.validate(old)
+
+        # HS256 tokens still validate via the secret (mixed deployments)
+        v2 = TokenValidator(jwt_secret="s", jwks_client=jwks)
+        assert (await v2.validate(dev_generate_token("bob", "s"))).user_id == "bob"
+
+    run(go())
