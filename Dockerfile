@@ -1,0 +1,39 @@
+# finetune-controller-tpu — one image, three roles (reference ships two
+# images, `Dockerfile:28` API + `Dockerfile.monitor:30` monitor, and delegates
+# training to user images; here the trainer is in-repo so the same image also
+# runs inside the TPU pods):
+#
+#   API server (default):  python -m finetune_controller_tpu.controller.server
+#   monitor daemon:        see Dockerfile.monitor
+#   training pod:          python -m finetune_controller_tpu.train.cli --spec ...
+#                          (the command the JobSet deployer renders,
+#                          controller/backends/k8s.py)
+#
+# Build:   docker build -t finetune-controller-tpu:latest .
+# TPU pods get real chips via the `google.com/tpu` resource; jax[tpu] pulls
+# libtpu from the Google releases index.
+
+FROM python:3.12-slim
+
+WORKDIR /app
+
+# native toolchain for the C++ data packer (native/packer.cc)
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+COPY pyproject.toml README.md ./
+COPY finetune_controller_tpu ./finetune_controller_tpu
+
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir ".[control]" pandas \
+    && python -c "from finetune_controller_tpu.native.build import ensure_built; ensure_built(quiet=False)"
+
+ENV PYTHONUNBUFFERED=1 \
+    FTC_ENVIRONMENT=production
+
+EXPOSE 8787
+
+CMD ["python", "-m", "finetune_controller_tpu.controller.server", \
+     "--host", "0.0.0.0", "--port", "8787"]

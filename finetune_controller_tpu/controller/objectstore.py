@@ -55,6 +55,9 @@ def artifacts_prefix(bucket: str, user_id: str, job_id: str) -> str:
 class ObjectStore:
     """Abstract async object store."""
 
+    async def close(self) -> None:
+        """Release network resources (no-op for local stores)."""
+
     async def put_bytes(self, uri: str, data: bytes) -> None:
         raise NotImplementedError
 
@@ -270,6 +273,23 @@ class LocalObjectStore(ObjectStore):
             )
             n += 1
         return n
+
+
+def build_object_store(settings) -> ObjectStore:
+    """Object-store factory from settings: ``local`` (hermetic CI) or ``gcs``
+    (cloud buckets over aiohttp — ``controller.gcs``). The seam the reference
+    hardwires to aioboto3 (``S3Handler.py:12,25``)."""
+    backend = getattr(settings, "object_store_backend", "local")
+    if backend == "local":
+        return LocalObjectStore(settings.object_store_path)
+    if backend == "gcs":
+        from .gcs import GCSObjectStore
+
+        return GCSObjectStore(
+            endpoint=settings.gcs_endpoint,
+            bucket_prefix=settings.gcs_bucket_prefix,
+        )
+    raise ValueError(f"unknown object_store_backend {backend!r}")
 
 
 class Presigner:

@@ -17,7 +17,7 @@ from .backends.local import LocalProcessBackend
 from .config import Settings, get_settings
 from .devices import DeviceCatalog, load_catalog
 from .monitor import JobMonitor
-from .objectstore import LocalObjectStore, ObjectStore, Presigner
+from .objectstore import ObjectStore, Presigner, build_object_store
 from .registry import load_model_modules
 from .statestore import StateStore
 
@@ -48,6 +48,7 @@ class Runtime:
         await self.monitor.stop()
         await self.backend.close()
         await self.state.close()
+        await self.store.close()
 
 
 def build_runtime(
@@ -59,7 +60,7 @@ def build_runtime(
     settings = settings or get_settings()
     load_model_modules(plugin_dir)
     state = StateStore(settings.state_path)
-    store = LocalObjectStore(settings.object_store_path)
+    store = build_object_store(settings)
     catalog = load_catalog(settings.device_config_file or None)
     backend: TrainingBackend
     if settings.backend == "local":

@@ -23,19 +23,25 @@ import time
 from pathlib import Path
 
 from .config import get_settings
-from .objectstore import LocalObjectStore
+from .objectstore import ObjectStore, build_object_store
 from .syncer import sync_dir_to_store
 
 logger = logging.getLogger(__name__)
 
 
-def _store() -> LocalObjectStore:
-    return LocalObjectStore(get_settings().object_store_path)
+def _store() -> ObjectStore:
+    """Backend comes from env (``FTC_OBJECT_STORE_BACKEND=local|gcs``) — the
+    pod-side selection the round-1 build lacked (it assumed a shared
+    filesystem mount, which does not survive a real GKE cluster)."""
+    return build_object_store(get_settings())
 
 
 async def cmd_get(uri: str, dest: str) -> int:
     store = _store()
-    n = await store.get_file(uri, dest)
+    try:
+        n = await store.get_file(uri, dest)
+    finally:
+        await store.close()
     logger.info("fetched %s -> %s (%d bytes)", uri, dest, n)
     return 0
 
@@ -48,29 +54,33 @@ async def cmd_sync(
     src_path = Path(src)
     synced: dict[str, tuple[float, int]] = {}
     done = Path(until_done_file) if until_done_file else None
-    while True:
-        try:
-            n = await sync_dir_to_store(
-                store, src_path, dest_uri, patterns=patterns, synced=synced
-            )
-            if n:
-                logger.info("synced %d file(s) -> %s", n, dest_uri)
-        except Exception:
+    try:
+        while True:
+            try:
+                n = await sync_dir_to_store(
+                    store, src_path, dest_uri, patterns=patterns, synced=synced
+                )
+                if n:
+                    logger.info("synced %d file(s) -> %s", n, dest_uri)
+            except Exception:
+                if done is None:
+                    # one-shot mode has no retry: a swallowed failure would
+                    # exit 0 and the caller would treat a failed upload as
+                    # success
+                    logger.exception("one-shot sync failed")
+                    return 1
+                logger.exception("sync pass failed; retrying")
+            if done is not None and done.exists():
+                await sync_dir_to_store(  # final pass
+                    store, src_path, dest_uri, patterns=patterns, synced=synced
+                )
+                logger.info("done-file present; exiting after final sync")
+                return 0
             if done is None:
-                # one-shot mode has no retry: a swallowed failure would exit 0
-                # and the caller would treat a failed upload as success
-                logger.exception("one-shot sync failed")
-                return 1
-            logger.exception("sync pass failed; retrying")
-        if done is not None and done.exists():
-            await sync_dir_to_store(  # final pass
-                store, src_path, dest_uri, patterns=patterns, synced=synced
-            )
-            logger.info("done-file present; exiting after final sync")
-            return 0
-        if done is None:
-            return 0  # one-shot mode
-        await asyncio.sleep(interval)
+                return 0  # one-shot mode
+            await asyncio.sleep(interval)
+    finally:
+        await store.close()
 
 
 def main(argv: list[str] | None = None) -> int:

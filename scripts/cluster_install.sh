@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# One-shot cluster install (reference: scripts/cluster_install.sh:54-81 —
+# Kubeflow Training Operator + Kueue + Mongo + app; the TPU build needs the
+# JobSet operator + Kueue + the controller itself, state rides in-process).
+#
+# Usage: scripts/cluster_install.sh [namespace]
+set -euo pipefail
+
+NAMESPACE="${1:-default}"
+JOBSET_VERSION="${JOBSET_VERSION:-v0.7.2}"
+KUEUE_VERSION="${KUEUE_VERSION:-v0.10.1}"
+IMAGE="${IMAGE:-finetune-controller-tpu:latest}"
+
+echo "==> installing JobSet operator ${JOBSET_VERSION}"
+kubectl apply --server-side -f \
+  "https://github.com/kubernetes-sigs/jobset/releases/download/${JOBSET_VERSION}/manifests.yaml"
+
+echo "==> installing Kueue ${KUEUE_VERSION}"
+kubectl apply --server-side -f \
+  "https://github.com/kubernetes-sigs/kueue/releases/download/${KUEUE_VERSION}/manifests.yaml"
+
+echo "==> waiting for operators"
+kubectl -n jobset-system rollout status deploy/jobset-controller-manager --timeout=180s
+kubectl -n kueue-system rollout status deploy/kueue-controller-manager --timeout=180s
+
+echo "==> rendering Kueue CRDs + controller deployments from the device catalog"
+python "$(dirname "$0")/render_crds.py" --namespace "${NAMESPACE}" --image "${IMAGE}"
+
+echo "==> service account + RBAC (JobSet/ConfigMap/pod-log access)"
+kubectl -n "${NAMESPACE}" apply -f - <<EOF
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: finetune-controller
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: finetune-controller
+rules:
+  - apiGroups: ["jobset.x-k8s.io"]
+    resources: ["jobsets"]
+    verbs: ["create", "get", "list", "delete", "watch"]
+  - apiGroups: [""]
+    resources: ["configmaps"]
+    verbs: ["create", "get", "delete"]
+  - apiGroups: [""]
+    resources: ["pods", "pods/log", "events"]
+    verbs: ["get", "list", "watch"]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: finetune-controller
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: Role
+  name: finetune-controller
+subjects:
+  - kind: ServiceAccount
+    name: finetune-controller
+EOF
+
+echo "==> applying rendered manifests"
+kubectl -n "${NAMESPACE}" apply -f deploy/kueue-crds.yaml
+kubectl -n "${NAMESPACE}" apply -f deploy/controller.yaml
+
+echo "==> done; API service: finetune-controller-api.${NAMESPACE}.svc"

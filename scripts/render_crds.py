@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Dump the Kueue CRDs (TPU ResourceFlavors + ClusterQueue + LocalQueues)
+generated from the device catalog, plus the controller Deployments, as YAML
+for `kubectl apply -f` (reference: static `crds/kueue/*.yaml` the operator had
+to hand-edit; ours are derived from the same catalog the scheduler enforces —
+`controller/backends/k8s.py:render_kueue_crds`).
+
+Usage:
+    python scripts/render_crds.py [--device-config config.json] \
+        [--namespace default] [--out deploy/]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import yaml
+
+from finetune_controller_tpu.controller.backends.k8s import render_kueue_crds
+from finetune_controller_tpu.controller.devices import load_catalog
+
+
+def controller_deployments(namespace: str, image: str) -> list[dict]:
+    """API + monitor Deployments (reference: scripts/cluster_install.sh
+    deploys both processes; SURVEY.md §1)."""
+
+    def deployment(name: str, command: list[str], port: int | None) -> dict:
+        container = {
+            "name": name,
+            "image": image,
+            "command": command,
+            "env": [
+                {"name": "FTC_BACKEND", "value": "k8s"},
+                {"name": "FTC_OBJECT_STORE_BACKEND", "value": "gcs"},
+                {"name": "FTC_NAMESPACE", "value": namespace},
+            ],
+        }
+        if port is not None:
+            container["ports"] = [{"containerPort": port}]
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "serviceAccountName": "finetune-controller",
+                        "containers": [container],
+                    },
+                },
+            },
+        }
+
+    api = deployment(
+        "finetune-controller-api",
+        ["python", "-m", "finetune_controller_tpu.controller.server",
+         "--host", "0.0.0.0", "--port", "8787"],
+        8787,
+    )
+    monitor = deployment(
+        "finetune-controller-monitor",
+        ["python", "-m", "finetune_controller_tpu.controller.monitor_main"],
+        None,
+    )
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "finetune-controller-api", "namespace": namespace},
+        "spec": {
+            "selector": {"app": "finetune-controller-api"},
+            "ports": [{"port": 80, "targetPort": 8787}],
+        },
+    }
+    return [api, monitor, service]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device-config", default=None,
+                   help="device catalog JSON (defaults to the built-in catalog)")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--image", default="finetune-controller-tpu:latest")
+    p.add_argument("--out", default="deploy")
+    args = p.parse_args()
+
+    catalog = load_catalog(args.device_config)
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+
+    crds = render_kueue_crds(catalog, namespace=args.namespace)
+    (out / "kueue-crds.yaml").write_text(yaml.safe_dump_all(crds, sort_keys=False))
+    deployments = controller_deployments(args.namespace, args.image)
+    (out / "controller.yaml").write_text(
+        yaml.safe_dump_all(deployments, sort_keys=False)
+    )
+    print(f"wrote {out / 'kueue-crds.yaml'} ({len(crds)} objects)")
+    print(f"wrote {out / 'controller.yaml'} ({len(deployments)} objects)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
