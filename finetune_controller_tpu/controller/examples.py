@@ -38,6 +38,11 @@ class LoRASFTArguments(TrainingArguments):
     lora_rank: int = Field(16, ge=1, le=256, description="LoRA adapter rank")
     weight_decay: float = Field(0.0, ge=0, description="AdamW weight decay")
     seed: int = Field(0, description="PRNG seed")
+    profile_steps: int = Field(
+        0, ge=0, le=100,
+        description="Capture a jax.profiler trace for N steps (0 = off); the "
+                    "trace ships with the job artifacts under profile/",
+    )
 
 
 class TinyLlamaLoRA(BaseFineTuneJob):

@@ -63,6 +63,12 @@ class TrainConfig:
     log_every: int = 10
     checkpoint_every: int = 100
     keep_checkpoints: int = 3
+    #: capture a jax.profiler trace for N steps (0 = off); the trace lands in
+    #: {artifacts_dir}/profile and ships with the artifacts (SURVEY.md §5.1 —
+    #: the reference has no tracing at all)
+    profile_steps: int = 0
+    #: first profiled step (default skips the compile step)
+    profile_start_step: int = 2
 
 
 class PreemptionGuard:
@@ -402,11 +408,27 @@ class Trainer:
         tokens_per_batch = self.cfg.batch_size * self.cfg.seq_len
         window_t0 = time.perf_counter()
         window_tokens = 0
+        # jax.profiler trace window (rank 0 only): ships with the artifacts
+        profiling = False
+        prof_first = start_step + self.cfg.profile_start_step
+        prof_last = prof_first + self.cfg.profile_steps  # exclusive
+        want_profile = self.cfg.profile_steps > 0 and jax.process_index() == 0
         try:
             for step_idx in range(start_step, self.cfg.total_steps):
+                if want_profile and not profiling and step_idx == prof_first:
+                    jax.profiler.start_trace(f"{artifacts_dir}/profile")
+                    profiling = True
                 batch = next(it)
                 state, metrics = self.step(state, batch)
                 window_tokens += tokens_per_batch
+                if profiling and step_idx + 1 >= prof_last:
+                    jax.block_until_ready(state)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    logger.info(
+                        "profiler trace for steps [%d, %d) -> %s/profile",
+                        prof_first, prof_last, artifacts_dir,
+                    )
 
                 last = step_idx + 1 == self.cfg.total_steps
                 if (step_idx + 1) % self.cfg.log_every == 0 or last:
@@ -446,5 +468,7 @@ class Trainer:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
                     raise SystemExit(143)
         finally:
+            if profiling:
+                jax.profiler.stop_trace()
             writer.close()
         return state

@@ -83,3 +83,20 @@ def test_checkpoint_resume_continues(devices8, tmp_path):
 
     ckpt = CheckpointManager(str(tmp_path / "checkpoints"))
     assert set(ckpt.all_steps()) >= {6, 9}
+
+
+def test_profiler_trace_ships_with_artifacts(tmp_path):
+    """SURVEY.md §5.1 gap: a jax.profiler trace window lands under
+    {artifacts}/profile so the artifact sync ships it with the job."""
+    model_cfg = _tiny_cfg()
+    cfg = TrainConfig(
+        mode="lora", total_steps=6, batch_size=2, seq_len=16,
+        log_every=100, checkpoint_every=1000,
+        profile_steps=2, profile_start_step=1,
+    )
+    trainer = Trainer(model_cfg, cfg)
+    batches = synthetic_batches(2, 16, model_cfg.vocab_size)
+    trainer.fit(batches, str(tmp_path), resume=False)
+    profile_dir = tmp_path / "profile"
+    traces = list(profile_dir.rglob("*.xplane.pb"))
+    assert traces, f"no trace files under {profile_dir}"
