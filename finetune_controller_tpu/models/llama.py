@@ -202,6 +202,82 @@ class Block(nn.Module):
         return x + mlp_out
 
 
+def stacked_block_variables(variables: dict) -> dict:
+    """Extract the layer-stacked block variables (leading layer axis) from a
+    ``scan_layers`` model's variable tree — the pipeline's stage parameters."""
+    out = {"params": variables["params"]["blocks"]["block"]}
+    if "lora" in variables and "blocks" in variables["lora"]:
+        out["lora"] = variables["lora"]["blocks"]["block"]
+    return out
+
+
+def make_block_stage_fn(cfg: LlamaConfig):
+    """Stage body for the GPipe pipeline: scan this stage's layer shard over
+    the activations (``parallel/pipeline.py`` contract). Honors ``cfg.remat``
+    exactly like the non-pipelined scan path — without it, reverse-mode would
+    save every layer's residuals for every tick and large models would OOM."""
+    block = Block(cfg)
+
+    def one_layer(layer_vars, h, positions, segment_ids):
+        return block.apply(layer_vars, h, positions, segment_ids, True)
+
+    if cfg.remat:
+        one_layer = jax.checkpoint(
+            one_layer, prevent_cse=False,
+            policy=jax.checkpoint_policies.nothing_saveable,
+        )
+
+    def stage_fn(stage_vars, x, positions, segment_ids):
+        def body(h, layer_vars):
+            return one_layer(layer_vars, h, positions, segment_ids), None
+
+        h, _ = jax.lax.scan(body, x, stage_vars)
+        return h
+
+    return stage_fn
+
+
+def pipelined_causal_lm_logits(
+    cfg: LlamaConfig,
+    variables: dict,
+    tokens: jax.Array,
+    *,
+    mesh,
+    n_micro: int,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Forward pass with the decoder blocks run as a GPipe pipeline over the
+    ``pp`` mesh axis (embedding and head stay outside the pipeline — they are
+    replicated over pp and sharded over the batch axes by GSPMD as usual).
+
+    NOTE: the embedding lookup, final norm, and head below mirror
+    ``LlamaForCausalLM.__call__`` — change them together. The pipeline
+    equivalence tests (``tests/test_pipeline.py``) compare this path against
+    ``model.apply`` and fail CI on any divergence."""
+    from ..parallel.pipeline import gpipe_blocks
+
+    params = variables["params"]
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = params["embed_tokens"]["embedding"].astype(cfg.dtype)[tokens]
+
+    x = gpipe_blocks(
+        stacked_block_variables(variables), x, positions, segment_ids,
+        stage_fn=make_block_stage_fn(cfg), mesh=mesh, n_micro=n_micro,
+    )
+
+    x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype).apply(
+        {"params": params["final_norm"]}, x
+    )
+    if cfg.tie_embeddings:
+        logits = x @ params["embed_tokens"]["embedding"].astype(cfg.dtype).T
+    else:
+        logits = LoRADense(
+            cfg.vocab_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype
+        ).apply({"params": params["lm_head"]}, x)
+    return logits.astype(jnp.float32)
+
+
 class _ScanBlock(nn.Module):
     """Block adapted to nn.scan's (carry, *broadcast) -> (carry, out) shape."""
 

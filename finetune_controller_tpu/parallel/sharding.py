@@ -34,8 +34,10 @@ class PartitionRules:
                 if value is None or ndim is None or len(spec) == ndim:
                     return spec
                 if len(spec) == ndim - 1 and "blocks" in path:
-                    # Layer-stacked (nn.scan) params carry a leading layer axis.
-                    return P(None, *spec)
+                    # Layer-stacked (nn.scan) params carry a leading layer
+                    # axis — the pipeline axis. With pp=1 this is a no-op;
+                    # with pp>1 each stage holds its contiguous layer shard.
+                    return P(Ax.PIPE, *spec)
                 if len(spec) > ndim:
                     # Rank-mismatch safety: replicate rather than mis-shard.
                     return P()

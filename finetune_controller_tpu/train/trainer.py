@@ -69,6 +69,9 @@ class TrainConfig:
     profile_steps: int = 0
     #: first profiled step (default skips the compile step)
     profile_start_step: int = 2
+    #: GPipe microbatches when the mesh has pp > 1 (0 = 2·pp, a reasonable
+    #: bubble/memory tradeoff); must divide the per-dp-shard batch
+    pp_microbatches: int = 0
 
 
 class PreemptionGuard:
@@ -113,6 +116,24 @@ class Trainer:
             self.model = LlavaForCausalLM(model_cfg)
         else:
             self.model = LlamaForCausalLM(model_cfg)
+
+        self._pp = self.mesh.shape.get("pp", 1)
+        if self._pp > 1:
+            from ..parallel.pipeline import validate_pp_mesh
+
+            validate_pp_mesh(self.mesh)
+            if self._is_multimodal or model_cfg.n_experts:
+                raise ValueError(
+                    "pipeline parallelism currently supports dense text models"
+                )
+            if not model_cfg.scan_layers:
+                raise ValueError("pp > 1 requires scan_layers=True (stacked params)")
+            if model_cfg.n_layers % self._pp:
+                raise ValueError(
+                    f"n_layers {model_cfg.n_layers} not divisible by pp {self._pp}"
+                )
+            if model_cfg.lora.rank > 0 and model_cfg.lora.dropout > 0:
+                raise ValueError("pp > 1 does not support LoRA dropout yet")
         self.tx, self.sched = build_optimizer(
             learning_rate=train_cfg.learning_rate,
             warmup_steps=train_cfg.warmup_steps,
@@ -233,6 +254,30 @@ class Trainer:
 
     def _loss_fn(self, trainable, frozen, batch, dropout_rng):
         variables = self._assemble(frozen, trainable)
+        if self._pp > 1:
+            # dropout_rng is intentionally unused here: the constructor
+            # rejects pp>1 with LoRA dropout; if that guard is ever relaxed,
+            # this branch must thread rngs through the pipeline too.
+            assert not self._use_dropout, "pp path has no dropout support"
+            from ..models.llama import pipelined_causal_lm_logits
+
+            n_micro = self.cfg.pp_microbatches
+            if not n_micro:
+                # default: the largest microbatch count <= 2·pp that divides
+                # the per-data-shard batch (2·pp halves the GPipe bubble)
+                local = batch["tokens"].shape[0] // (
+                    self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
+                )
+                n_micro = max(
+                    (m for m in range(1, 2 * self._pp + 1) if local % m == 0),
+                    default=1,
+                )
+            logits = pipelined_causal_lm_logits(
+                self.model_cfg, variables, batch["tokens"],
+                mesh=self.mesh, n_micro=n_micro,
+                segment_ids=batch.get("segment_ids"),
+            )
+            return next_token_loss(logits, batch["tokens"], batch.get("loss_mask"))
         rngs = {"dropout": dropout_rng} if self._use_dropout else None
         apply_kw: dict[str, Any] = dict(
             segment_ids=batch.get("segment_ids"),
@@ -411,8 +456,17 @@ class Trainer:
         # jax.profiler trace window (rank 0 only): ships with the artifacts
         profiling = False
         prof_first = start_step + self.cfg.profile_start_step
-        prof_last = prof_first + self.cfg.profile_steps  # exclusive
         want_profile = self.cfg.profile_steps > 0 and jax.process_index() == 0
+        if want_profile and prof_first >= self.cfg.total_steps:
+            # a requested trace must never silently no-op: clamp the window
+            # to the run instead of skipping it
+            logger.warning(
+                "profile_start_step %d is past the run (total_steps %d); "
+                "profiling from the first step instead",
+                self.cfg.profile_start_step, self.cfg.total_steps,
+            )
+            prof_first = start_step
+        prof_last = prof_first + self.cfg.profile_steps  # exclusive
         try:
             for step_idx in range(start_step, self.cfg.total_steps):
                 if want_profile and not profiling and step_idx == prof_first:

@@ -40,9 +40,10 @@ def test_partition_rules_paths():
     assert r.spec_for("params/layer_0/mlp/down_proj/kernel", Arr(2)) == P("tp", "fsdp")
     assert r.spec_for("params/embed_tokens/embedding", Arr(2)) == P("tp", "fsdp")
     assert r.spec_for("params/layer_0/attn_norm/scale", Arr(1)) == P()
-    # scanned stacks get a leading layer axis
-    assert r.spec_for("params/blocks/block/attn/q_proj/kernel", Arr(3)) == P(None, "fsdp", "tp")
-    assert r.spec_for("lora/blocks/block/attn/q_proj/lora_a", Arr(3)) == P(None, "fsdp", None)
+    # scanned stacks get a leading layer axis — the pipeline axis (size 1
+    # unless the mesh actually has pp > 1)
+    assert r.spec_for("params/blocks/block/attn/q_proj/kernel", Arr(3)) == P("pp", "fsdp", "tp")
+    assert r.spec_for("lora/blocks/block/attn/q_proj/lora_a", Arr(3)) == P("pp", "fsdp", None)
 
 
 def test_tree_specs_on_real_model(devices8):
@@ -55,11 +56,11 @@ def test_tree_specs_on_real_model(devices8):
     flat = jax.tree_util.tree_flatten_with_path(
         specs, is_leaf=lambda x: isinstance(x, P)
     )[0]
-    # every scanned kernel got a 3-long spec with leading None
+    # every scanned kernel got a 3-long spec with the layer axis on pp
     kernel_specs = [
         s for kp, s in flat if "kernel" in jax.tree_util.keystr(kp)
     ]
     assert kernel_specs, "no kernels found"
     for s in kernel_specs:
         if len(s) == 3:
-            assert s[0] is None
+            assert s[0] == "pp"

@@ -1,0 +1,109 @@
+"""Pipeline-parallel (GPipe over the ``pp`` mesh axis) tests — closes the one
+parallelism row SURVEY.md §2.3 still listed as absent.
+
+All on the 8-virtual-device CPU mesh: numerical equivalence against the
+non-pipelined forward (f32, where rounding order cannot hide bugs), gradient
+equivalence through the differentiated schedule, an end-to-end Trainer run on
+a dp×pp mesh, and the composition guards.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from finetune_controller_tpu.data import synthetic_batches
+from finetune_controller_tpu.models.llama import (
+    PRESETS,
+    LlamaForCausalLM,
+    pipelined_causal_lm_logits,
+)
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.parallel.mesh import MeshSpec
+from finetune_controller_tpu.parallel.pipeline import validate_pp_mesh
+from finetune_controller_tpu.train import Trainer, TrainConfig
+
+
+def _setup(devices8, dtype=jnp.float32, n_layers=4):
+    cfg = PRESETS["tiny-test"].replace(
+        lora=LoRAConfig(rank=4), n_layers=n_layers, dtype=dtype
+    )
+    model = LlamaForCausalLM(cfg)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 32)
+    ).astype(np.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, jnp.asarray(tokens))
+    mesh = MeshSpec(dp=2, fsdp=1, pp=4).build(devices8)
+    return cfg, model, dict(variables), jnp.asarray(tokens), mesh
+
+
+def test_pipeline_forward_matches_reference(devices8):
+    cfg, model, variables, tokens, mesh = _setup(devices8)
+    ref = model.apply(variables, tokens)
+    with mesh:
+        out = pipelined_causal_lm_logits(
+            cfg, variables, tokens, mesh=mesh, n_micro=4
+        )
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_pipeline_uneven_microbatches_and_segments(devices8):
+    cfg, model, variables, tokens, mesh = _setup(devices8)
+    seg = (jnp.arange(32)[None, :] // 16).astype(jnp.int32).repeat(8, 0)
+    ref = model.apply(variables, tokens, segment_ids=seg)
+    with mesh:
+        # M=2 < P=4: more bubble, same numbers
+        out = pipelined_causal_lm_logits(
+            cfg, variables, tokens, mesh=mesh, n_micro=2, segment_ids=seg
+        )
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_pipeline_grads_match_reference(devices8):
+    cfg, model, variables, tokens, mesh = _setup(devices8)
+
+    def loss_pp(lora):
+        v = {**variables, "lora": lora}
+        with mesh:
+            lg = pipelined_causal_lm_logits(cfg, v, tokens, mesh=mesh, n_micro=4)
+        return (lg.astype(jnp.float32) ** 2).mean()
+
+    def loss_ref(lora):
+        v = {**variables, "lora": lora}
+        return (model.apply(v, tokens).astype(jnp.float32) ** 2).mean()
+
+    g1 = jax.grad(loss_pp)(variables["lora"])
+    g2 = jax.grad(loss_ref)(variables["lora"])
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+def test_trainer_trains_on_dp_pp_mesh(devices8, tmp_path):
+    cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=4))
+    train_cfg = TrainConfig(
+        mode="lora", learning_rate=2e-2, warmup_steps=2, total_steps=40,
+        batch_size=8, seq_len=32, log_every=5, checkpoint_every=1000,
+    )
+    mesh = MeshSpec(dp=2, fsdp=1, pp=2, tp=1).build(devices8[:4])
+    trainer = Trainer(cfg, train_cfg, mesh=mesh)
+    batches = synthetic_batches(8, 32, cfg.vocab_size, task="increment")
+    losses = []
+    trainer.fit(
+        batches, str(tmp_path), on_metrics=lambda s, m: losses.append(m["loss"])
+    )
+    assert losses[-1] < losses[0] * 0.7, f"loss did not drop: {losses}"
+
+
+def test_pp_composition_guards(devices8):
+    mesh = MeshSpec(dp=1, fsdp=1, pp=4, tp=2).build(devices8)
+    with pytest.raises(ValueError, match="composes with dp only"):
+        validate_pp_mesh(mesh)
+
+    moe_cfg = PRESETS["tiny-moe-test"].replace(lora=LoRAConfig(rank=4))
+    pp_mesh = MeshSpec(dp=2, fsdp=1, pp=4).build(devices8)
+    with pytest.raises(ValueError, match="dense text"):
+        Trainer(moe_cfg, TrainConfig(mode="lora"), mesh=pp_mesh)
+
+    odd_cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=4), n_layers=3)
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        Trainer(odd_cfg, TrainConfig(mode="lora"), mesh=pp_mesh)
