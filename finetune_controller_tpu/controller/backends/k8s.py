@@ -384,12 +384,183 @@ class KubeClient:
 
 
 class InMemoryKubeClient(KubeClient):
-    """Hermetic fake API server for tests; test code mutates ``objects`` to
-    script status transitions."""
+    """Hermetic fake API server for tests.
 
-    def __init__(self):
+    Two modes: test code may mutate ``objects`` directly to script status
+    transitions, or use the built-in **Kueue/pod simulation** —
+    :meth:`kueue_tick` admits suspended JobSets FIFO within a chip quota
+    (unsuspend + pod creation + active status, what the real Kueue and JobSet
+    operators do), and :meth:`finish_jobset` drives terminal conditions — so
+    backend tests exercise the real SUSPENDED → RUNNING → terminal mapping
+    and the rank-0 pod-resolution path instead of hand-written fixtures.
+
+    On create, JobSet manifests are schema-checked the way the operators
+    would reject them: coordinator DNS convention, downward-API annotation
+    paths, and Indexed completion mode.
+    """
+
+    def __init__(self, *, quota_chips: int | None = None):
         self.objects: dict[tuple[str, str], dict[str, Any]] = {}
         self.pod_logs: dict[str, list[str]] = {}
+        self.quota_chips = quota_chips
+
+    # -- JobSet manifest validation (what a real API server/operator rejects) --
+
+    @staticmethod
+    def _validate_jobset(body: dict[str, Any]) -> None:
+        name = body["metadata"]["name"]
+        spec = body["spec"]
+        if "suspend" not in spec:
+            raise BackendError(f"JobSet {name}: missing spec.suspend (Kueue contract)")
+        rjs = spec.get("replicatedJobs") or []
+        if not rjs:
+            raise BackendError(f"JobSet {name}: no replicatedJobs")
+        rj = rjs[0]
+        job_spec = rj["template"]["spec"]
+        if job_spec.get("completionMode") != "Indexed":
+            raise BackendError(
+                f"JobSet {name}: completionMode must be Indexed for the "
+                "downward-API completion index to exist"
+            )
+        pod_spec = job_spec["template"]["spec"]
+        containers = {c["name"]: c for c in pod_spec.get("containers", [])}
+        trainer = containers.get("trainer")
+        if trainer is None:
+            raise BackendError(f"JobSet {name}: no trainer container")
+        env = {e["name"]: e for e in trainer.get("env", [])}
+        coord = env.get("FTC_COORDINATOR_ADDRESS", {}).get("value", "")
+        # the headless service JobSet creates is named after the jobset; pod 0
+        # of replicated job 0 must be the coordinator
+        want_prefix = f"{name}-{rj['name']}-0-0.{name}:"
+        if not coord.startswith(want_prefix):
+            raise BackendError(
+                f"JobSet {name}: coordinator {coord!r} does not match the "
+                f"JobSet DNS convention {want_prefix}<port>"
+            )
+        for var, field in (
+            ("FTC_SLICE_INDEX", "jobset.sigs.k8s.io/job-index"),
+            ("JOB_COMPLETION_INDEX", "batch.kubernetes.io/job-completion-index"),
+        ):
+            got = (
+                env.get(var, {})
+                .get("valueFrom", {})
+                .get("fieldRef", {})
+                .get("fieldPath", "")
+            )
+            if f"['{field}']" not in got:
+                raise BackendError(
+                    f"JobSet {name}: env {var} must come from the downward-API "
+                    f"annotation {field!r}, got {got!r}"
+                )
+
+    # -- Kueue + JobSet operator simulation ------------------------------------
+
+    def _jobsets(self) -> list[dict[str, Any]]:
+        return [
+            obj for (path, _), obj in self.objects.items()
+            if path.endswith(f"/{JOBSET_PLURAL}")
+        ]
+
+    @staticmethod
+    def _is_terminal(obj: dict[str, Any]) -> bool:
+        return any(
+            c.get("status") == "True" and c.get("type") in ("Completed", "Failed")
+            for c in obj.get("status", {}).get("conditions", [])
+        )
+
+    @staticmethod
+    def _chips(obj: dict[str, Any]) -> int:
+        return int(obj["metadata"].get("labels", {}).get("ftc/chips", 0) or 0)
+
+    def _pods_path(self, namespace: str) -> str:
+        return f"/api/v1/namespaces/{namespace}/pods"
+
+    def kueue_tick(self) -> None:
+        """One reconcile pass of the fake Kueue + JobSet operators: admit
+        suspended JobSets FIFO within the chip quota, then materialise pods
+        and active status for every admitted, non-terminal JobSet."""
+        jobsets = sorted(
+            self._jobsets(), key=lambda o: o["metadata"].get("creationTimestamp", 0)
+        )
+        used = sum(
+            self._chips(o) for o in jobsets
+            if not o["spec"].get("suspend") and not self._is_terminal(o)
+        )
+        for obj in jobsets:
+            if not obj["spec"].get("suspend") or self._is_terminal(obj):
+                continue
+            chips = self._chips(obj)
+            if self.quota_chips is not None and used + chips > self.quota_chips:
+                continue  # FIFO with borrowing disabled: later jobs may still fit
+            obj["spec"]["suspend"] = False
+            used += chips
+        for obj in jobsets:
+            if obj["spec"].get("suspend") or self._is_terminal(obj):
+                continue
+            self._materialise_pods(obj)
+
+    def _materialise_pods(self, obj: dict[str, Any]) -> None:
+        name = obj["metadata"]["name"]
+        namespace = obj["metadata"].get("namespace", "default")
+        status = obj.setdefault("status", {})
+        rj_status = []
+        for rj in obj["spec"]["replicatedJobs"]:
+            hosts = rj["template"]["spec"].get("parallelism", 1)
+            replicas = rj.get("replicas", 1)
+            for slice_idx in range(replicas):
+                for host_idx in range(hosts):
+                    pod_name = f"{name}-{rj['name']}-{slice_idx}-{host_idx}"
+                    key = (self._pods_path(namespace), pod_name)
+                    if key in self.objects:
+                        continue
+                    self.objects[key] = {
+                        "metadata": {
+                            "name": pod_name,
+                            "namespace": namespace,
+                            "creationTimestamp": time.time(),
+                            "labels": {
+                                "jobset.sigs.k8s.io/jobset-name": name,
+                                "jobset.sigs.k8s.io/job-index": str(slice_idx),
+                                "batch.kubernetes.io/job-completion-index": str(host_idx),
+                            },
+                        },
+                        "status": {"phase": "Running"},
+                    }
+                    self.pod_logs.setdefault(pod_name, []).append(
+                        f"{pod_name}: training started"
+                    )
+            rj_status.append({"name": rj["name"], "active": replicas * hosts})
+        status["replicatedJobsStatus"] = rj_status
+
+    def finish_jobset(
+        self, name: str, *, failed: bool = False, message: str = ""
+    ) -> None:
+        """Drive a JobSet to a terminal condition; succeeded pods are removed
+        (the kubelet reaps them), failed pods stay for forensics."""
+        for obj in self._jobsets():
+            if obj["metadata"]["name"] != name:
+                continue
+            status = obj.setdefault("status", {})
+            status["replicatedJobsStatus"] = []
+            status.setdefault("conditions", []).append(
+                {
+                    "type": "Failed" if failed else "Completed",
+                    "status": "True",
+                    "message": message,
+                }
+            )
+            if not failed:
+                namespace = obj["metadata"].get("namespace", "default")
+                for key in [
+                    k for k in self.objects
+                    if k[0] == self._pods_path(namespace)
+                    and self.objects[k]["metadata"]["labels"].get(
+                        "jobset.sigs.k8s.io/jobset-name"
+                    ) == name
+                ]:
+                    del self.objects[key]
+            return
+        raise BackendError(f"unknown JobSet {name!r}")
 
     @staticmethod
     def _name(body: dict[str, Any]) -> str:
@@ -399,6 +570,8 @@ class InMemoryKubeClient(KubeClient):
         key = (api_path, self._name(body))
         if key in self.objects:
             raise BackendError(f"{key} already exists")
+        if body.get("kind") == "JobSet":
+            self._validate_jobset(body)
         body.setdefault("metadata", {})["creationTimestamp"] = time.time()
         self.objects[key] = body
         return body

@@ -51,13 +51,29 @@ class Collection:
 
     _COMPACT_MIN_RECORDS = 1024
 
-    def __init__(self, path: Path | None, key: str):
+    def __init__(self, path: Path | None, key: str, index_fields: tuple[str, ...] = ()):
         self._path = path
         self._key = key
         self._docs: dict[str, dict[str, Any]] = {}
         self._lock = asyncio.Lock()
         self._loaded = False
         self._log_records = 0
+        # secondary equality indexes: field -> value -> set of primary keys
+        # (reference: Mongo ``_ensure_indexes``, ``db.py:77-105``)
+        self._index_fields = index_fields
+        self._index: dict[str, dict[Any, set[str]]] = {f: {} for f in index_fields}
+
+    def _index_add(self, doc: dict[str, Any]) -> None:
+        for f in self._index_fields:
+            self._index[f].setdefault(doc.get(f), set()).add(doc[self._key])
+
+    def _index_remove(self, doc: dict[str, Any]) -> None:
+        for f in self._index_fields:
+            bucket = self._index[f].get(doc.get(f))
+            if bucket is not None:
+                bucket.discard(doc[self._key])
+                if not bucket:
+                    del self._index[f][doc.get(f)]
 
     def _load(self) -> None:
         if self._loaded:
@@ -72,9 +88,15 @@ class Collection:
                     rec = json.loads(line)
                     self._log_records += 1
                     if "__tombstone__" in rec:
-                        self._docs.pop(rec["__tombstone__"], None)
+                        old = self._docs.pop(rec["__tombstone__"], None)
+                        if old is not None:
+                            self._index_remove(old)
                     else:
+                        old = self._docs.get(rec[self._key])
+                        if old is not None:
+                            self._index_remove(old)
                         self._docs[rec[self._key]] = rec
+                        self._index_add(rec)
 
     def _append(self, record: dict[str, Any]) -> None:
         if self._path is None:
@@ -98,7 +120,11 @@ class Collection:
         async with self._lock:
             await asyncio.to_thread(self._load)
             doc = dict(doc)
+            old = self._docs.get(doc[self._key])
+            if old is not None:
+                self._index_remove(old)
             self._docs[doc[self._key]] = doc
+            self._index_add(doc)
             await asyncio.to_thread(self._append, doc)
 
     async def get(self, key: str) -> dict[str, Any] | None:
@@ -115,7 +141,9 @@ class Collection:
             doc = self._docs.get(key)
             if doc is None:
                 return False
+            self._index_remove(doc)
             doc.update(fields)
+            self._index_add(doc)
             await asyncio.to_thread(self._append, doc)
             return True
 
@@ -134,7 +162,9 @@ class Collection:
             doc = self._docs.get(key)
             if doc is None or not predicate(doc):
                 return False
+            self._index_remove(doc)
             doc.update(fields)
+            self._index_add(doc)
             await asyncio.to_thread(self._append, doc)
             return True
 
@@ -157,15 +187,32 @@ class Collection:
             await asyncio.to_thread(self._load)
             doc = self._docs.pop(key, None)
             if doc is not None:
+                self._index_remove(doc)
                 await asyncio.to_thread(self._append, {"__tombstone__": key})
             return doc
 
     async def find(
-        self, predicate: Callable[[dict[str, Any]], bool] | None = None
+        self,
+        predicate: Callable[[dict[str, Any]], bool] | None = None,
+        *,
+        eq: dict[str, Any] | None = None,
     ) -> list[dict[str, Any]]:
+        """``eq`` filters on indexed fields WITHOUT scanning the collection
+        (the in-memory-index promise); ``predicate`` refines the candidates."""
         async with self._lock:
             await asyncio.to_thread(self._load)
-            docs = [dict(d) for d in self._docs.values()]
+            if eq:
+                keys: set[str] | None = None
+                for f, v in eq.items():
+                    if f not in self._index:
+                        raise KeyError(f"field {f!r} is not indexed on this collection")
+                    bucket = self._index[f].get(v, set())
+                    keys = bucket if keys is None else keys & bucket
+                # primary-key order: set iteration is hash-randomized, and
+                # paginated callers need a deterministic tie-break
+                docs = [dict(self._docs[k]) for k in sorted(keys or ())]
+            else:
+                docs = [dict(d) for d in self._docs.values()]
         if predicate is not None:
             docs = [d for d in docs if predicate(d)]
         return docs
@@ -189,10 +236,10 @@ class StateStore:
         def path(name: str) -> Path | None:
             return None if self._dir is None else self._dir / f"{name}.jsonl"
 
-        self.jobs = Collection(path("jobs"), "job_id")
+        self.jobs = Collection(path("jobs"), "job_id", index_fields=("user_id", "status"))
         self.archived_jobs = Collection(path("archived_jobs"), "job_id")
         self.metrics = Collection(path("metrics"), "job_id")
-        self.datasets = Collection(path("datasets"), "dataset_id")
+        self.datasets = Collection(path("datasets"), "dataset_id", index_fields=("user_id",))
         self._connected = False
 
     # -- lifecycle (reference: connect/_ensure_indexes, db.py:33-105) --------
@@ -302,11 +349,12 @@ class StateStore:
         ``user_id=None`` lists all users' jobs (the admin view,
         ``app/main.py:1099-1297``).
         """
-        docs = await self.jobs.find(
-            lambda d: user_id is None or d["user_id"] == user_id
-        )
+        eq: dict[str, Any] = {}
+        if user_id is not None:
+            eq["user_id"] = user_id
         if status is not None:
-            docs = [d for d in docs if d["status"] == DatabaseStatus(status).value]
+            eq["status"] = DatabaseStatus(status).value
+        docs = await self.jobs.find(eq=eq or None)
         if search:
             needle = search.lower()
             docs = [
@@ -369,7 +417,7 @@ class StateStore:
         return DatasetRecord(**doc) if doc else None
 
     async def get_user_datasets(self, user_id: str) -> list[DatasetRecord]:
-        docs = await self.datasets.find(lambda d: d["user_id"] == user_id)
+        docs = await self.datasets.find(eq={"user_id": user_id})
         docs.sort(key=lambda d: d["created_at"], reverse=True)
         return [DatasetRecord(**d) for d in docs]
 

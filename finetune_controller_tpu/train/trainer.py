@@ -457,7 +457,15 @@ class Trainer:
         profiling = False
         prof_first = start_step + self.cfg.profile_start_step
         want_profile = self.cfg.profile_steps > 0 and jax.process_index() == 0
-        if want_profile and prof_first >= self.cfg.total_steps:
+        if want_profile and start_step >= self.cfg.total_steps:
+            # resumed past the end: no step will run, so no trace can exist
+            logger.warning(
+                "profiling requested but the run is already complete "
+                "(resumed at step %d of %d); no trace will be captured",
+                start_step, self.cfg.total_steps,
+            )
+            want_profile = False
+        elif want_profile and prof_first >= self.cfg.total_steps:
             # a requested trace must never silently no-op: clamp the window
             # to the run instead of skipping it
             logger.warning(

@@ -337,3 +337,54 @@ def test_presigner():
     assert not p.verify("obj://b/k", tok, now=1200.0)  # expired
     assert not p.verify("obj://b/other", tok, now=1050.0)  # wrong uri
     assert not p.verify("obj://b/k", "garbage", now=1050.0)
+
+
+def test_secondary_indexes_consistent(tmp_path):
+    """Equality lookups use the in-memory secondary indexes (no collection
+    scan) and stay consistent across insert/update/delete AND log replay."""
+    from finetune_controller_tpu.controller.schemas import JobRecord
+
+    store = StateStore(tmp_path / "state")
+
+    async def go():
+        await store.connect()
+        for i in range(6):
+            await store.create_job(JobRecord(
+                job_id=f"j{i}", user_id="alice" if i % 2 else "bob",
+                model_name="m", device="d",
+            ))
+        alice = await store.jobs.find(eq={"user_id": "alice"})
+        assert {d["job_id"] for d in alice} == {"j1", "j3", "j5"}
+
+        # status transitions move docs between index buckets
+        await store.update_job_status("j1", DatabaseStatus.RUNNING)
+        running = await store.jobs.find(eq={"status": "running"})
+        assert [d["job_id"] for d in running] == ["j1"]
+        combo = await store.jobs.find(eq={"user_id": "alice", "status": "running"})
+        assert [d["job_id"] for d in combo] == ["j1"]
+
+        # delete removes from buckets
+        await store.delete_job("j3")
+        alice = await store.jobs.find(eq={"user_id": "alice"})
+        assert {d["job_id"] for d in alice} == {"j1", "j5"}
+
+        # unindexed field refuses (a silent scan would hide the regression)
+        import pytest as _pytest
+        with _pytest.raises(KeyError):
+            await store.jobs.find(eq={"model_name": "m"})
+        await store.close()
+
+    run(go())
+
+    # fresh process: indexes rebuilt from the JSONL log replay
+    store2 = StateStore(tmp_path / "state")
+
+    async def go2():
+        await store2.connect()
+        alice = await store2.jobs.find(eq={"user_id": "alice"})
+        assert {d["job_id"] for d in alice} == {"j1", "j5"}
+        running = await store2.jobs.find(eq={"status": "running"})
+        assert [d["job_id"] for d in running] == ["j1"]
+        await store2.close()
+
+    run(go2())

@@ -9,7 +9,10 @@ kube-apiserver').
 
 import json
 
+import pytest
+
 from conftest import run_async, tiny_job_spec
+from finetune_controller_tpu.controller.backends.base import BackendError
 from finetune_controller_tpu.controller.backends.k8s import (
     InMemoryKubeClient,
     K8sJobSetBackend,
@@ -318,3 +321,96 @@ def test_report_uses_condition_transition_time():
     report = backend._report(obj)
     assert report.state is BackendJobState.SUCCEEDED
     assert report.completion_time - report.start_time == 3600.0
+
+
+def test_k8s_backend_simulated_kueue_lifecycle(tmp_path):
+    """Full lifecycle against the SIMULATED Kueue/JobSet operators (round-1
+    weak spot: transitions were only ever hand-written fixtures): FIFO
+    admission under chip quota, pod materialisation with real JobSet labels,
+    rank-0 log resolution against simulator-created pods, terminal states."""
+
+    async def main():
+        # quota fits one v5e-16 job (16 chips) at a time
+        client = InMemoryKubeClient(quota_chips=16)
+        backend = K8sJobSetBackend(CATALOG, Settings(namespace="ftc"), client=client)
+        def mk(jid):
+            return JobInput(job_id=jid, user_id="alice",
+                            model_name="llama3-8b-lora", device="v5e-16",
+                            arguments={})
+        j1, j2 = mk("sim-1"), mk("sim-2")
+        for j in (j1, j2):
+            await backend.submit(
+                j, tiny_job_spec(), CATALOG.get("v5e-16"),
+                dataset_uri=None, artifacts_uri="obj://artifacts/x",
+            )
+        assert await backend.queue_snapshot() == ["sim-1", "sim-2"]
+
+        # fake Kueue admits FIFO within quota: sim-1 runs, sim-2 waits
+        client.kueue_tick()
+        r1 = await backend.get_job("sim-1")
+        r2 = await backend.get_job("sim-2")
+        assert r1.state is BackendJobState.RUNNING
+        assert r2.state is BackendJobState.SUSPENDED
+        assert await backend.queue_snapshot() == ["sim-2"]
+
+        # rank-0 pod was materialised by the simulator with real labels;
+        # logs stream through it
+        lines = [l async for l in await backend.read_logs("sim-1")]
+        assert any("training started" in l for l in lines)
+
+        # sim-1 finishes -> quota frees -> sim-2 admitted on the next tick
+        client.finish_jobset("sim-1")
+        assert (await backend.get_job("sim-1")).state is BackendJobState.SUCCEEDED
+        client.kueue_tick()
+        assert (await backend.get_job("sim-2")).state is BackendJobState.RUNNING
+
+        # failed jobs keep their pods for forensics
+        client.finish_jobset("sim-2", failed=True, message="boom")
+        r2 = await backend.get_job("sim-2")
+        assert r2.state is BackendJobState.FAILED and "boom" in r2.message
+        pods = await client.list(
+            "/api/v1/namespaces/ftc/pods",
+            "jobset.sigs.k8s.io/jobset-name=sim-2",
+        )
+        assert pods, "failed job's pods must be retained"
+        await backend.close()
+
+    run_async(main())
+
+
+def test_k8s_fake_rejects_malformed_jobset():
+    """The fake API server enforces the operator contracts a real cluster
+    would: coordinator DNS convention + downward-API annotations."""
+
+    async def main():
+        client = InMemoryKubeClient()
+        backend = K8sJobSetBackend(CATALOG, Settings(namespace="ftc"), client=client)
+        from finetune_controller_tpu.controller.backends.k8s import render_jobset
+
+        js = render_jobset(
+            JobInput(job_id="bad-1", user_id="a", model_name="m", device="v5e-16", arguments={}), tiny_job_spec(), CATALOG.get("v5e-16"),
+            namespace="ftc", image="x", dataset_uri=None,
+            artifacts_uri="obj://artifacts/x",
+        )
+        # break the coordinator address convention
+        env = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+            "spec"]["containers"][0]["env"]
+        next(e for e in env if e["name"] == "FTC_COORDINATOR_ADDRESS")[
+            "value"] = "wrong-host:1234"
+        with pytest.raises(BackendError, match="DNS convention"):
+            await client.create(backend._jobsets_path, js)
+
+        # break a downward-API annotation path
+        js2 = render_jobset(
+            JobInput(job_id="bad-2", user_id="a", model_name="m", device="v5e-16", arguments={}), tiny_job_spec(), CATALOG.get("v5e-16"),
+            namespace="ftc", image="x", dataset_uri=None,
+            artifacts_uri="obj://artifacts/x",
+        )
+        env2 = js2["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+            "spec"]["containers"][0]["env"]
+        next(e for e in env2 if e["name"] == "FTC_SLICE_INDEX")["valueFrom"][
+            "fieldRef"]["fieldPath"] = "metadata.annotations['wrong/key']"
+        with pytest.raises(BackendError, match="downward-API"):
+            await client.create(backend._jobsets_path, js2)
+
+    run_async(main())
