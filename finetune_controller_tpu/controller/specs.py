@@ -108,6 +108,9 @@ class BaseFineTuneJob(BaseModel):
     #: by :func:`finetune_controller_tpu.controller.devices.default_mesh_for`.
     #: MoE families set ``{"ep": N, "fsdp": -1}``, long-context ones add sp.
     mesh_policy: ClassVar[dict[str, int]] = {"fsdp": -1}
+    #: HF checkpoint directory with the pretrained base weights (staged into
+    #: the pod like a dataset); empty = random init (smoke/test specs)
+    pretrained_weights_dir: ClassVar[str] = ""
 
     # ---- instance-level (validated user input) ----
     training_arguments: TrainingArguments
@@ -128,6 +131,7 @@ class BaseFineTuneJob(BaseModel):
         "store_asset_patterns": list,
         "promotion_path": str,
         "mesh_policy": dict,
+        "pretrained_weights_dir": str,
     }
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
@@ -180,6 +184,8 @@ class BaseFineTuneJob(BaseModel):
             if key in args:
                 training[key] = args.pop(key)
         model: dict[str, Any] = {"preset": self.model_preset}
+        if self.pretrained_weights_dir:
+            model["weights_dir"] = self.pretrained_weights_dir
         if self.framework == TrainingFramework.JAX_QLORA:
             # int4 base weights (models/quant.py); adapters still train in LoRA
             model["overrides"] = {"quantize_base": True}

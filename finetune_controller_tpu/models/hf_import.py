@@ -1,0 +1,179 @@
+"""Import pretrained HuggingFace Llama-family checkpoints into our param tree.
+
+The reference never loads weights — training runs in user containers that
+bring their own (SURVEY.md §2.2). A TPU-native fine-tuning framework has to
+own this step: this module maps a local HF checkpoint directory
+(``*.safetensors`` shards or ``pytorch_model.bin``) onto the flax parameter
+tree the trainer shards, covering the dense Llama family (TinyLlama, Llama-3,
+Mistral) and Mixtral's MoE experts.
+
+Layout notes (why the transposes/stacks below are correct):
+
+* HF ``nn.Linear`` stores ``(out_features, in_features)``; flax ``Dense``
+  kernels are ``(in, out)`` → transpose every projection.
+* our decoder runs under ``nn.scan`` — per-layer trees are stacked on a
+  leading layer axis (the same axis pp shards), so layer ``i``'s tensors land
+  at ``stacked[i]``.
+* RoPE conventions match (both rotate half-vectors with the same frequency
+  table), so no head permutation is needed — verified numerically against
+  ``transformers``' reference implementation in ``tests/test_hf_import.py``.
+
+No network egress happens here: the checkpoint directory must already be on
+disk (in-cluster: staged like a dataset through the object store).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Iterator
+
+import numpy as np
+
+from .llama import LlamaConfig
+
+logger = logging.getLogger(__name__)
+
+
+def _iter_checkpoint_tensors(ckpt_dir: Path) -> Iterator[tuple[str, np.ndarray]]:
+    """Yield (hf_name, array) from safetensors shards or a torch .bin file."""
+    st_files = sorted(ckpt_dir.glob("*.safetensors"))
+    if st_files:
+        from safetensors import safe_open
+
+        for f in st_files:
+            with safe_open(str(f), framework="np") as reader:
+                for name in reader.keys():
+                    yield name, reader.get_tensor(name)
+        return
+    bin_files = sorted(ckpt_dir.glob("pytorch_model*.bin"))
+    if not bin_files:
+        raise FileNotFoundError(
+            f"no *.safetensors or pytorch_model*.bin under {ckpt_dir}"
+        )
+    import torch
+
+    for f in bin_files:
+        state = torch.load(str(f), map_location="cpu", weights_only=True)
+        for name, tensor in state.items():
+            yield name, tensor.float().numpy()
+
+
+def _strip(name: str) -> str:
+    return name.removeprefix("model.")
+
+
+def load_llama_params(
+    ckpt_dir: Path | str,
+    cfg: LlamaConfig,
+    *,
+    dtype: Any = None,
+) -> dict[str, Any]:
+    """Build the model's ``params`` collection from an HF checkpoint dir.
+
+    Returns a tree matching ``LlamaForCausalLM`` with ``scan_layers=True``
+    (blocks stacked on the leading layer axis). Raises on missing/unexpected
+    tensors so a architecture/config mismatch fails loudly at load, not as
+    silent garbage training.
+    """
+    ckpt_dir = Path(ckpt_dir).expanduser()
+    dtype = dtype or cfg.param_dtype
+    L = cfg.n_layers
+
+    # staging area: per-layer dicts to stack once everything is read
+    layers: list[dict[str, np.ndarray]] = [dict() for _ in range(L)]
+    top: dict[str, np.ndarray] = {}
+    unexpected: list[str] = []
+
+    for name, arr in _iter_checkpoint_tensors(ckpt_dir):
+        key = _strip(name)
+        if "rotary_emb.inv_freq" in key:
+            # non-persistent RoPE buffer serialized by transformers < 4.32
+            # (Llama-2-era .bin checkpoints); recomputed from config here
+            continue
+        if key == "embed_tokens.weight":
+            top["embedding"] = arr
+        elif key == "norm.weight":
+            top["final_norm"] = arr
+        elif key == "lm_head.weight":
+            top["lm_head"] = arr.T
+        elif key.startswith("layers."):
+            _, idx_s, rest = key.split(".", 2)
+            idx = int(idx_s)
+            if idx >= L:
+                raise ValueError(
+                    f"checkpoint layer {idx} out of range for n_layers={L}"
+                )
+            layers[idx][rest] = arr
+        else:
+            unexpected.append(name)
+    if unexpected:
+        raise ValueError(f"unexpected checkpoint tensors: {unexpected[:5]}")
+
+    def proj(rest: dict, hf: str) -> np.ndarray:
+        return rest.pop(hf).T  # (out, in) -> (in, out)
+
+    def layer_tree(rest: dict[str, np.ndarray], idx: int) -> dict[str, Any]:
+        tree: dict[str, Any] = {
+            "attn_norm": {"scale": rest.pop("input_layernorm.weight")},
+            "mlp_norm": {"scale": rest.pop("post_attention_layernorm.weight")},
+            "attn": {
+                "q_proj": {"kernel": proj(rest, "self_attn.q_proj.weight")},
+                "k_proj": {"kernel": proj(rest, "self_attn.k_proj.weight")},
+                "v_proj": {"kernel": proj(rest, "self_attn.v_proj.weight")},
+                "o_proj": {"kernel": proj(rest, "self_attn.o_proj.weight")},
+            },
+        }
+        if cfg.n_experts:
+            gate = []
+            up = []
+            down = []
+            for e in range(cfg.n_experts):
+                gate.append(proj(rest, f"block_sparse_moe.experts.{e}.w1.weight"))
+                down.append(proj(rest, f"block_sparse_moe.experts.{e}.w2.weight"))
+                up.append(proj(rest, f"block_sparse_moe.experts.{e}.w3.weight"))
+            tree["moe"] = {
+                "experts_gate": np.stack(gate),
+                "experts_up": np.stack(up),
+                "experts_down": np.stack(down),
+                "router_kernel": proj(rest, "block_sparse_moe.gate.weight"),
+            }
+        else:
+            tree["mlp"] = {
+                "gate_proj": {"kernel": proj(rest, "mlp.gate_proj.weight")},
+                "up_proj": {"kernel": proj(rest, "mlp.up_proj.weight")},
+                "down_proj": {"kernel": proj(rest, "mlp.down_proj.weight")},
+            }
+        if rest:
+            raise ValueError(f"layer {idx}: unmapped tensors {sorted(rest)[:5]}")
+        return tree
+
+    missing = [i for i, rest in enumerate(layers) if not rest]
+    if missing:
+        raise ValueError(f"checkpoint has no tensors for layers {missing[:5]}")
+    trees = [layer_tree(rest, i) for i, rest in enumerate(layers)]
+    import jax
+
+    stacked = jax.tree.map(lambda *xs: np.stack(xs).astype(dtype), *trees)
+
+    if "embedding" not in top or "final_norm" not in top:
+        raise ValueError("checkpoint missing embed_tokens/norm weights")
+    params: dict[str, Any] = {
+        "embed_tokens": {"embedding": top["embedding"].astype(dtype)},
+        "blocks": {"block": stacked},
+        "final_norm": {"scale": top["final_norm"].astype(dtype)},
+    }
+    if cfg.tie_embeddings:
+        if "lm_head" in top:
+            logger.info("tie_embeddings=True: ignoring separate lm_head weight")
+    else:
+        if "lm_head" not in top:
+            raise ValueError(
+                "checkpoint has no lm_head.weight but cfg.tie_embeddings=False"
+            )
+        params["lm_head"] = {"kernel": top["lm_head"].astype(dtype)}
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    logger.info("loaded %d tensors (%.1fM params) from %s",
+                len(jax.tree.leaves(params)), n_params / 1e6, ckpt_dir)
+    return params

@@ -88,6 +88,40 @@ class PreemptionGuard:
         signal.signal(signal.SIGTERM, handler)
 
 
+def _adapt_loaded_params(loaded: Any, target: Any, *, quant_block: int) -> Any:
+    """Recursively fit a converted HF tree onto the initialised param tree:
+    shape/dtype-check every leaf and quantize kernels where the target stores
+    int4 (QLoRA base weights)."""
+    if not isinstance(target, dict):
+        arr = jnp.asarray(loaded)
+        if tuple(arr.shape) != tuple(target.shape):
+            raise ValueError(
+                f"pretrained tensor shape {tuple(arr.shape)} != model "
+                f"{tuple(target.shape)} — config/checkpoint mismatch"
+            )
+        return arr.astype(target.dtype)
+    out: dict[str, Any] = {}
+    loaded = dict(loaded)
+    if "kernel_packed" in target and "kernel" in loaded:
+        from ..models.quant import quantize_int4
+
+        kernel = jnp.asarray(loaded.pop("kernel"), jnp.float32)
+        quant = partial(quantize_int4, block_size=quant_block)
+        if kernel.ndim == 3:  # layer-stacked
+            packed, scales = jax.vmap(quant)(kernel)
+        else:
+            packed, scales = quant(kernel)
+        out["kernel_packed"] = packed
+        out["kernel_scales"] = scales
+    for key, tv in target.items():
+        if key in out:
+            continue
+        if key not in loaded:
+            raise ValueError(f"pretrained checkpoint missing {key!r}")
+        out[key] = _adapt_loaded_params(loaded[key], tv, quant_block=quant_block)
+    return out
+
+
 class Trainer:
     def __init__(
         self,
@@ -362,6 +396,33 @@ class Trainer:
 
         return jax.tree.map(put, batch)
 
+    def load_pretrained(self, state: TrainState, ckpt_dir: str) -> TrainState:
+        """Replace the base-model weights with a pretrained HF checkpoint
+        (``models/hf_import.py``), resharded onto the state's shardings.
+
+        LoRA/QLoRA modes load into the frozen ``params`` collection (int4
+        kernels are quantized on the way in); full fine-tune loads into the
+        trainable tree. The loaded tree is shape-checked leaf-by-leaf against
+        the initialised state so a config mismatch fails loudly."""
+        if self._is_multimodal:
+            raise ValueError("pretrained import currently covers the Llama family")
+        from ..models.hf_import import load_llama_params
+
+        loaded = load_llama_params(ckpt_dir, self.model_cfg)
+        if self.cfg.mode == "lora":
+            target, shardings = state.frozen["params"], self._state_shardings.frozen["params"]
+        else:
+            target, shardings = state.trainable, self._state_shardings.trainable
+        adapted = _adapt_loaded_params(
+            loaded, target, quant_block=self.model_cfg.quant_block
+        )
+        adapted = reshard(adapted, shardings)
+        if self.cfg.mode == "lora":
+            frozen = dict(state.frozen)
+            frozen["params"] = adapted
+            return state.replace(frozen=frozen)
+        return state.replace(trainable=adapted)
+
     def state_to_host(self, state: TrainState) -> dict:
         """Gather the persistable slice of state (trainable + opt) to host.
 
@@ -396,6 +457,7 @@ class Trainer:
         artifacts_dir: str,
         resume: bool = True,
         on_metrics: Callable[[int, dict], None] | None = None,
+        pretrained_dir: str | None = None,
     ) -> TrainState:
         guard = PreemptionGuard()
         try:
@@ -411,9 +473,10 @@ class Trainer:
         )
         state = self.init_state()
         start_step = 0
+        latest = None
+        multi = jax.process_count() > 1
         if resume:
             latest = ckpt.latest_step()
-            multi = jax.process_count() > 1
             if multi:
                 # All hosts must agree on the resume decision: artifacts_dir may
                 # be host-local storage where only rank 0 persisted, so rank 0's
@@ -425,6 +488,14 @@ class Trainer:
                     np.asarray(-1 if latest is None else latest, np.int64)
                 )
                 latest = None if int(latest_arr) < 0 else int(latest_arr)
+        if pretrained_dir and not (latest is not None and self.cfg.mode == "full"):
+            # pretrained base before the checkpoint restore: the restore only
+            # replaces the trainable/optimizer slice, so in LoRA/QLoRA mode
+            # the base weights must come from here even on resume. In full
+            # fine-tune the checkpoint holds everything — reloading GBs of
+            # safetensors just to overwrite them would waste every resume.
+            state = self.load_pretrained(state, pretrained_dir)
+        if resume:
             if latest is not None:
                 # Only rank 0 is guaranteed to hold the checkpoint bytes, so
                 # rank 0 restores and the tree is broadcast; other hosts feed

@@ -1,0 +1,156 @@
+"""Pretrained-weight import tests: HF Llama-family checkpoints → our tree.
+
+Verified the strong way — numerically, against ``transformers``' own PyTorch
+forward pass on the same (random) weights. The reference never loads weights
+(user containers bring their own — SURVEY.md §2.2), so this surface is pure
+greenfield and the conversion is exactly where silent corruption would hide.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from finetune_controller_tpu.models.hf_import import load_llama_params
+from finetune_controller_tpu.models.llama import PRESETS, LlamaForCausalLM
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+TINY = PRESETS["tiny-test"].replace(dtype=jnp.float32)
+
+
+def _save_hf_llama(tmp_path, *, tie=False):
+    import torch
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM as HFModel
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=TINY.vocab_size, hidden_size=TINY.d_model,
+        num_hidden_layers=TINY.n_layers, num_attention_heads=TINY.n_heads,
+        num_key_value_heads=TINY.n_kv_heads,
+        intermediate_size=TINY.d_ff, rms_norm_eps=TINY.rms_eps,
+        rope_theta=TINY.rope_theta, max_position_embeddings=TINY.max_seq_len,
+        tie_word_embeddings=tie, attention_bias=False, mlp_bias=False,
+    )
+    model = HFModel(hf_cfg).eval()
+    ckpt = tmp_path / "hf"
+    model.save_pretrained(str(ckpt), safe_serialization=True)
+    return model, ckpt
+
+
+def test_import_matches_transformers_forward(tmp_path):
+    torch = pytest.importorskip("torch")
+    hf_model, ckpt = _save_hf_llama(tmp_path)
+
+    params = load_llama_params(ckpt, TINY, dtype=jnp.float32)
+    ours = LlamaForCausalLM(TINY)
+
+    tokens = np.random.default_rng(0).integers(0, TINY.vocab_size, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    out = ours.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=1e-3)
+
+
+def test_import_shape_mismatch_fails_loudly(tmp_path):
+    pytest.importorskip("torch")
+    _, ckpt = _save_hf_llama(tmp_path)
+    wrong = TINY.replace(d_ff=64)
+    with pytest.raises(ValueError):
+        # conversion itself reads fine; the trainer-side adaptation catches
+        # the shape mismatch. load_llama_params catches layer-count drift.
+        trainer = Trainer(
+            wrong.replace(lora=LoRAConfig(rank=2)),
+            TrainConfig(mode="lora", total_steps=1, batch_size=2, seq_len=16),
+        )
+        state = trainer.init_state()
+        trainer.load_pretrained(state, str(ckpt))
+
+
+def test_trainer_loads_pretrained_and_trains(tmp_path):
+    torch = pytest.importorskip("torch")
+    hf_model, ckpt = _save_hf_llama(tmp_path)
+    cfg = TINY.replace(lora=LoRAConfig(rank=4))
+    trainer = Trainer(
+        cfg, TrainConfig(mode="lora", total_steps=2, batch_size=2, seq_len=16,
+                         learning_rate=1e-3),
+    )
+    state = trainer.init_state()
+    state = trainer.load_pretrained(state, str(ckpt))
+
+    # the loaded frozen base reproduces the HF forward through the trainer's
+    # assembled model (LoRA deltas start at zero)
+    tokens = np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 16))
+    variables = trainer._assemble(state.frozen, state.trainable)
+    out = trainer.model.apply(variables, jnp.asarray(tokens, jnp.int32))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=1e-3)
+
+    # and it trains
+    batch = {"tokens": tokens.astype(np.int32),
+             "loss_mask": np.ones_like(tokens, np.float32)}
+    state2, metrics = trainer.step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_qlora_pretrained_quantizes_on_load(tmp_path):
+    pytest.importorskip("torch")
+    _, ckpt = _save_hf_llama(tmp_path)
+    cfg = TINY.replace(lora=LoRAConfig(rank=4), quantize_base=True, quant_block=32)
+    trainer = Trainer(
+        cfg, TrainConfig(mode="lora", total_steps=1, batch_size=2, seq_len=16),
+    )
+    state = trainer.init_state()
+    state = trainer.load_pretrained(state, str(ckpt))
+    blocks = state.frozen["params"]["blocks"]["block"]
+    q = blocks["attn"]["q_proj"]
+    assert q["kernel_packed"].dtype == jnp.uint8
+    assert q["kernel_scales"].dtype == jnp.bfloat16
+    # int4 round-trip stays close to the f32 original
+    from finetune_controller_tpu.models.quant import dequantize_int4
+
+    deq = dequantize_int4(q["kernel_packed"][0], q["kernel_scales"][0],
+                          dtype=jnp.float32)
+    orig = load_llama_params(ckpt, TINY, dtype=jnp.float32)
+    ref = orig["blocks"]["block"]["attn"]["q_proj"]["kernel"][0]
+    err = np.max(np.abs(np.asarray(deq) - np.asarray(ref)))
+    assert err < np.max(np.abs(np.asarray(ref))) * 0.1
+
+    batch = {"tokens": np.zeros((2, 16), np.int32),
+             "loss_mask": np.ones((2, 16), np.float32)}
+    _, metrics = trainer.step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_mixtral_moe_import_matches_transformers(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    moe = PRESETS["tiny-moe-test"].replace(
+        dtype=jnp.float32, capacity_factor=100.0,  # no token dropping
+    )
+    torch.manual_seed(0)
+    hf_cfg = MixtralConfig(
+        vocab_size=moe.vocab_size, hidden_size=moe.d_model,
+        num_hidden_layers=moe.n_layers, num_attention_heads=moe.n_heads,
+        num_key_value_heads=moe.n_kv_heads, intermediate_size=moe.d_ff,
+        num_local_experts=moe.n_experts, num_experts_per_tok=moe.moe_top_k,
+        rms_norm_eps=moe.rms_eps, rope_theta=moe.rope_theta,
+        max_position_embeddings=moe.max_seq_len, tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    hf_model = MixtralForCausalLM(hf_cfg).eval()
+    ckpt = tmp_path / "hf-moe"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+
+    params = load_llama_params(ckpt, moe, dtype=jnp.float32)
+    ours = LlamaForCausalLM(moe)
+    tokens = np.random.default_rng(0).integers(0, moe.vocab_size, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    out, _ = ours.apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32), mutable=("moe_aux",)
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-2)
