@@ -8,6 +8,9 @@ Supported formats:
   * ``.jsonl`` with ``{"tokens": [...]}`` rows (pre-tokenized), or
     ``{"text": "..."}`` rows tokenized with a byte-level fallback tokenizer
     (or a HuggingFace ``tokenizers`` file when provided);
+  * ``.jsonl`` SFT rows — ``{"prompt": ..., "completion": ...}`` (text) or
+    ``{"prompt_tokens": [...], "completion_tokens": [...]}`` — where the loss
+    counts ONLY completion tokens (the mask rides through packing);
   * ``.npy`` — a flat int32 token stream.
 
 Packing: documents are concatenated into a flat stream with per-document
@@ -33,15 +36,27 @@ def _byte_tokenize(text: str) -> list[int]:
     return list(text.encode("utf-8"))
 
 
-def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[list[int]]:
+#: a document is (tokens, loss_flags) — flags mark the positions whose
+#: prediction counts (1 everywhere for plain LM rows, completion-only for SFT)
+Document = tuple[list[int], list[int]]
+
+
+def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[Document]:
     if path.endswith(".npy"):
-        return [np.load(path).astype(np.int32).tolist()]
+        toks = np.load(path).astype(np.int32).tolist()
+        return [(toks, [1] * len(toks))]
     tokenizer = None
     if tokenizer_file:
         from tokenizers import Tokenizer
 
         tokenizer = Tokenizer.from_file(tokenizer_file)
-    docs: list[list[int]] = []
+
+    def encode(text: str) -> list[int]:
+        if tokenizer is not None:
+            return tokenizer.encode(text).ids
+        return _byte_tokenize(text)
+
+    docs: list[Document] = []
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -49,36 +64,58 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[l
                 continue
             row = json.loads(line)
             if "tokens" in row:
-                docs.append([int(t) for t in row["tokens"]])
+                toks = [int(t) for t in row["tokens"]]
+                docs.append((toks, [1] * len(toks)))
             elif "text" in row:
-                if tokenizer is not None:
-                    docs.append(tokenizer.encode(row["text"]).ids)
-                else:
-                    docs.append(_byte_tokenize(row["text"]))
+                toks = encode(row["text"])
+                docs.append((toks, [1] * len(toks)))
+            elif "prompt_tokens" in row and "completion_tokens" in row:
+                p = [int(t) for t in row["prompt_tokens"]]
+                c = [int(t) for t in row["completion_tokens"]]
+                docs.append((p + c, [0] * len(p) + [1] * len(c)))
+            elif "prompt" in row and "completion" in row:
+                p, c = encode(row["prompt"]), encode(row["completion"])
+                docs.append((p + c, [0] * len(p) + [1] * len(c)))
             else:
-                raise ValueError("jsonl rows must have a 'tokens' or 'text' field")
+                raise ValueError(
+                    "jsonl rows must have 'tokens', 'text', or "
+                    "'prompt'/'completion' fields"
+                )
     if not docs:
         raise ValueError(f"no documents found in {path}")
     return docs
 
 
 def pack_documents(
-    docs: Sequence[Sequence[int]], seq_len: int
-) -> tuple[np.ndarray, np.ndarray]:
-    """Concatenate docs → (n_blocks, seq_len) token and segment-id arrays."""
+    docs: Sequence[Document | Sequence[int]], seq_len: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Concatenate docs → (n_blocks, seq_len) token, segment-id, and
+    loss-flag arrays. Accepts bare token lists (all positions count) or
+    (tokens, flags) documents (SFT completion masking)."""
     stream: list[int] = []
     segs: list[int] = []
+    flags: list[int] = []
     for i, d in enumerate(docs):
-        stream.extend(d)
-        segs.extend([i + 1] * len(d))
+        if isinstance(d, tuple):
+            toks, f = d
+        else:
+            toks, f = list(d), [1] * len(d)
+        if len(f) != len(toks):
+            raise ValueError(f"doc {i}: {len(f)} flags for {len(toks)} tokens")
+        stream.extend(toks)
+        flags.extend(f)
+        segs.extend([i + 1] * len(toks))
     n_blocks = max(len(stream) // seq_len, 1)
     if len(stream) < seq_len:  # pad tiny datasets up to one block
         pad = seq_len - len(stream)
         stream = list(stream) + [0] * pad
         segs = list(segs) + [0] * pad
-    tokens = np.asarray(stream[: n_blocks * seq_len], np.int32).reshape(n_blocks, seq_len)
-    segments = np.asarray(segs[: n_blocks * seq_len], np.int32).reshape(n_blocks, seq_len)
-    return tokens, segments
+        flags = list(flags) + [0] * pad
+    cut = n_blocks * seq_len
+    tokens = np.asarray(stream[:cut], np.int32).reshape(n_blocks, seq_len)
+    segments = np.asarray(segs[:cut], np.int32).reshape(n_blocks, seq_len)
+    loss_flags = np.asarray(flags[:cut], np.float32).reshape(n_blocks, seq_len)
+    return tokens, segments, loss_flags
 
 
 def batches_from_tokens(
@@ -88,6 +125,7 @@ def batches_from_tokens(
     seed: int = 0,
     shard_index: int = 0,
     shard_count: int = 1,
+    loss_flags: np.ndarray | None = None,
 ) -> Iterator[dict]:
     """Infinite shuffled batch iterator over packed blocks."""
     n = tokens.shape[0]
@@ -95,10 +133,12 @@ def batches_from_tokens(
 
     def make_batch(idx: np.ndarray) -> dict:
         if segments is None:
-            return {
-                "tokens": tokens[idx],
-                "loss_mask": np.ones_like(tokens[idx], np.float32),
-            }
+            mask = (
+                loss_flags[idx].astype(np.float32)
+                if loss_flags is not None
+                else np.ones_like(tokens[idx], np.float32)
+            )
+            return {"tokens": tokens[idx], "loss_mask": mask}
         seg = segments[idx]
         # Mask padding AND each document's first in-block token: predicting
         # doc i+1's first token happens from inside doc i, which
@@ -107,6 +147,9 @@ def batches_from_tokens(
         # zero goes on the boundary target itself.
         mask = (seg > 0).astype(np.float32)
         mask[:, 1:] *= (seg[:, 1:] == seg[:, :-1]).astype(np.float32)
+        if loss_flags is not None:
+            # SFT: only completion targets count
+            mask *= loss_flags[idx].astype(np.float32)
         return {"tokens": tokens[idx], "loss_mask": mask, "segment_ids": seg}
 
     warned = False
@@ -139,10 +182,12 @@ def jsonl_token_batches(
     shard_index: int = 0,
     shard_count: int = 1,
 ) -> Iterator[dict]:
-    tokens = segments = None
-    if tokenizer_file is None and path.endswith(".jsonl"):
+    tokens = segments = loss_flags = None
+    if tokenizer_file is None and path.endswith(".jsonl") and not _is_sft_jsonl(path):
         # native C++ parse+tokenize+pack hot path (data/native_loader.py);
-        # byte-parity with the Python path, gate with FTC_NATIVE=0
+        # byte-parity with the Python path, gate with FTC_NATIVE=0. SFT
+        # prompt/completion rows carry loss flags the native packer doesn't
+        # know about — those take the Python path.
         from .native_loader import pack_jsonl_native
 
         # malformed datasets raise ValueError — same contract as the Python path
@@ -152,8 +197,17 @@ def jsonl_token_batches(
             logger.debug("native packer produced %d blocks", tokens.shape[0])
     if tokens is None:
         docs = load_token_documents(path, tokenizer_file)
-        tokens, segments = pack_documents(docs, seq_len)
+        tokens, segments, loss_flags = pack_documents(docs, seq_len)
     return batches_from_tokens(
         tokens, segments, batch_size, seed=seed,
         shard_index=shard_index, shard_count=shard_count,
+        loss_flags=loss_flags,
     )
+
+
+def _is_sft_jsonl(path: str) -> bool:
+    """Whether ANY row uses the SFT prompt/completion schema (rows may mix
+    schemas, so a first-row sniff is not enough). A substring scan keeps this
+    a single cheap pass; a false positive merely takes the Python path."""
+    with open(path) as f:
+        return any('"prompt' in line for line in f)

@@ -91,9 +91,11 @@ class PreemptionGuard:
 def _adapt_loaded_params(loaded: Any, target: Any, *, quant_block: int) -> Any:
     """Recursively fit a converted HF tree onto the initialised param tree:
     shape/dtype-check every leaf and quantize kernels where the target stores
-    int4 (QLoRA base weights)."""
+    int4 (QLoRA base weights). Leaves stay HOST-side numpy throughout — the
+    caller reshards onto the mesh, so the unsharded model never has to fit a
+    single device."""
     if not isinstance(target, dict):
-        arr = jnp.asarray(loaded)
+        arr = np.asarray(loaded)
         if tuple(arr.shape) != tuple(target.shape):
             raise ValueError(
                 f"pretrained tensor shape {tuple(arr.shape)} != model "
@@ -105,14 +107,26 @@ def _adapt_loaded_params(loaded: Any, target: Any, *, quant_block: int) -> Any:
     if "kernel_packed" in target and "kernel" in loaded:
         from ..models.quant import quantize_int4
 
-        kernel = jnp.asarray(loaded.pop("kernel"), jnp.float32)
+        kernel = np.asarray(loaded.pop("kernel"), np.float32)
+        packed_t = target["kernel_packed"]
+        want = tuple(packed_t.shape[:-2]) + (
+            packed_t.shape[-2] * 2, packed_t.shape[-1],
+        )
+        if tuple(kernel.shape) != want:
+            raise ValueError(
+                f"pretrained kernel shape {tuple(kernel.shape)} != model "
+                f"{want} (pre-quantization) — config/checkpoint mismatch"
+            )
         quant = partial(quantize_int4, block_size=quant_block)
-        if kernel.ndim == 3:  # layer-stacked
-            packed, scales = jax.vmap(quant)(kernel)
-        else:
-            packed, scales = quant(kernel)
-        out["kernel_packed"] = packed
-        out["kernel_scales"] = scales
+        # quantize on the CPU backend so a model bigger than one accelerator's
+        # HBM can still be converted; results go straight back to host
+        with jax.default_device(jax.devices("cpu")[0]):
+            if kernel.ndim == 3:  # layer-stacked
+                packed, scales = jax.vmap(quant)(kernel)
+            else:
+                packed, scales = quant(kernel)
+        out["kernel_packed"] = np.asarray(packed)
+        out["kernel_scales"] = np.asarray(scales)
     for key, tv in target.items():
         if key in out:
             continue

@@ -12,13 +12,13 @@ from finetune_controller_tpu.data.loader import (
 
 def test_pack_documents_segments():
     docs = [[1, 2, 3], [4, 5, 6, 7, 8]]
-    tokens, segs = pack_documents(docs, seq_len=4)
+    tokens, segs, _ = pack_documents(docs, seq_len=4)
     assert tokens.shape == (2, 4)
     assert segs.tolist() == [[1, 1, 1, 2], [2, 2, 2, 2]]
 
 
 def test_pack_pads_tiny_dataset():
-    tokens, segs = pack_documents([[9, 9]], seq_len=8)
+    tokens, segs, _ = pack_documents([[9, 9]], seq_len=8)
     assert tokens.shape == (1, 8)
     assert segs[0, :2].tolist() == [1, 1]
     assert segs[0, 2:].sum() == 0
@@ -45,11 +45,40 @@ def test_text_rows_byte_fallback(tmp_path):
     with open(path, "w") as f:
         f.write(json.dumps({"text": "hello"}) + "\n")
     docs = load_token_documents(str(path))
-    assert docs[0] == list(b"hello")
+    toks, flags = docs[0]
+    assert toks == list(b"hello") and flags == [1] * 5
 
 
 def test_batches_have_loss_mask_and_segments():
-    tokens, segs = pack_documents([list(range(100))], seq_len=10)
+    tokens, segs, _ = pack_documents([list(range(100))], seq_len=10)
     b = next(batches_from_tokens(tokens, segs, batch_size=2))
     assert set(b) >= {"tokens", "loss_mask", "segment_ids"}
     assert b["loss_mask"].dtype == np.float32
+
+
+def test_sft_prompt_completion_masking(tmp_path):
+    """SFT rows: loss counts only completion targets, through packing and
+    the segment-boundary masking."""
+    path = tmp_path / "sft.jsonl"
+    rows = [
+        {"prompt": "ab", "completion": "XY"},
+        {"prompt_tokens": [1, 2, 3], "completion_tokens": [7, 8]},
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    docs = load_token_documents(str(path))
+    toks0, flags0 = docs[0]
+    assert toks0 == list(b"abXY") and flags0 == [0, 0, 1, 1]
+    assert docs[1] == ([1, 2, 3, 7, 8], [0, 0, 0, 1, 1])
+
+    it = jsonl_token_batches(str(path), batch_size=1, seq_len=9)
+    b = next(it)
+    # stream: a b X Y | 1 2 3 7 8 → flags 0 0 1 1 0 0 0 1 1; doc-boundary
+    # target (position 4, first token of doc 2) is already 0 via flags
+    assert b["tokens"].shape == (1, 9)
+    expect = np.array([[0, 0, 1, 1, 0, 0, 0, 1, 1]], np.float32)
+    np.testing.assert_array_equal(b["loss_mask"], expect)
+    # plain-LM rows in the same schema family still mask everything on
+    assert b["segment_ids"].tolist() == [[1, 1, 1, 1, 2, 2, 2, 2, 2]]

@@ -44,7 +44,7 @@ def test_native_pack_parity_with_python(tmp_path):
     _write_jsonl(p, TRICKY_ROWS)
     for seq_len in (16, 64, 1024):
         docs = load_token_documents(str(p))
-        py_tokens, py_segs = pack_documents(docs, seq_len)
+        py_tokens, py_segs, _ = pack_documents(docs, seq_len)
         nat = pack_jsonl_native(str(p), seq_len)
         assert nat is not None
         np.testing.assert_array_equal(nat[0], py_tokens)
@@ -58,7 +58,7 @@ def test_native_pack_parity_ensure_ascii_false(tmp_path):
         for row in [{"text": "café ♞ emoji 😀"}, {"text": "δοκιμή"}]:
             f.write(json.dumps(row, ensure_ascii=False) + "\n")
     docs = load_token_documents(str(p))
-    py_tokens, py_segs = pack_documents(docs, 32)
+    py_tokens, py_segs, _ = pack_documents(docs, 32)
     nat = pack_jsonl_native(str(p), 32)
     np.testing.assert_array_equal(nat[0], py_tokens)
     np.testing.assert_array_equal(nat[1], py_segs)
@@ -108,7 +108,7 @@ def test_native_top_level_key_matching(tmp_path):
     ]
     _write_jsonl(p, rows)
     docs = load_token_documents(str(p))
-    py_tokens, py_segs = pack_documents(docs, 16)
+    py_tokens, py_segs, _ = pack_documents(docs, 16)
     nat = pack_jsonl_native(str(p), 16)
     np.testing.assert_array_equal(nat[0], py_tokens)
     np.testing.assert_array_equal(nat[1], py_segs)
