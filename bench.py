@@ -77,7 +77,48 @@ def fail(reason: str, **diag) -> None:
     sys.exit(1)
 
 
+def _init_backend_with_fallback() -> None:
+    """Initialise JAX; if the TPU backend is unreachable (e.g. a remote-TPU
+    tunnel outage), retry briefly, then re-exec onto the CPU backend so the
+    bench still emits an honest (clearly CPU-labelled) number instead of
+    crashing the harness."""
+    if os.environ.get("BENCH_NO_CPU_FALLBACK"):
+        return  # fallback leg (or probing disabled): init happens in main()
+    import subprocess
+
+    probe = (
+        "import os, jax\n"
+        "if os.environ.get('JAX_PLATFORMS'):\n"
+        "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
+        "jax.devices()\n"
+    )
+    for attempt in range(3):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=240, check=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            return  # backend reachable; init in-process will succeed too
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+            # a dead remote-TPU tunnel can HANG init, not just fail it — the
+            # subprocess probe bounds that
+            print(f"backend probe failed (attempt {attempt + 1}): {e}", file=sys.stderr)
+            if attempt < 2:
+                time.sleep(30)
+    print("TPU backend unavailable; re-exec on CPU fallback", file=sys.stderr)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_TINY"] = "1"
+    env["BENCH_NO_CPU_FALLBACK"] = "1"
+    # TPU-sized knobs must not leak into the tiny CPU leg
+    for knob in ("BENCH_PRESET", "BENCH_SEQ", "BENCH_BATCH", "BENCH_STEPS"):
+        env.pop(knob, None)
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
 def main() -> None:
+    _init_backend_with_fallback()
     import jax
 
     from finetune_controller_tpu.platform import assert_platform_env, env_flag

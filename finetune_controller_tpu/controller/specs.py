@@ -99,7 +99,8 @@ class BaseFineTuneJob(BaseModel):
     #: glob patterns the artifact sync ships to the object store
     #: (reference: store_asset_patterns, ``finetuning.py:94-97``)
     store_asset_patterns: ClassVar[list[str]] = [
-        "*.csv", "*.json", "checkpoints/**/*", "profile/**/*", "done.txt",
+        "*.csv", "*.json", "checkpoints/**/*", "profile/**/*",
+        "adapter/**/*", "merged/**/*", "done.txt",
     ]
     #: deploy-bucket prefix used on promotion (reference: ``finetuning.py:75-78``)
     promotion_path: ClassVar[str] = "models"
@@ -179,7 +180,7 @@ class BaseFineTuneJob(BaseModel):
         for key in (
             "learning_rate", "warmup_steps", "total_steps", "schedule",
             "weight_decay", "clip_norm", "batch_size", "seq_len", "seed",
-            "log_every", "checkpoint_every", "profile_steps",
+            "log_every", "checkpoint_every", "profile_steps", "export_merged",
         ):
             if key in args:
                 training[key] = args.pop(key)

@@ -183,15 +183,19 @@ def jsonl_token_batches(
     shard_count: int = 1,
 ) -> Iterator[dict]:
     tokens = segments = loss_flags = None
-    if tokenizer_file is None and path.endswith(".jsonl") and not _is_sft_jsonl(path):
+    if tokenizer_file is None and path.endswith(".jsonl") and not _sniff_sft_jsonl(path):
         # native C++ parse+tokenize+pack hot path (data/native_loader.py);
         # byte-parity with the Python path, gate with FTC_NATIVE=0. SFT
         # prompt/completion rows carry loss flags the native packer doesn't
-        # know about — those take the Python path.
+        # know about — those take the Python path (cheap head sniff; a
+        # deep SFT row past the sniff window makes the native packer raise
+        # and we fall back below).
         from .native_loader import pack_jsonl_native
 
-        # malformed datasets raise ValueError — same contract as the Python path
-        packed = pack_jsonl_native(path, seq_len)
+        try:
+            packed = pack_jsonl_native(path, seq_len)
+        except ValueError:
+            packed = None  # mixed/SFT schema: the Python loader decides
         if packed is not None:
             tokens, segments = packed
             logger.debug("native packer produced %d blocks", tokens.shape[0])
@@ -205,9 +209,10 @@ def jsonl_token_batches(
     )
 
 
-def _is_sft_jsonl(path: str) -> bool:
-    """Whether ANY row uses the SFT prompt/completion schema (rows may mix
-    schemas, so a first-row sniff is not enough). A substring scan keeps this
-    a single cheap pass; a false positive merely takes the Python path."""
-    with open(path) as f:
-        return any('"prompt' in line for line in f)
+def _sniff_sft_jsonl(path: str, head_bytes: int = 1 << 16) -> bool:
+    """Whether the file's HEAD uses the SFT prompt/completion schema. Bounded
+    read so multi-GB plain-LM files don't pay a full extra Python pass before
+    the native packer; an SFT row hiding past the window is still handled —
+    the native packer rejects it and the caller falls back to Python."""
+    with open(path, "rb") as f:
+        return b'"prompt' in f.read(head_bytes)

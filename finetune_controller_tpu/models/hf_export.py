@@ -1,0 +1,181 @@
+"""Export trained artifacts in HuggingFace-consumable formats.
+
+The other half of ``hf_import.py``: after a fine-tune, users need artifacts
+their serving stack understands — either a **PEFT adapter** directory
+(``adapter_model.safetensors`` + ``adapter_config.json``, loadable with
+``peft.PeftModel``) or a **merged full checkpoint** (``model.safetensors`` +
+``config.json``, loadable with ``transformers``). The reference delegates all
+artifact formats to user containers (SURVEY.md §2.2); here the trainer owns
+them, so promotion publishes something deployable.
+
+Both paths are round-trip tested against ``peft``/``transformers`` in
+``tests/test_hf_export.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from .llama import LlamaConfig
+from .quant import dequantize_int4
+
+logger = logging.getLogger(__name__)
+
+#: our projection name → HF module path fragment
+_HF_MODULE = {
+    "q_proj": "self_attn.q_proj",
+    "k_proj": "self_attn.k_proj",
+    "v_proj": "self_attn.v_proj",
+    "o_proj": "self_attn.o_proj",
+    "gate_proj": "mlp.gate_proj",
+    "up_proj": "mlp.up_proj",
+    "down_proj": "mlp.down_proj",
+}
+
+
+def _save_safetensors(path: Path, tensors: dict[str, np.ndarray]) -> None:
+    from safetensors.numpy import save_file
+
+    save_file({k: np.ascontiguousarray(v) for k, v in tensors.items()}, str(path))
+
+
+def _stacked_lora_modules(lora_tree: dict) -> dict[str, dict[str, np.ndarray]]:
+    """Flatten the scanned lora tree → {proj_name: {lora_a, lora_b}} with the
+    leading layer axis intact."""
+    blocks = lora_tree["blocks"]["block"]
+    out: dict[str, dict[str, np.ndarray]] = {}
+    for group in blocks.values():            # attn / mlp
+        for proj, leaves in group.items():
+            out[proj] = {k: np.asarray(v) for k, v in leaves.items()}
+    return out
+
+
+def export_lora_adapter(
+    cfg: LlamaConfig,
+    lora_tree: dict,
+    out_dir: Path | str,
+    *,
+    base_model_name: str = "",
+) -> Path:
+    """Write a PEFT-format LoRA adapter directory.
+
+    PEFT stores ``lora_A.weight (r, in)`` / ``lora_B.weight (out, r)`` per
+    target module with scaling ``alpha / r`` — ours are flax ``(in, r)`` /
+    ``(r, out)`` kernels with the same scaling, so the export is a transpose
+    per tensor (verified numerically against ``peft`` in the tests).
+    """
+    out_dir = Path(out_dir).expanduser()
+    out_dir.mkdir(parents=True, exist_ok=True)
+    modules = _stacked_lora_modules(lora_tree)
+    tensors: dict[str, np.ndarray] = {}
+    for proj, leaves in modules.items():
+        a, b = leaves["lora_a"], leaves["lora_b"]     # (L, in, r), (L, r, out)
+        for i in range(a.shape[0]):
+            prefix = f"base_model.model.model.layers.{i}.{_HF_MODULE[proj]}"
+            tensors[f"{prefix}.lora_A.weight"] = a[i].T.astype(np.float32)
+            tensors[f"{prefix}.lora_B.weight"] = b[i].T.astype(np.float32)
+    _save_safetensors(out_dir / "adapter_model.safetensors", tensors)
+
+    adapter_config = {
+        "peft_type": "LORA",
+        "task_type": "CAUSAL_LM",
+        "base_model_name_or_path": base_model_name,
+        "r": cfg.lora.rank,
+        "lora_alpha": cfg.lora.alpha,
+        "lora_dropout": cfg.lora.dropout,
+        "target_modules": sorted(
+            _HF_MODULE[p].rsplit(".", 1)[-1] for p in modules
+        ),
+        "bias": "none",
+        "fan_in_fan_out": False,
+        "inference_mode": True,
+    }
+    (out_dir / "adapter_config.json").write_text(json.dumps(adapter_config, indent=2))
+    logger.info("wrote PEFT adapter (%d tensors) -> %s", len(tensors), out_dir)
+    return out_dir
+
+
+def _base_kernel(leaves: dict[str, np.ndarray], layer: int, cfg: LlamaConfig) -> np.ndarray:
+    """(in, out) f32 base kernel for one layer, dequantizing QLoRA storage."""
+    if "kernel" in leaves:
+        return np.asarray(leaves["kernel"][layer], np.float32)
+    deq = dequantize_int4(
+        leaves["kernel_packed"][layer], leaves["kernel_scales"][layer],
+        dtype=np.float32,
+    )
+    return np.asarray(deq, np.float32)
+
+
+def export_merged_checkpoint(
+    cfg: LlamaConfig,
+    variables: dict[str, Any],
+    out_dir: Path | str,
+) -> Path:
+    """Write a full HF Llama checkpoint with LoRA deltas merged into the base
+    (``W_eff = W + (alpha/r)·A·B``), loadable by ``transformers``. Dense text
+    models only (the importer's inverse)."""
+    if cfg.n_experts:
+        raise NotImplementedError("merged export currently covers dense models")
+    out_dir = Path(out_dir).expanduser()
+    out_dir.mkdir(parents=True, exist_ok=True)
+    params = variables["params"]
+    lora = variables.get("lora", {})
+    lora_blocks = lora.get("blocks", {}).get("block", {}) if lora else {}
+    blocks = params["blocks"]["block"]
+    scale = cfg.lora.alpha / cfg.lora.rank if cfg.lora.rank else 0.0
+
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["embed_tokens"]["embedding"], np.float32
+        ),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        tensors["lm_head.weight"] = np.asarray(
+            params["lm_head"]["kernel"], np.float32
+        ).T
+
+    for i in range(cfg.n_layers):
+        prefix = f"model.layers.{i}"
+        tensors[f"{prefix}.input_layernorm.weight"] = np.asarray(
+            blocks["attn_norm"]["scale"][i], np.float32
+        )
+        tensors[f"{prefix}.post_attention_layernorm.weight"] = np.asarray(
+            blocks["mlp_norm"]["scale"][i], np.float32
+        )
+        for group_name in ("attn", "mlp"):
+            for proj, leaves in blocks[group_name].items():
+                kernel = _base_kernel(leaves, i, cfg)           # (in, out)
+                ladder = lora_blocks.get(group_name, {}).get(proj)
+                if ladder is not None:
+                    a = np.asarray(ladder["lora_a"][i], np.float32)
+                    b = np.asarray(ladder["lora_b"][i], np.float32)
+                    kernel = kernel + scale * (a @ b)
+                tensors[f"{prefix}.{_HF_MODULE[proj]}.weight"] = kernel.T
+
+    _save_safetensors(out_dir / "model.safetensors", tensors)
+    hf_config = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.d_model,
+        "intermediate_size": cfg.d_ff,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "rms_norm_eps": cfg.rms_eps,
+        "rope_theta": cfg.rope_theta,
+        "max_position_embeddings": cfg.max_seq_len,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "attention_bias": False,
+        "mlp_bias": False,
+        "torch_dtype": "float32",
+    }
+    (out_dir / "config.json").write_text(json.dumps(hf_config, indent=2))
+    logger.info("wrote merged HF checkpoint (%d tensors) -> %s", len(tensors), out_dir)
+    return out_dir

@@ -150,10 +150,12 @@ def run_job(spec: dict) -> None:
         local_batch_size=trainer.local_batch_size,
         shard_index=jax.process_index(), shard_count=jax.process_count(),
     )
-    trainer.fit(
+    state = trainer.fit(
         batches, artifacts_dir,
         pretrained_dir=spec.get("model", {}).get("weights_dir"),
     )
+    # deployable artifacts: PEFT adapter (+ merged checkpoint if configured)
+    trainer.export_artifacts(state, artifacts_dir)
 
     if is_rank_zero():
         with open(os.path.join(artifacts_dir, "done.txt"), "w") as f:

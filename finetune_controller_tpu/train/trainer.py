@@ -72,6 +72,9 @@ class TrainConfig:
     #: GPipe microbatches when the mesh has pp > 1 (0 = 2·pp, a reasonable
     #: bubble/memory tradeoff); must divide the per-dp-shard batch
     pp_microbatches: int = 0
+    #: also write a merged full HF checkpoint at the end of a LoRA run
+    #: (adapter-only PEFT export always happens for text LoRA runs)
+    export_merged: bool = False
 
 
 class PreemptionGuard:
@@ -118,9 +121,16 @@ def _adapt_loaded_params(loaded: Any, target: Any, *, quant_block: int) -> Any:
                 f"{want} (pre-quantization) — config/checkpoint mismatch"
             )
         quant = partial(quantize_int4, block_size=quant_block)
-        # quantize on the CPU backend so a model bigger than one accelerator's
-        # HBM can still be converted; results go straight back to host
-        with jax.default_device(jax.devices("cpu")[0]):
+        # quantize on the CPU backend when available so a model bigger than
+        # one accelerator's HBM can still be converted (a tpu-only
+        # jax_platforms pin has no cpu backend — use the default device then)
+        try:
+            ctx = jax.default_device(jax.devices("cpu")[0])
+        except RuntimeError:
+            import contextlib
+
+            ctx = contextlib.nullcontext()
+        with ctx:
             if kernel.ndim == 3:  # layer-stacked
                 packed, scales = jax.vmap(quant)(kernel)
             else:
@@ -436,6 +446,48 @@ class Trainer:
             frozen["params"] = adapted
             return state.replace(frozen=frozen)
         return state.replace(trainable=adapted)
+
+    def export_artifacts(self, state: TrainState, artifacts_dir: str) -> None:
+        """Write deployable HF-format artifacts after training: a PEFT
+        adapter for text LoRA runs, plus a merged checkpoint when
+        ``cfg.export_merged``. Collective (all hosts gather), rank 0 writes."""
+        if self._is_multimodal or self.cfg.mode != "lora":
+            return
+        if not self.model_cfg.scan_layers:
+            logger.warning(
+                "HF adapter export supports the scanned layer layout only "
+                "(scan_layers=False run): skipping export"
+            )
+            return
+        host = self.state_to_host(state)  # collective — every rank calls
+        if jax.process_index() != 0:
+            return
+        from ..models.hf_export import export_lora_adapter, export_merged_checkpoint
+
+        export_lora_adapter(
+            self.model_cfg, host["trainable"], f"{artifacts_dir}/adapter"
+        )
+        if self.cfg.export_merged and self.model_cfg.n_experts:
+            logger.warning(
+                "export_merged skipped: merged export covers dense models "
+                "(MoE adapters still exported)"
+            )
+        if self.cfg.export_merged and not self.model_cfg.n_experts:
+            if jax.process_count() > 1:
+                # frozen base shards span non-addressable devices on a
+                # multi-host mesh; merge offline from the adapter + base
+                logger.warning(
+                    "export_merged skipped on multi-host: merge offline from "
+                    "the adapter and the pretrained base"
+                )
+                return
+            frozen_host = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(x)), dict(state.frozen)
+            )
+            variables = self._assemble(frozen_host, host["trainable"])
+            export_merged_checkpoint(
+                self.model_cfg, variables, f"{artifacts_dir}/merged"
+            )
 
     def state_to_host(self, state: TrainState) -> dict:
         """Gather the persistable slice of state (trainable + opt) to host.
