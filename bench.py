@@ -84,6 +84,8 @@ def _init_backend_with_fallback() -> None:
     crashing the harness."""
     if os.environ.get("BENCH_NO_CPU_FALLBACK"):
         return  # fallback leg (or probing disabled): init happens in main()
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return  # already pinned to CPU — nothing to probe
     import subprocess
 
     probe = (
@@ -97,13 +99,22 @@ def _init_backend_with_fallback() -> None:
             subprocess.run(
                 [sys.executable, "-c", probe],
                 timeout=240, check=True,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
             )
             return  # backend reachable; init in-process will succeed too
         except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
             # a dead remote-TPU tunnel can HANG init, not just fail it — the
-            # subprocess probe bounds that
-            print(f"backend probe failed (attempt {attempt + 1}): {e}", file=sys.stderr)
+            # subprocess probe bounds that. Surface the probe's stderr so a
+            # genuine install error (version mismatch etc.) isn't masked by
+            # the CPU fallback's success-looking output.
+            detail = (e.stderr or b"") if hasattr(e, "stderr") else ""
+            if isinstance(detail, bytes):
+                detail = detail.decode(errors="replace")
+            tail = "\n".join(str(detail).strip().splitlines()[-5:])
+            print(
+                f"backend probe failed (attempt {attempt + 1}): {e}\n{tail}",
+                file=sys.stderr,
+            )
             if attempt < 2:
                 time.sleep(30)
     print("TPU backend unavailable; re-exec on CPU fallback", file=sys.stderr)

@@ -88,9 +88,7 @@ def export_lora_adapter(
         "r": cfg.lora.rank,
         "lora_alpha": cfg.lora.alpha,
         "lora_dropout": cfg.lora.dropout,
-        "target_modules": sorted(
-            _HF_MODULE[p].rsplit(".", 1)[-1] for p in modules
-        ),
+        "target_modules": sorted(modules),
         "bias": "none",
         "fan_in_fan_out": False,
         "inference_mode": True,

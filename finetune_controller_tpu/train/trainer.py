@@ -459,7 +459,8 @@ class Trainer:
                 "(scan_layers=False run): skipping export"
             )
             return
-        host = self.state_to_host(state)  # collective — every rank calls
+        # collective — every rank calls; only the adapter slice is gathered
+        host = self.state_to_host(state, fields=("trainable",))
         if jax.process_index() != 0:
             return
         from ..models.hf_export import export_lora_adapter, export_merged_checkpoint
@@ -489,14 +490,20 @@ class Trainer:
                 self.model_cfg, variables, f"{artifacts_dir}/merged"
             )
 
-    def state_to_host(self, state: TrainState) -> dict:
+    def state_to_host(
+        self,
+        state: TrainState,
+        fields: tuple[str, ...] = ("step", "trainable", "opt_state"),
+    ) -> dict:
         """Gather the persistable slice of state (trainable + opt) to host.
 
         On a multi-host mesh, sharded arrays span non-addressable devices and
         plain ``device_get`` raises; every process must participate in a
         collective gather (all hosts call this, only rank 0 persists).
+        ``fields`` narrows the gather (e.g. adapter export needs only
+        ``trainable`` — no point allgathering Adam moments for it).
         """
-        tree = {"step": state.step, "trainable": state.trainable, "opt_state": state.opt_state}
+        tree = {f: getattr(state, f) for f in fields}
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
