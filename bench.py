@@ -123,7 +123,11 @@ def _init_backend_with_fallback() -> None:
     env["BENCH_TINY"] = "1"
     env["BENCH_NO_CPU_FALLBACK"] = "1"
     # TPU-sized knobs must not leak into the tiny CPU leg
-    for knob in ("BENCH_PRESET", "BENCH_SEQ", "BENCH_BATCH", "BENCH_STEPS"):
+    for knob in (
+        "BENCH_PRESET", "BENCH_SEQ", "BENCH_BATCH", "BENCH_STEPS",
+        "BENCH_MODE", "BENCH_REMAT_POLICY", "BENCH_FROZEN_DTYPE",
+        "BENCH_ATTN_IMPL",
+    ):
         env.pop(knob, None)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
@@ -152,6 +156,9 @@ def main() -> None:
     # Default global batch must divide evenly over the fsdp=all-chips mesh,
     # so scale it with the chip count (a v5e-16 slice gets batch 16, not 8).
     default_batch = max(8, n_chips)
+    # BENCH_MODE=qlora measures BASELINE config #3 (int4 frozen base —
+    # a 7B model fits one v5e chip); default is the config-#1 LoRA run
+    qlora = os.environ.get("BENCH_MODE", "lora").strip().lower() == "qlora"
     if tiny:
         preset = os.environ.get("BENCH_PRESET", "tiny-test")
         batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
@@ -159,17 +166,33 @@ def main() -> None:
         steps = int(os.environ.get("BENCH_STEPS", "10"))
         lora = LoRAConfig(rank=8)
     else:
-        preset = os.environ.get("BENCH_PRESET", "tinyllama-1.1b")
+        preset = os.environ.get(
+            "BENCH_PRESET", "mistral-7b" if qlora else "tinyllama-1.1b"
+        )
         batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
         seq = int(os.environ.get("BENCH_SEQ", "2048"))
         steps = int(os.environ.get("BENCH_STEPS", "20"))
         lora = LoRAConfig(rank=16)
 
     model_cfg = PRESETS[preset].replace(lora=lora, max_seq_len=max(seq, 128))
+    if qlora:
+        # int4 base; the d_ff-wide "mlp" remat saves don't fit next to a 7B
+        # model's activations on one chip — full recompute is the measured
+        # config (override via BENCH_REMAT_POLICY to experiment)
+        model_cfg = model_cfg.replace(quantize_base=True, remat_policy="full")
+    if os.environ.get("BENCH_REMAT_POLICY"):
+        model_cfg = model_cfg.replace(remat_policy=os.environ["BENCH_REMAT_POLICY"])
+    if os.environ.get("BENCH_ATTN_IMPL"):
+        model_cfg = model_cfg.replace(attention_impl=os.environ["BENCH_ATTN_IMPL"])
     mesh = MeshSpec(fsdp=-1).build(devices)
+    # bf16 storage for the frozen base halves its HBM footprint (measured
+    # ~1% step win on its own, and the headroom is what lets the "mlp" remat
+    # policy fit); the tiny CPU leg keeps f32 for checkpoint-test parity
+    frozen_default = "bfloat16" if not tiny else ""
     train_cfg = TrainConfig(
         mode="lora", batch_size=batch, seq_len=seq,
         total_steps=steps + 3, log_every=10**9, checkpoint_every=10**9,
+        frozen_dtype=os.environ.get("BENCH_FROZEN_DTYPE", frozen_default) or None,
     )
     trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
     state = trainer.init_state()
@@ -246,7 +269,8 @@ def main() -> None:
         target = CPU_FALLBACK_TARGET_TOKENS_PER_SEC
 
     print(json.dumps({
-        "metric": f"lora_sft_tokens_per_sec_per_chip[{preset},bs{batch},seq{seq}]",
+        "metric": f"{'qlora' if qlora else 'lora'}_sft_tokens_per_sec_per_chip"
+                  f"[{preset},bs{batch},seq{seq}]",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tok_per_sec_chip / target, 3),

@@ -191,14 +191,15 @@ class LlavaForCausalLM(nn.Module):
             )
 
         # reuse the Llama decoder stack over the combined sequence
-        from .llama import Block, _ScanBlock
+        from .llama import Block, _ScanBlock, remat_policy_fn
 
+        policy = remat_policy_fn(tcfg.remat_policy)
         if tcfg.scan_layers:
             block_cls = _ScanBlock
-            if tcfg.remat:
+            if tcfg.remat and policy is not None:
                 block_cls = nn.remat(
                     _ScanBlock, prevent_cse=False, static_argnums=(4,),
-                    policy=jax.checkpoint_policies.nothing_saveable,
+                    policy=policy,
                 )
             stack = nn.scan(
                 block_cls,
@@ -209,8 +210,13 @@ class LlavaForCausalLM(nn.Module):
             )(tcfg, name="blocks")
             x, _ = stack(x, positions, segment_ids, deterministic)
         else:
+            block_cls = (
+                nn.remat(Block, prevent_cse=False, static_argnums=(4,), policy=policy)
+                if tcfg.remat and policy is not None
+                else Block
+            )
             for i in range(tcfg.n_layers):
-                x = Block(tcfg, name=f"layer_{i}")(
+                x = block_cls(tcfg, name=f"layer_{i}")(
                     x, positions, segment_ids, deterministic
                 )
 

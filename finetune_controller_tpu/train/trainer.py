@@ -75,6 +75,12 @@ class TrainConfig:
     #: also write a merged full HF checkpoint at the end of a LoRA run
     #: (adapter-only PEFT export always happens for text LoRA runs)
     export_merged: bool = False
+    #: storage dtype for the FROZEN base params in lora mode (e.g. "bfloat16"
+    #: halves their HBM footprint and per-step weight traffic; the compute
+    #: path already runs bf16 so only the storage rounding changes). None
+    #: keeps the model's param_dtype. Int4 kernels and their bf16 scales
+    #: (models/quant.py) pass through untouched.
+    frozen_dtype: str | None = None
 
 
 class PreemptionGuard:
@@ -260,6 +266,7 @@ class Trainer:
         else:
             variables = self.model.init({"params": rng}, tokens)
         frozen, trainable = self._split(variables)
+        frozen = self._cast_frozen(frozen)
         opt_state = self.tx.init(trainable)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
@@ -267,6 +274,24 @@ class Trainer:
             trainable=trainable,
             opt_state=opt_state,
         )
+
+    def _cast_frozen(self, frozen: Any) -> Any:
+        """Downcast float32 leaves of the frozen base to ``cfg.frozen_dtype``
+        (lora mode only — full fine-tune keeps f32 master weights). Int4
+        packed kernels and their scales pass through untouched (non-f32
+        dtypes; the ``scales`` name guard is belt-and-braces for future
+        f32-scaled quant formats)."""
+        if not self.cfg.frozen_dtype or self.cfg.mode != "lora":
+            return frozen
+        dt = jnp.dtype(self.cfg.frozen_dtype)
+
+        def cast(path, x):
+            name = str(path[-1]) if path else ""
+            if "scales" in name or x.dtype != jnp.float32:
+                return x
+            return x.astype(dt)
+
+        return jax.tree_util.tree_map_with_path(cast, frozen)
 
     def _build(self) -> None:
         rng = jax.random.PRNGKey(self.cfg.seed)

@@ -1,0 +1,126 @@
+"""North-star metric #2 (BASELINE.md): job-submit -> first-training-step latency.
+
+Measures the control-plane overhead between an accepted submission and the
+first metrics row a user can see:
+
+    submit (task_builder) -> backend launch -> trainer process boots
+    -> jax import + first-step compile -> metrics.csv row 1 -> monitor upsert
+
+Runs entirely on the local backend (CPU, tiny preset), so the number is the
+plane's own overhead, not model FLOPs. The reference never measured this —
+its equivalent span crosses Kueue admission + pod scheduling + image pull,
+all cluster-dependent (reference ``app/jobs/task_builder.py:19-81``,
+``app/core/monitor.py:124-197``).
+
+Prints ONE JSON line:
+    {"metric": "submit_to_first_step_latency", "value": N, "unit": "s", ...}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def measure(tmp: str) -> dict:
+    from finetune_controller_tpu.controller.backends.local import LocalProcessBackend
+    from finetune_controller_tpu.controller.datasets import upload_dataset_bytes
+    from finetune_controller_tpu.controller.examples import (
+        LoRASFTArguments,
+        TinyTestLoRA,
+    )
+    from finetune_controller_tpu.controller.monitor import JobMonitor
+    from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+    from finetune_controller_tpu.controller.schemas import DatabaseStatus, JobInput
+    from finetune_controller_tpu.controller.statestore import StateStore
+    from finetune_controller_tpu.controller.task_builder import (
+        DatasetInput,
+        task_builder,
+    )
+    from finetune_controller_tpu.controller.devices import (
+        DeviceCatalog,
+        DeviceFlavor,
+        FlavorQuota,
+    )
+
+    state = StateStore(f"{tmp}/state")
+    store = LocalObjectStore(f"{tmp}/objects")
+    catalog = DeviceCatalog(
+        flavors=[DeviceFlavor(name="chip-1", generation="cpu", hosts=1,
+                              chips_per_host=1, runtime="cpu", queue="q")],
+        quotas=[FlavorQuota(flavor="chip-1", nominal_chips=2)],
+        default_flavor="chip-1",
+    )
+    backend = LocalProcessBackend(f"{tmp}/sandboxes", store, catalog,
+                                  sync_interval_s=0.1)
+    monitor = JobMonitor(state, store, backend, interval_s=0.05)
+    await state.connect()
+
+    rows = b'{"text": "the quick brown fox jumps over the lazy dog"}\n' * 16
+    ds = await upload_dataset_bytes(
+        store, state, user_id="bench", filename="train.jsonl",
+        data=rows, bucket="datasets",
+    )
+    # total_steps=1: the metrics row lands right after the first step (the
+    # trainer always writes on the final step), so "first step visible" is
+    # exactly what the poll below observes
+    spec = TinyTestLoRA(training_arguments=LoRASFTArguments(
+        total_steps=1, warmup_steps=1, batch_size=2, seq_len=16, lora_rank=2,
+    ))
+    job = JobInput(job_id="lat-1", user_id="bench", model_name="tiny-test-lora",
+                   device="chip-1", arguments={"total_steps": 1})
+
+    t_submit = time.perf_counter()
+    await task_builder(
+        job, spec, DatasetInput(dataset_id=ds.dataset_id),
+        state=state, store=store, backend=backend, catalog=catalog,
+        datasets_bucket="datasets", artifacts_bucket="artifacts",
+    )
+
+    t_running = None
+    deadline = time.perf_counter() + 300
+    # poll exactly like the monitor daemon would; first metrics row == the
+    # first completed training step became user-visible
+    while True:
+        await monitor.tick()
+        now = time.perf_counter()
+        if t_running is None:
+            rec = await state.get_job("lat-1")
+            if rec and rec.status is DatabaseStatus.RUNNING:
+                t_running = now
+        doc = await state.get_metrics("lat-1")
+        if doc is not None and len(doc.records) >= 1:
+            t_first = now
+            break
+        rec = await state.get_job("lat-1")
+        if rec and rec.status.is_final:
+            raise RuntimeError(f"job finished without metrics: {rec}")
+        if now > deadline:
+            raise TimeoutError("no first step within 300s")
+        await asyncio.sleep(0.05)
+
+    await backend.close()
+    await state.close()
+    return {
+        "metric": "submit_to_first_step_latency[tiny-test,local-backend,cpu]",
+        "value": round(t_first - t_submit, 2),
+        "unit": "s",
+        "submit_to_running_s": round((t_running or t_first) - t_submit, 2),
+    }
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        result = asyncio.run(measure(tmp))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
