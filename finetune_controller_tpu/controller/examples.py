@@ -43,6 +43,14 @@ class LoRASFTArguments(TrainingArguments):
         description="Capture a jax.profiler trace for N steps (0 = off); the "
                     "trace ships with the job artifacts under profile/",
     )
+    eval_every: int = Field(
+        0, ge=0,
+        description="Evaluate a held-out split every N steps (0 = off); adds "
+                    "eval_loss/eval_accuracy columns to the metrics",
+    )
+    eval_steps: int = Field(
+        8, ge=1, le=1024, description="Batches averaged per evaluation pass"
+    )
 
 
 class TinyLlamaLoRA(BaseFineTuneJob):

@@ -107,6 +107,10 @@ class ObjectStore:
         newest = max(csvs, key=lambda o: o["mtime"])
         raw = await self.get_bytes(newest["uri"])
         df = await asyncio.to_thread(pd.read_csv, io.BytesIO(raw))
+        # Ragged rows (e.g. eval columns written on their own cadence) parse
+        # as NaN — which is RFC-invalid in the JSON API and breaks the
+        # monitor's records-unchanged compare (NaN != NaN). Null them.
+        df = df.astype(object).where(pd.notna(df), None)
         records = df.to_dict(orient="records")
         return records, newest["uri"]
 

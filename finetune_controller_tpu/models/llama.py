@@ -48,6 +48,13 @@ def remat_policy_fn(name: str):
         # mlp + rope'd q/k/v (skips the qkv-projection + rope recompute);
         # ~84MB/layer more than "mlp" at bs8/seq2048 on TinyLlama
         "mlp_qkv": ("mlp_gate", "mlp_up", "attn_qkv"),
+        # the Pallas flash-attention residuals (out + logsumexp, named inside
+        # the kernel's custom_vjp fwd — ops/pallas/flash_attention.py): the
+        # backward then reuses them instead of re-running the forward kernel
+        "flash": ("flash_out", "flash_lse"),
+        # mlp + flash residuals — the measured-best combination on a v5e chip
+        # when both fit (TinyLlama bs8/seq2048)
+        "mlp_flash": ("mlp_gate", "mlp_up", "flash_out", "flash_lse"),
         # everything wide: MLP hiddens + rope'd q/k/v + attention context
         "wide": ("mlp_gate", "mlp_up", "attn_qkv", "attn_ctx"),
         # every projection output: backward re-runs (almost) no forward
@@ -87,6 +94,11 @@ class LlamaConfig:
     #: "full" | "attn" | "mlp" | "wide" | "matmuls" | "none" ("none" disables
     #: remat entirely even when ``remat=True`` is left at its default)
     remat_policy: str = "full"
+    #: dtype the lm-head logits are materialised in. float32 is exact; bf16
+    #: halves the (B, S, V) tensor's HBM footprint and round-trip traffic —
+    #: the loss still computes its log-softmax in f32 (train/losses.py), only
+    #: the stored logits are rounded. None = float32.
+    logits_dtype: Any = None
     scan_layers: bool = True
     tie_embeddings: bool = False
     lora: LoRAConfig = dataclasses.field(default_factory=LoRAConfig)
@@ -331,7 +343,7 @@ def pipelined_causal_lm_logits(
         logits = LoRADense(
             cfg.vocab_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype
         ).apply({"params": params["lm_head"]}, x)
-    return logits.astype(jnp.float32)
+    return logits.astype(cfg.logits_dtype or jnp.float32)
 
 
 class _ScanBlock(nn.Module):
@@ -400,7 +412,7 @@ class LlamaForCausalLM(nn.Module):
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
             )(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(cfg.logits_dtype or jnp.float32)
 
     def init_variables(self, rng: jax.Array, batch: int = 1, seq: int = 8):
         tokens = jnp.zeros((batch, seq), jnp.int32)

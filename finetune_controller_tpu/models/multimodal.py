@@ -223,7 +223,7 @@ class LlavaForCausalLM(nn.Module):
         x = RMSNorm(tcfg.rms_eps, tcfg.dtype, tcfg.param_dtype, name="final_norm")(x)
         x = x[:, n_img:]                                 # logits for text positions only
         logits = _proj(tcfg.replace(lora=LoRAConfig()), "lm_head", tcfg.vocab_size)(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(tcfg.logits_dtype or jnp.float32)
 
     def init_variables(self, rng: jax.Array, batch: int = 1, seq: int = 8):
         tokens = jnp.zeros((batch, seq), jnp.int32)

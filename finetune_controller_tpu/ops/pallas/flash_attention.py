@@ -38,6 +38,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -85,14 +86,18 @@ def _fwd_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
-        k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # matmul inputs stay in their storage dtype (bf16 in production) with
+        # f32 MXU accumulation — upcasting them to f32 first would push the
+        # dots off the fast MXU path (measured ~12% FLOP efficiency vs ~3x
+        # after the fix). The scale folds in AFTER the dot, in f32.
+        q = q_ref[0, 0]                                      # (bq, d)
+        k = k_ref[0, 0]                                      # (bk, d)
+        v = v_ref[0, 0]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (bq, bk)
+        ) * scale  # (bq, bk) f32
         q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = q_pos >= k_pos
@@ -108,8 +113,10 @@ def _fwd_kernel(
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)          # (bq, bk)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p rounds to the value dtype for the MXU (the FlashAttention-2
+        # recipe); accumulation stays f32 in VMEM scratch
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = m_new
@@ -237,28 +244,31 @@ def _bwd_dq_kernel(
 
     @pl.when(needed)
     def _compute():
-        qs = q_ref[0, 0].astype(jnp.float32) * scale          # scaled q (bq, d)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # storage-dtype (bf16) matmul inputs + f32 accumulation — see the
+        # forward kernel's note; the scale folds in after the s dot
+        q = q_ref[0, 0]                                        # (bq, d)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                                    # (bq, 1)
         delta = delta_ref[0, 0]
 
         s = jax.lax.dot_general(
-            qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
         q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = (q_pos >= k_pos) & (k_pos < seq_len)
         mask &= qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)             # (bq, bk)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)             # (bq, bk) f32
 
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(ik == nk - 1)
@@ -300,16 +310,19 @@ def _bwd_dkv_kernel(
 
     @pl.when(needed)
     def _compute():
-        k = k_ref[0, 0].astype(jnp.float32)                    # (bk, d)
-        v = v_ref[0, 0].astype(jnp.float32)
-        qs = q_ref[0, 0].astype(jnp.float32) * scale           # (bq, d)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # storage-dtype (bf16) matmul inputs + f32 accumulation — see the
+        # forward kernel's note; the scale folds in after the s dot and at
+        # the dK finalize (it used to ride on a pre-scaled f32 q)
+        k = k_ref[0, 0]                                        # (bk, d)
+        v = v_ref[0, 0]
+        q = q_ref[0, 0]                                        # (bq, d)
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                                    # (bq, 1)
         delta = delta_ref[0, 0]
 
         s = jax.lax.dot_general(
-            qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                                      # (bq, bk)
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                              # (bq, bk)
         q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = (q_pos >= k_pos) & (q_pos < seq_len)
@@ -318,20 +331,22 @@ def _bwd_dkv_kernel(
 
         # dV += pᵀ · dO
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
-        # dK += dsᵀ · q_scaled  (the chain rule's ·scale rides on q_scaled)
+        # dK += scale · dsᵀ · q (scale applied once, at finalize)
         dk_acc[...] += jax.lax.dot_general(
-            ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(j == n_inner - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dk_ref[0, 0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
@@ -463,7 +478,16 @@ def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret):
     out, lse = _flash_forward(
         q, k, v, segment_ids, block_q=block_q, block_k=block_k, interpret=interpret
     )
-    return out, (q, k, v, segment_ids, out, lse)
+    # Named so a remat policy (models/llama.py remat_policy_fn, e.g.
+    # "mlp_flash") can SAVE these residuals: under plain per-layer remat the
+    # backward re-runs this whole forward kernel just to rebuild out/lse —
+    # ~125 ms/step of the TinyLlama bench profile. checkpoint_name inside a
+    # custom_vjp fwd is honored by save_only_these_names (verified by jaxpr:
+    # the named values move to the primal pass and the remat region consumes
+    # them as constants).
+    res_out = checkpoint_name(out, "flash_out")
+    res_lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, segment_ids, res_out, res_lse)
 
 
 def _flash_bwd(block_q, block_k, interpret, residuals, g):

@@ -14,10 +14,14 @@ Spec schema (all sections optional except artifacts_dir):
       "model":    {"preset": "tiny-test", "overrides": {...}, "lora": {"rank": 8}},
       "training": {... TrainConfig fields ...},
       "mesh":     {"dp": 1, "fsdp": -1, "tp": 1, "sp": 1, "ep": 1, "pp": 1},
-      "dataset":  {"path": "...", "tokenizer_file": null}
+      "dataset":  {"path": "...", "tokenizer_file": null, "eval_path": "..."}
                   | {"synthetic": {"task": "increment"}},
       "artifacts_dir": "/data/artifacts"
     }
+
+With ``training.eval_every > 0`` a held-out stream is evaluated on that
+cadence: ``dataset.eval_path`` when given, otherwise a disjoint synthetic
+stream (offset seed), and eval_loss/eval_accuracy columns join metrics.csv.
 """
 
 from __future__ import annotations
@@ -76,15 +80,16 @@ def build_mesh(spec: dict):
 
 def build_batches(
     spec: dict, model_cfg, train_cfg, local_batch_size: int,
-    shard_index: int, shard_count: int,
+    shard_index: int, shard_count: int, split: str = "train",
 ):
     from ..data.loader import jsonl_token_batches
     from ..data.synthetic import synthetic_batches
 
     ds = spec.get("dataset", {})
-    if "path" in ds and ds["path"]:
+    path = ds.get("eval_path") if split == "eval" else ds.get("path")
+    if path:
         return jsonl_token_batches(
-            ds["path"],
+            path,
             batch_size=local_batch_size,
             seq_len=train_cfg.seq_len,
             tokenizer_file=ds.get("tokenizer_file"),
@@ -92,15 +97,21 @@ def build_batches(
             shard_index=shard_index,
             shard_count=shard_count,
         )
+    if split == "eval" and ds.get("path"):
+        # real train data but no eval split configured: nothing held out
+        return None
     synth = ds.get("synthetic", {})
     # multimodal configs get pixels sized to their vision tower automatically
     image_size = getattr(getattr(model_cfg, "vision", None), "image_size", 0)
+    # the eval stream draws from a disjoint region of the generator's seed
+    # space so held-out rows never coincide with training rows
+    seed = train_cfg.seed + shard_index + (100_003 if split == "eval" else 0)
     return synthetic_batches(
         batch_size=local_batch_size,
         seq_len=train_cfg.seq_len,
         vocab_size=model_cfg.vocab_size,
         task=synth.get("task", "brightness" if image_size else "increment"),
-        seed=train_cfg.seed + shard_index,
+        seed=seed,
         image_size=image_size,
     )
 
@@ -150,9 +161,24 @@ def run_job(spec: dict) -> None:
         local_batch_size=trainer.local_batch_size,
         shard_index=jax.process_index(), shard_count=jax.process_count(),
     )
+    eval_batches = None
+    if train_cfg.eval_every > 0:
+        eval_batches = build_batches(
+            spec, model_cfg, train_cfg,
+            local_batch_size=trainer.local_batch_size,
+            shard_index=jax.process_index(), shard_count=jax.process_count(),
+            split="eval",
+        )
+        if eval_batches is None:
+            raise ValueError(
+                "training.eval_every > 0 but the dataset has no eval split: "
+                "set dataset.eval_path (or use a synthetic dataset, which "
+                "holds out a disjoint stream automatically)"
+            )
     state = trainer.fit(
         batches, artifacts_dir,
         pretrained_dir=spec.get("model", {}).get("weights_dir"),
+        eval_batches=eval_batches,
     )
     # deployable artifacts: PEFT adapter (+ merged checkpoint if configured)
     trainer.export_artifacts(state, artifacts_dir)

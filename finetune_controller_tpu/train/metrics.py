@@ -16,11 +16,22 @@ from typing import IO, Any, Mapping
 
 
 class MetricsWriter:
-    def __init__(self, artifacts_dir: str, filename: str = "metrics.csv", append: bool = False):
+    def __init__(
+        self,
+        artifacts_dir: str,
+        filename: str = "metrics.csv",
+        append: bool = False,
+        extra_fields: tuple[str, ...] = (),
+    ):
+        """``extra_fields`` declares columns that may appear only on LATER
+        rows (e.g. eval metrics written on their own cadence): the header is
+        pinned at the first write, so anything not present in the first row
+        must be declared up front or it would be silently dropped."""
         os.makedirs(artifacts_dir, exist_ok=True)
         self.path = os.path.join(artifacts_dir, filename)
         self._file: IO[str] | None = None
         self._writer: csv.DictWriter | None = None
+        self._extra_fields = extra_fields
         self._resume_fields: list[str] | None = None
         if append and os.path.exists(self.path):
             with open(self.path) as f:
@@ -32,12 +43,32 @@ class MetricsWriter:
         row = {"timestamp": round(time.time(), 3), **row}
         if self._writer is None:
             if self._resume_fields is not None:
+                missing = [
+                    f for f in self._extra_fields if f not in self._resume_fields
+                ]
+                if missing:
+                    # Resumed run gained new columns (e.g. eval enabled after
+                    # the first leg): rewrite the file under the union header
+                    # so the new columns aren't silently dropped.
+                    with open(self.path, newline="") as f:
+                        old_rows = list(csv.DictReader(f))
+                    self._resume_fields = self._resume_fields + missing
+                    with open(self.path, "w", newline="") as f:
+                        rewriter = csv.DictWriter(f, fieldnames=self._resume_fields)
+                        rewriter.writeheader()
+                        for old in old_rows:
+                            rewriter.writerow(
+                                {k: old.get(k, "") for k in self._resume_fields}
+                            )
                 # Preemption-resume: keep prior rows, reuse the existing header.
                 self._file = open(self.path, "a", newline="")
                 self._writer = csv.DictWriter(self._file, fieldnames=self._resume_fields)
             else:
+                fields = list(row.keys()) + [
+                    f for f in self._extra_fields if f not in row
+                ]
                 self._file = open(self.path, "w", newline="")
-                self._writer = csv.DictWriter(self._file, fieldnames=list(row.keys()))
+                self._writer = csv.DictWriter(self._file, fieldnames=fields)
                 self._writer.writeheader()
         self._writer.writerow({k: row.get(k, "") for k in self._writer.fieldnames})
         self._file.flush()

@@ -81,6 +81,11 @@ class TrainConfig:
     #: keeps the model's param_dtype. Int4 kernels and their bf16 scales
     #: (models/quant.py) pass through untouched.
     frozen_dtype: str | None = None
+    #: run a held-out evaluation every N steps (0 = off); requires an eval
+    #: batch stream passed to ``fit(eval_batches=...)``
+    eval_every: int = 0
+    #: batches averaged per evaluation pass
+    eval_steps: int = 8
 
 
 class PreemptionGuard:
@@ -402,6 +407,59 @@ class Trainer:
         )
         return new_state, metrics
 
+    def _eval_step(self, state: TrainState, batch: dict):
+        """Forward-only loss/accuracy on a held-out batch (no grads, no
+        state mutation — dropout off regardless of training mode)."""
+        variables = self._assemble(state.frozen, state.trainable)
+        apply_kw: dict[str, Any] = dict(
+            segment_ids=batch.get("segment_ids"), deterministic=True,
+        )
+        if self._is_multimodal:
+            apply_kw["pixels"] = batch.get("pixels")
+        if self.model_cfg.n_experts:
+            logits, _ = self.model.apply(
+                variables, batch["tokens"], mutable=("moe_aux",), **apply_kw
+            )
+        else:
+            logits = self.model.apply(variables, batch["tokens"], **apply_kw)
+        _, metrics = next_token_loss(
+            logits, batch["tokens"], batch.get("loss_mask")
+        )
+        return metrics
+
+    def _get_eval_jit(self, batch: dict):
+        key = ("eval",) + tuple(sorted(batch))
+        fn = self._step_jits.get(key)
+        if fn is None:
+            batch_sh = {k: self._batch_leaf_sharding(batch[k]) for k in batch}
+            fn = jax.jit(
+                self._eval_step,
+                in_shardings=(self._state_shardings, batch_sh),
+                out_shardings=None,
+            )
+            self._step_jits[key] = fn
+        return fn
+
+    def evaluate(self, state: TrainState, eval_batches: Iterator[dict]) -> dict:
+        """Average forward-only metrics over ``cfg.eval_steps`` batches."""
+        from ..parallel.ring import ring_mesh
+
+        sums: dict[str, float] = {}
+        n = 0
+        for _ in range(max(1, self.cfg.eval_steps)):
+            batch = self._shard_batch(next(eval_batches))
+            fn = self._get_eval_jit(batch)
+            with self.mesh, ring_mesh(self.mesh):
+                metrics = fn(state, batch)
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+        # target_tokens is a per-batch count — averaging it is meaningless,
+        # and only declared columns survive the CSV header
+        return {
+            f"eval_{k}": v / n for k, v in sums.items() if k != "target_tokens"
+        }
+
     # ---- host-side API ---------------------------------------------------
 
     def init_state(self) -> TrainState:
@@ -556,6 +614,7 @@ class Trainer:
         resume: bool = True,
         on_metrics: Callable[[int, dict], None] | None = None,
         pretrained_dir: str | None = None,
+        eval_batches: Iterable[dict] | None = None,
     ) -> TrainState:
         guard = PreemptionGuard()
         try:
@@ -613,7 +672,17 @@ class Trainer:
                 start_step = int(host["step"])
                 logger.info("resumed from checkpoint step %d", start_step)
 
-        writer = MetricsWriter(artifacts_dir, append=start_step > 0)
+        eval_it: Iterator[dict] | None = (
+            iter(eval_batches) if eval_batches is not None else None
+        )
+        if self.cfg.eval_every > 0 and eval_it is None:
+            raise ValueError(
+                "eval_every > 0 but no eval_batches were supplied to fit()"
+            )
+        writer = MetricsWriter(
+            artifacts_dir, append=start_step > 0,
+            extra_fields=("eval_loss", "eval_accuracy") if eval_it is not None else (),
+        )
         it: Iterator[dict] = iter(batches)
         # Fast-forward past already-consumed batches so a resumed run sees the
         # same data stream an uninterrupted run would have.
@@ -662,10 +731,30 @@ class Trainer:
                     )
 
                 last = step_idx + 1 == self.cfg.total_steps
-                if (step_idx + 1) % self.cfg.log_every == 0 or last:
+                eval_now = (
+                    self.cfg.eval_every > 0
+                    and eval_it is not None
+                    and ((step_idx + 1) % self.cfg.eval_every == 0 or last)
+                )
+                eval_metrics: dict[str, float] = {}
+                eval_elapsed = 0.0
+                if eval_now:
+                    eval_t0 = time.perf_counter()
+                    eval_metrics = self.evaluate(state, eval_it)
+                    eval_elapsed = time.perf_counter() - eval_t0
+                    logger.info(
+                        "step %d eval_loss %.4f eval_acc %.3f",
+                        step_idx + 1, eval_metrics["eval_loss"],
+                        eval_metrics["eval_accuracy"],
+                    )
+                # eval metrics ride ON a train log row (eval steps force one)
+                # so the CSV stays dense within each written row
+                if (step_idx + 1) % self.cfg.log_every == 0 or last or eval_now:
                     metrics = {k: float(v) for k, v in metrics.items()}
-                    dt = time.perf_counter() - window_t0
+                    # the evaluation pause doesn't count against throughput
+                    dt = time.perf_counter() - window_t0 - eval_elapsed
                     metrics["tokens_per_sec"] = window_tokens / max(dt, 1e-9)
+                    metrics.update(eval_metrics)
                     row = {"step": step_idx + 1, **metrics}
                     writer.write(row)
                     if on_metrics:

@@ -67,3 +67,32 @@ def test_bad_spec_rejected(tmp_path):
         raise AssertionError("should have raised")
     except ValueError as e:
         assert "bogus_field" in str(e)
+
+
+def test_eval_loop_writes_heldout_metrics(tmp_path):
+    """eval_every drives a held-out evaluation: eval columns ride on the
+    train log rows at the eval cadence (dense rows — ragged cells would
+    parse as NaN in the control plane's pandas reader)."""
+    import csv
+
+    spec = _spec(tmp_path, total_steps=4, eval_every=2)
+    spec["training"]["eval_steps"] = 2
+    cli.run_job(spec)
+    rows = list(csv.DictReader(open(tmp_path / "artifacts" / "metrics.csv")))
+    assert "eval_loss" in rows[0]
+    eval_rows = [r for r in rows if r["eval_loss"]]
+    assert len(eval_rows) == 2  # steps 2 and 4
+    assert {r["step"] for r in eval_rows} == {"2", "4"}
+    for r in eval_rows:
+        assert float(r["eval_loss"]) > 0
+        assert float(r["loss"]) > 0  # eval rides on a full train row
+
+
+def test_eval_without_heldout_split_fails_loudly(tmp_path):
+    spec = _spec(tmp_path, eval_every=2)
+    spec["dataset"] = {"path": str(tmp_path / "train.jsonl")}
+    (tmp_path / "train.jsonl").write_text('{"text": "hello world"}\n' * 8)
+    import pytest
+
+    with pytest.raises(ValueError, match="no eval split"):
+        cli.run_job(spec)

@@ -138,7 +138,8 @@ def test_remat_policies_are_numerically_identical():
         return float(loss), grads
 
     ref_loss, ref_grads = loss_and_grads("full")
-    for policy in ("attn", "mlp", "mlp_qkv", "wide", "matmuls", "none"):
+    for policy in ("attn", "mlp", "mlp_qkv", "flash", "mlp_flash", "wide",
+                   "matmuls", "none"):
         loss, grads = loss_and_grads(policy)
         assert abs(loss - ref_loss) < 1e-6, policy
         jax.tree.map(

@@ -100,3 +100,23 @@ def test_profiler_trace_ships_with_artifacts(tmp_path):
     profile_dir = tmp_path / "profile"
     traces = list(profile_dir.rglob("*.xplane.pb"))
     assert traces, f"no trace files under {profile_dir}"
+
+
+def test_metrics_writer_resume_gains_columns(tmp_path):
+    """A resumed run that enables eval mid-life rewrites the CSV under the
+    union header instead of silently dropping the new columns."""
+    import csv
+
+    from finetune_controller_tpu.train.metrics import MetricsWriter
+
+    w = MetricsWriter(str(tmp_path))
+    w.write({"step": 1, "loss": 2.0})
+    w.close()
+    w2 = MetricsWriter(
+        str(tmp_path), append=True, extra_fields=("eval_loss", "eval_accuracy")
+    )
+    w2.write({"step": 2, "loss": 1.5, "eval_loss": 1.8, "eval_accuracy": 0.4})
+    w2.close()
+    rows = list(csv.DictReader(open(tmp_path / "metrics.csv")))
+    assert rows[0]["loss"] == "2.0" and rows[0]["eval_loss"] == ""
+    assert rows[1]["eval_loss"] == "1.8" and rows[1]["eval_accuracy"] == "0.4"
