@@ -96,6 +96,7 @@ class LocalProcessBackend(TrainingBackend):
         backoff_limit: int = 2,
         python: str | None = None,
         extra_env: dict[str, str] | None = None,
+        warm_workers: int = 0,
     ):
         self.root = Path(root_dir).expanduser()
         self.root.mkdir(parents=True, exist_ok=True)
@@ -108,6 +109,12 @@ class LocalProcessBackend(TrainingBackend):
         self.extra_env = dict(extra_env or {})
         self._handles: dict[str, _JobHandle] = {}
         self._closing = False
+        #: pre-warmed trainer processes (train/warm_worker.py) keyed by their
+        #: platform env — they have already paid JAX import + backend init,
+        #: collapsing the submit -> first-step span (BASELINE.md north-star
+        #: #2). 0 disables the pool (tests keep deterministic process counts).
+        self.warm_workers = warm_workers
+        self._warm: dict[tuple, list[asyncio.subprocess.Process]] = {}
 
     # ------------------------------------------------------------------ submit
 
@@ -146,26 +153,7 @@ class LocalProcessBackend(TrainingBackend):
             )
             handle.spec_path.write_text(json.dumps(trainer_spec, indent=2))
 
-            # runtime env: CPU flavors get a virtual device mesh the size of
-            # the slice (the TPU-less test story, SURVEY.md §4)
-            env = dict(os.environ)
-            env.update(self.extra_env)
-            # the subprocess runs with the sandbox as cwd — make our package
-            # importable regardless of install state
-            pkg_root = str(Path(__file__).resolve().parents[3])
-            env["PYTHONPATH"] = (
-                pkg_root + os.pathsep + env["PYTHONPATH"]
-                if env.get("PYTHONPATH") else pkg_root
-            )
-            if flavor.runtime == "cpu":
-                env["JAX_PLATFORMS"] = "cpu"
-                n = flavor.total_chips * max(1, job.num_slices)
-                flags = env.get("XLA_FLAGS", "")
-                flags = " ".join(
-                    p for p in flags.split() if "host_platform_device_count" not in p
-                )
-                env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
-            handle.env = env
+            handle.env = self._runtime_env(flavor, job.num_slices)
 
             self.scheduler.submit(job.job_id, flavor.name, job.num_slices)
             handle.set_state(BackendJobState.SUSPENDED)
@@ -177,6 +165,114 @@ class LocalProcessBackend(TrainingBackend):
             self._handles.pop(job.job_id, None)
             raise BackendError(f"submit failed: {exc}") from exc
         self._admit_pending()
+
+    def _runtime_env(self, flavor: DeviceFlavor, num_slices: int) -> dict[str, str]:
+        """Runtime env for a job (or warm worker) on a flavor: CPU flavors get
+        a virtual device mesh the size of the slice (the TPU-less test story,
+        SURVEY.md §4)."""
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        # the subprocess runs with the sandbox as cwd — make our package
+        # importable regardless of install state
+        pkg_root = str(Path(__file__).resolve().parents[3])
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else pkg_root
+        )
+        if flavor.runtime == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            n = flavor.total_chips * max(1, num_slices)
+            flags = env.get("XLA_FLAGS", "")
+            flags = " ".join(
+                p for p in flags.split() if "host_platform_device_count" not in p
+            )
+            env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+        return env
+
+    # ------------------------------------------------------- warm worker pool
+
+    @staticmethod
+    def _env_key(env: dict[str, str]) -> tuple:
+        """Workers are only interchangeable within one platform config."""
+        return (env.get("JAX_PLATFORMS", ""), env.get("XLA_FLAGS", ""))
+
+    async def _spawn_warm(self, env: dict[str, str]) -> None:
+        if self._closing or self.warm_workers <= 0:
+            return
+        key = self._env_key(env)
+        pool = self._warm.setdefault(key, [])
+        pool[:] = [p for p in pool if p.returncode is None]
+        if len(pool) >= self.warm_workers:
+            return
+        # pre-claim output (JAX import warnings) goes to a pool log, not any
+        # job's log; after the claim the worker re-points itself at the job
+        pool_log = open(self.root / "warm_workers.log", "ab")
+        env = dict(env)
+        ready_path = self.root / f".warm_ready_{time.time_ns()}"
+        env["FTC_WARM_READY_FILE"] = str(ready_path)
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                self.python, "-m", "finetune_controller_tpu.train.warm_worker",
+                stdin=asyncio.subprocess.PIPE,
+                stdout=pool_log, stderr=asyncio.subprocess.STDOUT,
+                env=env, cwd=str(self.root),
+            )
+        finally:
+            pool_log.close()
+        proc.ftc_ready_path = ready_path  # type: ignore[attr-defined]
+        pool.append(proc)
+
+    def _claim_warm(self, env: dict[str, str]) -> asyncio.subprocess.Process | None:
+        pool = self._warm.get(self._env_key(env), [])
+        alive = [p for p in pool if p.returncode is None]
+        pool[:] = alive
+        # prefer a worker that has finished its import/init (ready file)
+        alive.sort(key=lambda p: Path(getattr(p, "ftc_ready_path", "/nonexistent")).exists())
+        if not alive:
+            return None
+        proc = alive[-1]
+        pool.remove(proc)
+        ready = getattr(proc, "ftc_ready_path", None)
+        if ready is not None:
+            Path(ready).unlink(missing_ok=True)
+        return proc
+
+    async def prewarm(
+        self,
+        flavor: DeviceFlavor | None = None,
+        num_slices: int = 1,
+        wait_s: float = 0.0,
+    ) -> None:
+        """Spawn the warm pool for a flavor (default: the catalog default) —
+        call at service startup so the first submission already warm-starts.
+        ``wait_s > 0`` blocks until the workers report ready (or the deadline
+        passes) — mainly for benchmarks that need a steady-state pool."""
+        if self.warm_workers <= 0:
+            return
+        flavor = flavor or self.catalog.get_worker(self.catalog.default_flavor)
+        env = self._runtime_env(flavor, num_slices)
+        for _ in range(self.warm_workers):
+            await self._spawn_warm(env)
+        deadline = time.time() + wait_s
+        pool = self._warm.get(self._env_key(env), [])
+        while time.time() < deadline:
+            alive = [p for p in pool if p.returncode is None]
+            if not alive:
+                # every spawned worker died (broken env, import failure) —
+                # an empty pool must not report "ready": claims will cold-
+                # spawn, and a latency bench would otherwise publish a bogus
+                # warm number
+                logger.warning(
+                    "warm-worker pool is empty: all spawned workers exited "
+                    "(see %s)", self.root / "warm_workers.log",
+                )
+                return
+            if all(
+                Path(getattr(p, "ftc_ready_path", "/nonexistent")).exists()
+                for p in alive
+            ):
+                return
+            await asyncio.sleep(0.2)
 
     def _admit_pending(self) -> None:
         if self._closing:
@@ -230,25 +326,57 @@ class LocalProcessBackend(TrainingBackend):
         finally:
             self.scheduler.release(handle.job_id)
             self._admit_pending()
+            # replenish the warm pool AFTER the job: a replacement spawning
+            # at claim time would contend (imports vs the job's first-step
+            # compile) and erase the warm start's saving
+            with contextlib.suppress(Exception):
+                await self._spawn_warm(handle.env)
 
     async def _run_once(self, handle: _JobHandle, attempt: int) -> int:
-        cmd = [
-            self.python, "-m", "finetune_controller_tpu.train.cli",
-            "--spec", str(handle.spec_path),
-        ]
-        handle.event("Started", f"attempt {attempt}: {shlex.join(cmd)}")
-        log_f = open(handle.logs_path, "ab")
-        try:
-            proc = await asyncio.create_subprocess_exec(
-                *cmd,
-                stdout=log_f,
-                stderr=asyncio.subprocess.STDOUT,
-                env=handle.env,
-                cwd=str(handle.sandbox),
-            )
-        except Exception:
+        proc = self._claim_warm(handle.env)
+        if proc is not None:
+            # warm start: the worker already paid JAX import + backend init;
+            # hand it the spec and let it re-point its output at the job log
+            request = json.dumps({
+                "spec": str(handle.spec_path),
+                "log": str(handle.logs_path),
+                "cwd": str(handle.sandbox),
+            })
+            try:
+                proc.stdin.write(request.encode() + b"\n")
+                await proc.stdin.drain()
+                proc.stdin.close()
+                handle.event(
+                    "Started", f"attempt {attempt}: warm worker pid={proc.pid}"
+                )
+            except (BrokenPipeError, ConnectionResetError, OSError) as exc:
+                # the worker died between the liveness check and the handoff —
+                # a dead pool member must not fail the job; cold-spawn instead
+                logger.warning(
+                    "warm worker pid=%s unusable (%s); falling back to cold spawn",
+                    proc.pid, exc,
+                )
+                handle.event("WarmWorkerLost", str(exc))
+                proc = None
+        if proc is None:
+            cmd = [
+                self.python, "-m", "finetune_controller_tpu.train.cli",
+                "--spec", str(handle.spec_path),
+            ]
+            handle.event("Started", f"attempt {attempt}: {shlex.join(cmd)}")
+            log_f = open(handle.logs_path, "ab")
+            try:
+                proc = await asyncio.create_subprocess_exec(
+                    *cmd,
+                    stdout=log_f,
+                    stderr=asyncio.subprocess.STDOUT,
+                    env=handle.env,
+                    cwd=str(handle.sandbox),
+                )
+            except Exception:
+                log_f.close()
+                raise
             log_f.close()
-            raise
         handle.proc = proc
         if handle.start_time is None:
             handle.start_time = time.time()
@@ -261,7 +389,6 @@ class LocalProcessBackend(TrainingBackend):
             rc = await proc.wait()
         finally:
             handle.proc = None
-            log_f.close()
         return rc
 
     # ------------------------------------------------------- artifact sidecar
@@ -436,3 +563,14 @@ class LocalProcessBackend(TrainingBackend):
         self._closing = True
         for job_id in list(self._handles):
             await self.delete_job(job_id)
+        for pool in self._warm.values():
+            for proc in pool:
+                if proc.returncode is None:
+                    # closing stdin without a request is the graceful exit
+                    with contextlib.suppress(Exception):
+                        proc.stdin.close()
+                    with contextlib.suppress(ProcessLookupError):
+                        proc.terminate()
+                    with contextlib.suppress(Exception):
+                        await proc.wait()
+        self._warm.clear()

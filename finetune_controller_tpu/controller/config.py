@@ -68,6 +68,10 @@ class Settings(BaseModel):
     # --- Monitor / sync cadence (reference: app/core/config.py:50-52) ---
     job_monitor_interval_s: float = 2.0
     artifact_sync_interval_s: float = 60.0
+    #: pre-warmed trainer processes per platform env on the local backend —
+    #: they pay JAX import + backend init before a job arrives, collapsing
+    #: the submit -> first-training-step latency (0 = off)
+    warm_workers: int = 0
 
     # --- Log streaming (reference: LOG_STREAM_SEARCH_STRING, app/core/config.py:26) ---
     log_stream_search_string: str = ""

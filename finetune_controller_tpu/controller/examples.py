@@ -81,6 +81,21 @@ class Llama3_8B_LoRA(BaseFineTuneJob):
     training_arguments: LoRASFTArguments
 
 
+class Gemma7B_LoRA(BaseFineTuneJob):
+    """Gemma family (GeGLU, tied head, head_dim 256) — numerics verified
+    against transformers' GemmaForCausalLM (tests/test_hf_import.py)."""
+
+    model_name = "gemma-7b-lora"
+    description = "Gemma-7B LoRA SFT on TPU"
+    task = TrainingTask.CAUSAL_LM
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "gemma-7b"
+    default_device = "v5e-8"
+    promotion_path = "models/gemma-7b"
+
+    training_arguments: LoRASFTArguments
+
+
 class Mistral7B_QLoRA(BaseFineTuneJob):
     """BASELINE config #3 — int4-quantized base weights, LoRA deltas."""
 
@@ -176,6 +191,7 @@ class TinyTestLoRA(BaseFineTuneJob):
 BUILTIN_JOB_SPECS: list[type[BaseFineTuneJob]] = [
     TinyLlamaLoRA,
     Llama3_8B_LoRA,
+    Gemma7B_LoRA,
     Mistral7B_QLoRA,
     Mixtral8x7B_MoE_LoRA,
     Llava15LoRA,

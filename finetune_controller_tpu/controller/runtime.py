@@ -43,6 +43,11 @@ class Runtime:
         )
         if run_monitor:
             self.monitor.start()
+        prewarm = getattr(self.backend, "prewarm", None)
+        if prewarm is not None:
+            # local backend: spawn the warm trainer pool for the default
+            # flavor so the FIRST submission already warm-starts
+            await prewarm()
 
     async def close(self) -> None:
         await self.monitor.stop()
@@ -69,6 +74,7 @@ def build_runtime(
             store,
             catalog,
             sync_interval_s=settings.artifact_sync_interval_s,
+            warm_workers=settings.warm_workers,
         )
     elif settings.backend == "k8s":
         from .backends.k8s import K8sJobSetBackend

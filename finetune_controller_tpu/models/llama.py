@@ -110,22 +110,36 @@ class LlamaConfig:
     # QLoRA: frozen projection kernels stored as blockwise int4 (config #3)
     quantize_base: bool = False
     quant_block: int = 64
+    # --- Gemma-family knobs (defaults = Llama semantics) -------------------
+    #: attention head dim decoupled from d_model // n_heads (Gemma uses 256
+    #: with d_model 2048/3072); 0 = d_model // n_heads
+    head_dim_override: int = 0
+    #: MLP gate activation: "silu" (Llama SwiGLU) | "gelu" (Gemma GeGLU,
+    #: tanh-approximate like transformers' gelu_pytorch_tanh)
+    mlp_act: str = "silu"
+    #: RMSNorm weight parameterisation: 0.0 = plain scale (Llama, ones-init);
+    #: 1.0 = (1 + scale) with zeros-init (Gemma — HF stores the offset form)
+    norm_offset: float = 0.0
+    #: multiply embedding output by sqrt(d_model) (Gemma input scaling)
+    embed_scale: bool = False
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     def replace(self, **kw) -> "LlamaConfig":
         return dataclasses.replace(self, **kw)
 
     def param_count(self) -> int:
         d, v, f, L = self.d_model, self.vocab_size, self.d_ff, self.n_layers
-        kvd = self.n_kv_heads * self.head_dim
+        hd = self.head_dim
+        qo = 2 * d * self.n_heads * hd
+        kv = 2 * d * self.n_kv_heads * hd
         if self.n_experts:
             mlp = self.n_experts * 3 * d * f + d * self.n_experts
         else:
             mlp = 3 * d * f
-        per_layer = d * d + 2 * d * kvd + d * d + mlp + 2 * d
+        per_layer = qo + kv + mlp + 2 * d
         return v * d + L * per_layer + d + (0 if self.tie_embeddings else d * v)
 
 
@@ -156,6 +170,25 @@ PRESETS: dict[str, LlamaConfig] = {
         d_ff=14336, max_seq_len=8192, n_experts=8, moe_top_k=2,
         attention_impl="auto",
     ),
+    # Gemma family: GeGLU MLP, (1+w) RMSNorm, sqrt(d) embed scaling, tied
+    # head, head_dim 256 decoupled from d_model/n_heads (model-card shapes)
+    "gemma-2b": LlamaConfig(
+        vocab_size=256000, d_model=2048, n_layers=18, n_heads=8, n_kv_heads=1,
+        d_ff=16384, max_seq_len=8192, head_dim_override=256, mlp_act="gelu",
+        norm_offset=1.0, embed_scale=True, tie_embeddings=True,
+        rms_eps=1e-6, attention_impl="auto", remat_policy="mlp",
+    ),
+    "gemma-7b": LlamaConfig(
+        vocab_size=256000, d_model=3072, n_layers=28, n_heads=16, n_kv_heads=16,
+        d_ff=24576, max_seq_len=8192, head_dim_override=256, mlp_act="gelu",
+        norm_offset=1.0, embed_scale=True, tie_embeddings=True,
+        rms_eps=1e-6, attention_impl="auto", remat_policy="mlp",
+    ),
+    "tiny-gemma-test": LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, head_dim_override=32, mlp_act="gelu",
+        norm_offset=1.0, embed_scale=True, tie_embeddings=True, rms_eps=1e-6,
+    ),
     "tiny-moe-test": LlamaConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=128, n_experts=4, moe_top_k=2,
@@ -182,15 +215,21 @@ class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    #: weight parameterisation: effective scale = offset + stored scale.
+    #: 0.0 = Llama (ones-init scale); 1.0 = Gemma ((1 + w), zeros-init —
+    #: matching how HF Gemma checkpoints store the weight)
+    offset: float = 0.0
 
     @nn.compact
     def __call__(self, x):
-        scale = self.param(
-            "scale", nn.initializers.ones_init(), (x.shape[-1],), self.param_dtype
+        init = (
+            nn.initializers.zeros_init() if self.offset
+            else nn.initializers.ones_init()
         )
+        scale = self.param("scale", init, (x.shape[-1],), self.param_dtype)
         x32 = x.astype(jnp.float32)
         norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
-        return (norm * scale.astype(jnp.float32)).astype(self.dtype)
+        return (norm * (self.offset + scale.astype(jnp.float32))).astype(self.dtype)
 
 
 def _proj(cfg: LlamaConfig, name: str, features: int) -> LoRADense:
@@ -239,7 +278,8 @@ class MLP(nn.Module):
         cfg = self.cfg
         gate = checkpoint_name(_proj(cfg, "gate_proj", cfg.d_ff)(x, deterministic), "mlp_gate")
         up = checkpoint_name(_proj(cfg, "up_proj", cfg.d_ff)(x, deterministic), "mlp_up")
-        out = _proj(cfg, "down_proj", cfg.d_model)(nn.silu(gate) * up, deterministic)
+        act = nn.gelu if cfg.mlp_act == "gelu" else nn.silu  # GeGLU | SwiGLU
+        out = _proj(cfg, "down_proj", cfg.d_model)(act(gate) * up, deterministic)
         return checkpoint_name(out, "mlp_down")
 
 
@@ -249,9 +289,9 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids, deterministic=True):
         cfg = self.cfg
-        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="attn_norm")(x)
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, cfg.norm_offset, name="attn_norm")(x)
         x = x + Attention(cfg, name="attn")(h, positions, segment_ids, deterministic)
-        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="mlp_norm")(x)
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, cfg.norm_offset, name="mlp_norm")(x)
         if cfg.n_experts:
             from .moe import MoEMLP
 
@@ -328,13 +368,15 @@ def pipelined_causal_lm_logits(
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     x = params["embed_tokens"]["embedding"].astype(cfg.dtype)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.d_model ** 0.5, cfg.dtype)
 
     x = gpipe_blocks(
         stacked_block_variables(variables), x, positions, segment_ids,
         stage_fn=make_block_stage_fn(cfg), mesh=mesh, n_micro=n_micro,
     )
 
-    x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype).apply(
+    x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, cfg.norm_offset).apply(
         {"params": params["final_norm"]}, x
     )
     if cfg.tie_embeddings:
@@ -373,6 +415,11 @@ class LlamaForCausalLM(nn.Module):
             name="embed_tokens",
         )
         x = embed(tokens)
+        if cfg.embed_scale:
+            # Gemma scales embedding outputs by sqrt(d_model); the cast
+            # matches transformers (the scale rounds through the compute
+            # dtype before multiplying)
+            x = x * jnp.asarray(cfg.d_model ** 0.5, cfg.dtype)
 
         policy = remat_policy_fn(cfg.remat_policy)
         if cfg.scan_layers:
@@ -402,7 +449,7 @@ class LlamaForCausalLM(nn.Module):
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids, deterministic)
 
-        x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, cfg.norm_offset, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = x @ embed.embedding.astype(cfg.dtype).T
         else:

@@ -220,7 +220,7 @@ class LlavaForCausalLM(nn.Module):
                     x, positions, segment_ids, deterministic
                 )
 
-        x = RMSNorm(tcfg.rms_eps, tcfg.dtype, tcfg.param_dtype, name="final_norm")(x)
+        x = RMSNorm(tcfg.rms_eps, tcfg.dtype, tcfg.param_dtype, tcfg.norm_offset, name="final_norm")(x)
         x = x[:, n_img:]                                 # logits for text positions only
         logits = _proj(tcfg.replace(lora=LoRAConfig()), "lm_head", tcfg.vocab_size)(x)
         return logits.astype(tcfg.logits_dtype or jnp.float32)

@@ -16,12 +16,15 @@ neighbours within a host, FSDP next, DP outermost across slices/DCN):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
 
 
 class AxisNames:
@@ -80,6 +83,46 @@ class MeshSpec:
         return build_mesh(self, devices)
 
 
+def order_devices_for_dcn(devices: Sequence, sizes: dict[str, int]) -> list:
+    """Order devices so the mesh maps onto the ICI/DCN hierarchy.
+
+    On a multi-slice TPU deployment each device carries a ``slice_index``;
+    ICI only connects chips within a slice, traffic between slices rides
+    DCN.  The mesh is reshaped row-major with ``dp`` outermost, so grouping
+    devices by slice makes every dp-subdivision fall on slice boundaries
+    whenever ``dp`` is a multiple of the slice count — inner axes (fsdp/ep/
+    pp/sp/tp) then ride ICI and only the dp gradient all-reduce crosses DCN,
+    the standard multi-slice recipe (dp-over-DCN x FSDP-over-ICI).
+
+    Emits a warning when an inner axis is forced across a slice boundary
+    (e.g. fsdp spanning two slices): still correct — XLA compiles DCN
+    collectives — but bandwidth-bound.  Single-slice and CPU/test devices
+    (no ``slice_index``) come back unchanged.
+    """
+    slice_of = [getattr(d, "slice_index", None) for d in devices]
+    distinct = {s for s in slice_of if s is not None}
+    if len(distinct) <= 1:
+        return list(devices)
+    ordered = [
+        d for _, d in sorted(
+            enumerate(devices),
+            key=lambda it: (slice_of[it[0]], it[0]),  # stable within a slice
+        )
+    ]
+    n_slices = len(distinct)
+    per_slice = len(ordered) // n_slices
+    inner = math.prod(v for a, v in sizes.items() if a != AxisNames.DATA)
+    # clean hierarchy iff each slice holds a whole number of inner tiles
+    if inner > per_slice or (per_slice and per_slice % inner):
+        logger.warning(
+            "mesh inner axes (%d devices) do not tile the %d-device slices: "
+            "an intra-slice axis will cross DCN — consider dp=%d so only "
+            "data-parallel gradient reduction leaves a slice",
+            inner, per_slice, n_slices,
+        )
+    return ordered
+
+
 def build_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     fixed = [spec.dp, spec.fsdp, spec.ep, spec.pp, spec.sp, spec.tp]
@@ -90,7 +133,7 @@ def build_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> M
         devices = devices[: math.prod(fixed)]
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in AxisNames.ORDER)
-    arr = np.asarray(devices).reshape(shape)
+    arr = np.asarray(order_devices_for_dcn(devices, sizes)).reshape(shape)
     return Mesh(arr, AxisNames.ORDER)
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import os
 import re
+import threading
 from typing import Any
 
 import jax
@@ -23,16 +24,40 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
 class CheckpointManager:
+    """Saves are ASYNC by default: ``save`` hands the (already host-side)
+    tree to a background writer and returns, so serialization + disk IO
+    overlap the next training steps — the standard TPU goodput lever.  At
+    most one save is in flight; ``wait()`` (called automatically before the
+    next save, any read, and by the trainer's exit path) is the durability
+    barrier."""
+
     def __init__(self, directory: str, keep: int = 3):
         self.directory = os.path.abspath(directory)
         self.keep = keep
         os.makedirs(self.directory, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer()
+        self._pending: threading.Thread | None = None
+        self._pending_error: list[BaseException] = []
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")
 
-    def all_steps(self) -> list[int]:
+    def wait(self) -> None:
+        """Block until any in-flight save is committed to disk.
+
+        Re-raises a background save's exception — a swallowed disk-full here
+        would let a preempted job exit believing its checkpoint committed."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        self._ckptr.wait_until_finished()
+        if self._pending_error:
+            err = self._pending_error.pop()
+            raise RuntimeError(f"background checkpoint save failed: {err}") from err
+
+    def _committed_steps(self) -> list[int]:
+        """Step dirs already committed on disk (does NOT wait — an in-flight
+        save's dir only appears at its atomic rename)."""
         steps = []
         for name in os.listdir(self.directory):
             m = _STEP_RE.match(name)
@@ -40,11 +65,31 @@ class CheckpointManager:
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
+    def all_steps(self) -> list[int]:
+        self.wait()
+        return self._committed_steps()
+
     def latest_step(self) -> int | None:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, tree: Any, force: bool = False) -> None:
+    def _save_sync(self, path: str, tree: Any) -> None:
+        try:
+            if jax.process_count() > 1:
+                # Orbax's save is itself a cross-process collective
+                # (sync_global_processes barriers); on multi-host only rank 0
+                # calls save with an already-gathered host tree, so use a
+                # non-collective msgpack writer (atomic tmp-dir rename).
+                self._save_msgpack(path, tree)
+            else:
+                self._ckptr.save(path, tree)
+                self._ckptr.wait_until_finished()
+        except BaseException as exc:  # noqa: BLE001 — re-raised from wait()
+            logger.exception("background checkpoint save to %s failed", path)
+            self._pending_error.append(exc)
+
+    def save(self, step: int, tree: Any, force: bool = False, blocking: bool = False) -> None:
+        self.wait()  # one in-flight save at a time (raises on a prior failure)
         path = self._path(step)
         if os.path.exists(path):
             if not force:
@@ -52,16 +97,16 @@ class CheckpointManager:
             import shutil
 
             shutil.rmtree(path)
-        if jax.process_count() > 1:
-            # Orbax's save is itself a cross-process collective (sync_global_
-            # processes barriers); on multi-host only rank 0 calls save with an
-            # already-gathered host tree, so use a non-collective msgpack
-            # writer (atomic via tmp-dir rename).
-            self._save_msgpack(path, tree)
-        else:
-            self._ckptr.save(path, tree)
-            self._ckptr.wait_until_finished()
+        # gc BEFORE starting the writer: gc lists only committed dirs, so it
+        # must not (and does not) wait on the save we are about to start —
+        # the whole point is overlapping serialization + IO with training
         self._gc()
+        self._pending = threading.Thread(
+            target=self._save_sync, args=(path, tree), daemon=False
+        )
+        self._pending.start()
+        if blocking:
+            self.wait()
 
     @staticmethod
     def _save_msgpack(path: str, tree: Any) -> None:
@@ -74,6 +119,7 @@ class CheckpointManager:
         os.replace(tmp, path)
 
     def restore(self, step: int, like: Any | None = None) -> Any:
+        self.wait()
         path = self._path(step)
         msgpack_file = os.path.join(path, "state.msgpack")
         if os.path.exists(msgpack_file):
@@ -90,7 +136,7 @@ class CheckpointManager:
         return step, self.restore(step, like)
 
     def _gc(self) -> None:
-        steps = self.all_steps()
+        steps = self._committed_steps()
         for step in steps[: -self.keep]:
             import shutil
 

@@ -790,5 +790,9 @@ class Trainer:
         finally:
             if profiling:
                 jax.profiler.stop_trace()
+            # durability barrier: an async checkpoint save must be committed
+            # before the process exits (especially the preemption path — the
+            # whole point of the save-on-SIGTERM is surviving the kill)
+            ckpt.wait()
             writer.close()
         return state

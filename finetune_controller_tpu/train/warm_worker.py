@@ -1,0 +1,69 @@
+"""Pre-warmed trainer process: eat the JAX import + backend-init cost
+*before* a job arrives.
+
+``python -m finetune_controller_tpu.train.warm_worker`` imports JAX and
+initialises the platform backend immediately, then blocks on stdin until the
+local backend hands it one request line:
+
+    {"spec": "/path/job.json", "log": "/path/logs.txt", "cwd": "/sandbox"}
+
+It then redirects stdout/stderr to the job's log file (the same file a
+cold-spawned trainer would write), chdirs into the sandbox, and runs the job
+via ``train.cli``.  One request per process — the pool replaces used workers.
+
+Why: the submit -> first-training-step span is dominated by interpreter +
+JAX import and backend init (~8-25 s measured; `BASELINE.md` north-star #2).
+The k8s equivalent is an image whose entrypoint pre-imports before fetching
+the spec; this is the local backend's version of that warm start.
+
+Closing stdin without a request is the shutdown signal (exit 0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    # Platform config (JAX_PLATFORMS, XLA_FLAGS device count) comes from the
+    # spawn env — the pool keys workers by it, so this matches the job's.
+    from ..platform import assert_platform_env
+
+    assert_platform_env()
+
+    import jax
+
+    jax.devices()  # force backend init now, not at first trace
+
+    # pre-import the whole training stack (flax/optax/orbax/models/data) —
+    # JAX alone is under half the interpreter's import bill
+    from . import checkpoint, cli, trainer  # noqa: F401
+    from ..data import loader, synthetic  # noqa: F401
+    from ..models import multimodal  # noqa: F401
+
+    ready = os.environ.get("FTC_WARM_READY_FILE")
+    if ready:
+        with open(ready, "w") as f:
+            f.write("ready\n")
+
+    line = sys.stdin.readline()
+    if not line.strip():
+        return 0  # pool shutdown
+    req = json.loads(line)
+
+    fd = os.open(req["log"], os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    if req.get("cwd"):
+        os.chdir(req["cwd"])
+
+    from .cli import main as cli_main
+
+    return cli_main(["--spec", req["spec"]])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

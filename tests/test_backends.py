@@ -255,3 +255,73 @@ def test_local_backend_stages_dataset(tmp_path):
         await backend.close()
 
     run(main())
+
+
+def test_warm_worker_pool_runs_job(tmp_path):
+    """A pre-warmed trainer process (JAX already imported) picks up the job:
+    the Started event records the warm worker, the job trains to success, and
+    the pool is replenished for the next job."""
+    import asyncio
+
+    from finetune_controller_tpu.controller.backends.local import (
+        LocalProcessBackend,
+    )
+    from finetune_controller_tpu.controller.datasets import upload_dataset_bytes
+    from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+    from finetune_controller_tpu.controller.schemas import (
+        BackendJobState,
+        JobInput,
+    )
+    from finetune_controller_tpu.controller.statestore import StateStore
+    from finetune_controller_tpu.controller.task_builder import (
+        DatasetInput,
+        task_builder,
+    )
+
+    from conftest import one_chip_catalog, run_async, tiny_job_spec
+
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        catalog = one_chip_catalog()
+        backend = LocalProcessBackend(
+            tmp_path / "sandboxes", store, catalog,
+            sync_interval_s=0.2, warm_workers=1,
+        )
+        await state.connect()
+        await backend.prewarm()
+        assert sum(len(p) for p in backend._warm.values()) == 1
+
+        ds = await upload_dataset_bytes(
+            store, state, user_id="u", filename="t.jsonl",
+            data=b'{"text": "warm start"}\n' * 8, bucket="datasets",
+        )
+        await task_builder(
+            JobInput(job_id="warm-1", user_id="u", model_name="tiny-test-lora",
+                     device="chip-1", arguments={"total_steps": 2}),
+            tiny_job_spec(2), DatasetInput(dataset_id=ds.dataset_id),
+            state=state, store=store, backend=backend, catalog=catalog,
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        deadline = asyncio.get_event_loop().time() + 180
+        while True:
+            report = await backend.get_job("warm-1")
+            if report.state in (BackendJobState.SUCCEEDED, BackendJobState.FAILED):
+                break
+            assert asyncio.get_event_loop().time() < deadline, report
+            await asyncio.sleep(0.2)
+        assert report.state is BackendJobState.SUCCEEDED, report
+
+        events = await backend.job_events("warm-1")
+        started = [e for e in events if e["reason"] == "Started"]
+        assert started and "warm worker" in started[0]["message"], started
+        # the claimed worker is replaced for the next job; the replenish runs
+        # in the job task's finally block, so poll rather than assert a race
+        deadline = asyncio.get_event_loop().time() + 30
+        while sum(len(p) for p in backend._warm.values()) < 1:
+            assert asyncio.get_event_loop().time() < deadline, backend._warm
+            await asyncio.sleep(0.1)
+        await backend.close()
+        await state.close()
+
+    run_async(main())

@@ -154,3 +154,34 @@ def test_mixtral_moe_import_matches_transformers(tmp_path):
         {"params": params}, jnp.asarray(tokens, jnp.int32), mutable=("moe_aux",)
     )
     np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-2)
+
+
+def test_gemma_import_matches_transformers(tmp_path):
+    """Gemma family: head_dim decoupled from d_model/n_heads, GeGLU MLP,
+    (1+w) RMSNorm, sqrt(d) embed scaling, tied head — all verified
+    numerically against transformers' GemmaForCausalLM on shared weights."""
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    cfg = PRESETS["tiny-gemma-test"].replace(dtype=jnp.float32)
+    torch.manual_seed(0)
+    hf_cfg = GemmaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads, intermediate_size=cfg.d_ff,
+        head_dim=cfg.head_dim, rms_norm_eps=cfg.rms_eps,
+        rope_theta=cfg.rope_theta, max_position_embeddings=cfg.max_seq_len,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    hf_model = GemmaForCausalLM(hf_cfg).eval()
+    ckpt = tmp_path / "hf-gemma"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+
+    params = load_llama_params(ckpt, cfg, dtype=jnp.float32)
+    ours = LlamaForCausalLM(cfg)
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    out = ours.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)

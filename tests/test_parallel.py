@@ -64,3 +64,59 @@ def test_tree_specs_on_real_model(devices8):
     for s in kernel_specs:
         if len(s) == 3:
             assert s[0] == "pp"
+
+
+def test_order_devices_for_dcn_groups_slices():
+    """Multi-slice device lists are regrouped so the outermost (dp) axis
+    subdivides on slice boundaries: inner axes stay on ICI, only the dp
+    gradient reduction crosses DCN."""
+    import dataclasses
+
+    from finetune_controller_tpu.parallel.mesh import (
+        AxisNames,
+        order_devices_for_dcn,
+    )
+
+    @dataclasses.dataclass
+    class FakeDev:
+        id: int
+        slice_index: int
+
+    # two slices of 4 chips, interleaved (the adversarial enumeration order)
+    devs = [FakeDev(i, i % 2) for i in range(8)]
+    sizes = {a: 1 for a in AxisNames.ORDER}
+    sizes[AxisNames.DATA] = 2      # dp over DCN
+    sizes[AxisNames.FSDP] = 4      # fsdp within a slice
+    ordered = order_devices_for_dcn(devs, sizes)
+    assert [d.slice_index for d in ordered] == [0, 0, 0, 0, 1, 1, 1, 1]
+    # stable within a slice (preserves enumeration order)
+    assert [d.id for d in ordered] == [0, 2, 4, 6, 1, 3, 5, 7]
+    # dp blocks (row-major outermost) == one slice each
+    assert {d.slice_index for d in ordered[:4]} == {0}
+    assert {d.slice_index for d in ordered[4:]} == {1}
+
+    # single-slice / CPU devices pass through untouched
+    plain = list(range(8))
+    assert order_devices_for_dcn(plain, sizes) == plain
+
+
+def test_order_devices_for_dcn_warns_on_cross_slice_inner_axis(caplog):
+    import dataclasses
+    import logging
+
+    from finetune_controller_tpu.parallel.mesh import (
+        AxisNames,
+        order_devices_for_dcn,
+    )
+
+    @dataclasses.dataclass
+    class FakeDev:
+        id: int
+        slice_index: int
+
+    devs = [FakeDev(i, i // 4) for i in range(8)]
+    sizes = {a: 1 for a in AxisNames.ORDER}
+    sizes[AxisNames.FSDP] = 8      # fsdp spanning both slices: DCN-bound
+    with caplog.at_level(logging.WARNING):
+        order_devices_for_dcn(devs, sizes)
+    assert any("cross" in r.message for r in caplog.records)
