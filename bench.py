@@ -162,15 +162,30 @@ def main() -> None:
     # Default global batch must divide evenly over the fsdp=all-chips mesh,
     # so scale it with the chip count (a v5e-16 slice gets batch 16, not 8).
     default_batch = max(8, n_chips)
-    # BENCH_MODE=qlora measures BASELINE config #3 (int4 frozen base —
-    # a 7B model fits one v5e chip); default is the config-#1 LoRA run
-    qlora = os.environ.get("BENCH_MODE", "lora").strip().lower() == "qlora"
+    # BENCH_MODE selects the BASELINE config family:
+    #   lora (default) — config #1 (TinyLlama LoRA)
+    #   qlora          — config #3 (int4 frozen base; a 7B fits one v5e chip)
+    #   mm             — config #5 (LLaVA multimodal SFT; int4 text tower +
+    #                    bf16 ViT — that combination fits one chip)
+    mode = os.environ.get("BENCH_MODE", "lora").strip().lower()
+    qlora = mode == "qlora"
+    mm = mode == "mm"
     if tiny:
-        preset = os.environ.get("BENCH_PRESET", "tiny-test")
+        preset = os.environ.get(
+            "BENCH_PRESET", "tiny-mm-test" if mm else "tiny-test"
+        )
         batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
         seq = int(os.environ.get("BENCH_SEQ", "128"))
         steps = int(os.environ.get("BENCH_STEPS", "10"))
         lora = LoRAConfig(rank=8)
+    elif mm:
+        preset = os.environ.get("BENCH_PRESET", "llava-1.5-7b")
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        # seq = TEXT tokens; the decoder additionally attends the 576-patch
+        # image prefix, which the FLOP accounting below includes
+        seq = int(os.environ.get("BENCH_SEQ", "1472"))
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        lora = LoRAConfig(rank=16)
     else:
         preset = os.environ.get(
             "BENCH_PRESET", "mistral-7b" if qlora else "tinyllama-1.1b"
@@ -180,11 +195,19 @@ def main() -> None:
         steps = int(os.environ.get("BENCH_STEPS", "20"))
         lora = LoRAConfig(rank=16)
 
-    model_cfg = PRESETS[preset].replace(lora=lora, max_seq_len=max(seq, 128))
-    if qlora:
+    if mm:
+        from finetune_controller_tpu.models.multimodal import MM_PRESETS
+
+        base_presets = MM_PRESETS
+    else:
+        base_presets = PRESETS
+    model_cfg = base_presets[preset].replace(lora=lora, max_seq_len=max(seq, 128))
+    if qlora or (mm and not tiny):
         # int4 base; the d_ff-wide "mlp" remat saves don't fit next to a 7B
         # model's activations on one chip — full recompute is the measured
-        # config (override via BENCH_REMAT_POLICY to experiment)
+        # config (override via BENCH_REMAT_POLICY to experiment). For mm the
+        # quantization covers the frozen text tower (the ViT + projector are
+        # plain flax Dense and ride the bf16 frozen cast instead).
         model_cfg = model_cfg.replace(quantize_base=True, remat_policy="full")
     if os.environ.get("BENCH_REMAT_POLICY"):
         model_cfg = model_cfg.replace(remat_policy=os.environ["BENCH_REMAT_POLICY"])
@@ -212,7 +235,12 @@ def main() -> None:
     )
     trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
     state = trainer.init_state()
-    batches = synthetic_batches(batch, seq, model_cfg.vocab_size, seed=0)
+    image_size = getattr(getattr(model_cfg, "vision", None), "image_size", 0) if mm else 0
+    batches = synthetic_batches(
+        batch, seq, model_cfg.vocab_size, seed=0,
+        task="brightness" if mm else "increment",
+        image_size=image_size,
+    )
 
     # Warmup: first step compiles; two more reach dispatch steady-state.
     warmup_losses = []
@@ -260,7 +288,19 @@ def main() -> None:
     tokens_per_step = batch * seq
     tok_per_sec_chip = tokens_per_step / med / n_chips
 
-    flops_per_token = 6.0 * model_cfg.param_count()
+    if mm:
+        # tokens = TEXT tokens, but the step's FLOPs also cover the decoder
+        # attending the image prefix and the ViT+projector encoding it —
+        # fold that into flops_per_(text-)token so the MFU stays honest
+        patches = model_cfg.vision.n_patches
+        n_text = model_cfg.text.param_count()
+        n_vision = model_cfg.param_count() - n_text
+        flops_per_step = 6.0 * (
+            n_text * batch * (seq + patches) + n_vision * batch * patches
+        )
+        flops_per_token = flops_per_step / tokens_per_step
+    else:
+        flops_per_token = 6.0 * model_cfg.param_count()
     # --- plausibility guard, platform-independent: no single chip of any ---
     # known kind sustains more than the best published peak; a figure above
     # that is a measurement bug (e.g. an async runtime making steps look
@@ -296,8 +336,9 @@ def main() -> None:
     else:
         target = CPU_FALLBACK_TARGET_TOKENS_PER_SEC
 
+    kind = "qlora" if qlora else ("mm_lora" if mm else "lora")
     print(json.dumps({
-        "metric": f"{'qlora' if qlora else 'lora'}_sft_tokens_per_sec_per_chip"
+        "metric": f"{kind}_sft_tokens_per_sec_per_chip"
                   f"[{preset},bs{batch},seq{seq}]",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",

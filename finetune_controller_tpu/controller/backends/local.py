@@ -249,7 +249,17 @@ class LocalProcessBackend(TrainingBackend):
         passes) — mainly for benchmarks that need a steady-state pool."""
         if self.warm_workers <= 0:
             return
-        flavor = flavor or self.catalog.get_worker(self.catalog.default_flavor)
+        if flavor is None:
+            try:
+                flavor = self.catalog.get_worker(self.catalog.default_flavor)
+            except KeyError:
+                # a latency optimization must not turn a config gap (no
+                # default flavor in the catalog) into a startup outage
+                logger.warning(
+                    "warm_workers=%d but the device catalog has no default "
+                    "flavor; skipping prewarm", self.warm_workers,
+                )
+                return
         env = self._runtime_env(flavor, num_slices)
         for _ in range(self.warm_workers):
             await self._spawn_warm(env)
@@ -573,4 +583,7 @@ class LocalProcessBackend(TrainingBackend):
                         proc.terminate()
                     with contextlib.suppress(Exception):
                         await proc.wait()
+                ready = getattr(proc, "ftc_ready_path", None)
+                if ready is not None:
+                    Path(ready).unlink(missing_ok=True)
         self._warm.clear()

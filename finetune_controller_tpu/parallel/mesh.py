@@ -129,8 +129,10 @@ def build_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> M
     if -1 not in fixed and math.prod(fixed) < len(devices):
         # A fully-specified mesh smaller than the host's device count is
         # honoured on a prefix of the devices (e.g. a 1-chip job on a
-        # multi-device test host).
-        devices = devices[: math.prod(fixed)]
+        # multi-device test host). Slice-group FIRST so the prefix fills
+        # whole slices instead of straddling DCN on an interleaved
+        # enumeration ({} sizes = sort only, warnings come later).
+        devices = order_devices_for_dcn(devices, {})[: math.prod(fixed)]
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in AxisNames.ORDER)
     arr = np.asarray(order_devices_for_dcn(devices, sizes)).reshape(shape)

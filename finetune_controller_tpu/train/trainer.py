@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import signal
+import sys
 import time
 from functools import partial
 from typing import Any, Callable, Iterable, Iterator
@@ -790,9 +791,19 @@ class Trainer:
         finally:
             if profiling:
                 jax.profiler.stop_trace()
-            # durability barrier: an async checkpoint save must be committed
-            # before the process exits (especially the preemption path — the
-            # whole point of the save-on-SIGTERM is surviving the kill)
-            ckpt.wait()
-            writer.close()
+            try:
+                # durability barrier: an async checkpoint save must commit
+                # before the process exits (especially the preemption path —
+                # the point of the save-on-SIGTERM is surviving the kill)
+                ckpt.wait()
+            except Exception:
+                if sys.exc_info()[1] is not None:
+                    # an exception (e.g. the preemption SystemExit 143) is
+                    # already propagating: log the save failure rather than
+                    # masking the original exit semantics
+                    logger.exception("final checkpoint save failed during teardown")
+                else:
+                    raise
+            finally:
+                writer.close()
         return state

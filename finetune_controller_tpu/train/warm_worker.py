@@ -49,6 +49,14 @@ def main() -> int:
             f.write("ready\n")
 
     line = sys.stdin.readline()
+    # the sentinel's job is done once a request (or shutdown) arrives; the
+    # worker owns its removal — the claim path's unlink is best-effort and
+    # misses workers claimed before the file existed
+    if ready:
+        try:
+            os.unlink(ready)
+        except OSError:
+            pass
     if not line.strip():
         return 0  # pool shutdown
     req = json.loads(line)
