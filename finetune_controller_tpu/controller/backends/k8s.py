@@ -128,6 +128,30 @@ def render_jobset(
     )
     trainer_cmd = bootstrap + spec.run_cmd("/etc/ftc/job.json")
 
+    # the JobSet replicated-job index IS the slice index — one fieldRef
+    # shared by the jax.distributed seam (FTC_SLICE_INDEX) and libtpu's
+    # MEGASCALE contract so the two can never drift
+    slice_index_ref = {
+        "fieldRef": {
+            "fieldPath": "metadata.annotations['jobset.sigs.k8s.io/job-index']"
+        }
+    }
+
+    # Multi-slice: libtpu's DCN transport needs the MEGASCALE_* contract in
+    # addition to the jax.distributed FTC_* seam — the coordinator is slice
+    # 0's host 0, the slice id is the JobSet replicated-job index. Harmless
+    # (and omitted) on single-slice jobs.
+    megascale_env: list[dict[str, Any]] = []
+    if max(1, job.num_slices) > 1:
+        megascale_env = [
+            {
+                "name": "MEGASCALE_COORDINATOR_ADDRESS",
+                "value": f"{job.job_id}-slice-0-0.{job.job_id}",
+            },
+            {"name": "MEGASCALE_NUM_SLICES", "value": str(job.num_slices)},
+            {"name": "MEGASCALE_SLICE_ID", "valueFrom": slice_index_ref},
+        ]
+
     trainer_container = {
         "name": "trainer",
         "image": image,
@@ -135,17 +159,8 @@ def render_jobset(
         "env": [
             {"name": "FTC_COORDINATOR_ADDRESS", "value": coordinator},
             {"name": "FTC_NUM_PROCESSES", "value": str(total_processes)},
-            {
-                "name": "FTC_SLICE_INDEX",
-                "valueFrom": {
-                    "fieldRef": {
-                        "fieldPath": (
-                            "metadata.annotations"
-                            "['jobset.sigs.k8s.io/job-index']"
-                        )
-                    }
-                },
-            },
+            *megascale_env,
+            {"name": "FTC_SLICE_INDEX", "valueFrom": slice_index_ref},
             {
                 "name": "JOB_COMPLETION_INDEX",
                 "valueFrom": {

@@ -49,6 +49,21 @@ def _dimension_semantics(*sem):
     return pltpu.CompilerParams(dimension_semantics=sem)
 
 
+def _segment_mask(qseg_ref, kseg_ref):
+    """(bq, bk) same-segment mask from the (1, 1, b*) segment-id refs."""
+    return qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
+
+
+def _block_positions(iq, ik, bq, bk):
+    """Absolute (q_pos, k_pos) iotas for a (bq, bk) score block — the masked
+    (non-interior) kernel paths compare these; which bound each kernel also
+    applies against seq_len differs (fwd/dq mask padded KEYS, dkv masks
+    padded QUERIES), so the comparisons stay at the call sites."""
+    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos, k_pos
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -68,6 +83,7 @@ def _fwd_kernel(
     *,
     seq_len: int,
     scale: float,
+    use_segments: bool,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -83,43 +99,60 @@ def _fwd_kernel(
     # causal frontier: this k block is live iff its first key position is
     # <= the q block's last query position
     needed = ik * bk <= (iq + 1) * bq - 1
+    # interior = every (q, k) pair in the block is causally valid AND inside
+    # the real sequence: the iota/compare/where mask passes can be skipped.
+    # The attention kernel is VPU-bound (S^2 elementwise vs 2dS^2 MXU flops
+    # at small head dims), so dropping mask passes on the ~N^2/2 interior
+    # blocks is a direct win at long sequence.
+    interior = ((ik + 1) * bk - 1 <= iq * bq) & ((ik + 1) * bk <= seq_len)
 
-    @pl.when(needed)
-    def _compute():
-        # matmul inputs stay in their storage dtype (bf16 in production) with
-        # f32 MXU accumulation — upcasting them to f32 first would push the
-        # dots off the fast MXU path (measured ~12% FLOP efficiency vs ~3x
-        # after the fix). The scale folds in AFTER the dot, in f32.
-        q = q_ref[0, 0]                                      # (bq, d)
-        k = k_ref[0, 0]                                      # (bk, d)
-        v = v_ref[0, 0]
-
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk) f32
-        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = q_pos >= k_pos
-        mask &= k_pos < seq_len  # tail block: beyond-S lanes are padding
-        mask &= qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
-        s = jnp.where(mask, s, NEG_INF)
-
+    def _online_update(s, mask):
+        """Shared online-softmax update; ``mask`` None = fully valid block."""
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # zero p under the mask explicitly: for a fully-masked row m_new is
         # still NEG_INF and exp(s - m_new) would be exp(0) = 1 per lane,
         # accumulating l = block count instead of 0
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)          # (bq, bk)
+        p = jnp.exp(s - m_new)                                # (bq, bk)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         # p rounds to the value dtype for the MXU (the FlashAttention-2
         # recipe); accumulation stays f32 in VMEM scratch
+        v = v_ref[0, 0]
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = m_new
+
+    def _scores():
+        # matmul inputs stay in their storage dtype (bf16 in production) with
+        # f32 MXU accumulation; the scale folds in AFTER the dot, in f32
+        return jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk) f32
+
+    @pl.when(needed & ~interior)
+    def _compute_masked():
+        s = _scores()
+        q_pos, k_pos = _block_positions(iq, ik, bq, bk)
+        mask = q_pos >= k_pos
+        mask &= k_pos < seq_len  # tail block: beyond-S lanes are padding
+        if use_segments:
+            mask &= _segment_mask(qseg_ref, kseg_ref)
+        _online_update(s, mask)
+
+    @pl.when(needed & interior)
+    def _compute_interior():
+        _online_update(
+            _scores(),
+            _segment_mask(qseg_ref, kseg_ref) if use_segments else None,
+        )
 
     @pl.when(ik == nk - 1)
     def _finalize():
@@ -156,6 +189,7 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
+    use_segments: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (out (B, S, H, D), lse (B, H, S_pad, 1) f32)."""
     b, s, h, d = q.shape
@@ -180,7 +214,8 @@ def _flash_forward(
     nk = pl.cdiv(s_pad, bk)
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, seq_len=s, scale=scale),
+        functools.partial(_fwd_kernel, seq_len=s, scale=scale,
+                          use_segments=use_segments),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -230,6 +265,7 @@ def _bwd_dq_kernel(
     *,
     seq_len: int,
     scale: float,
+    use_segments: bool,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -241,9 +277,10 @@ def _bwd_dq_kernel(
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     needed = ik * bk <= (iq + 1) * bq - 1
+    # all (q, k) pairs valid (see forward kernel): skip the mask passes
+    interior = ((ik + 1) * bk - 1 <= iq * bq) & ((ik + 1) * bk <= seq_len)
 
-    @pl.when(needed)
-    def _compute():
+    def _update(mask):
         # storage-dtype (bf16) matmul inputs + f32 accumulation — see the
         # forward kernel's note; the scale folds in after the s dot
         q = q_ref[0, 0]                                        # (bq, d)
@@ -256,11 +293,9 @@ def _bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = (q_pos >= k_pos) & (k_pos < seq_len)
-        mask &= qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)             # (bq, bk) f32
+        p = jnp.exp(s - lse)                                   # (bq, bk) f32
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
 
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -270,6 +305,18 @@ def _bwd_dq_kernel(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    @pl.when(needed & ~interior)
+    def _compute_masked():
+        q_pos, k_pos = _block_positions(iq, ik, bq, bk)
+        mask = (q_pos >= k_pos) & (k_pos < seq_len)
+        if use_segments:
+            mask &= _segment_mask(qseg_ref, kseg_ref)
+        _update(mask)
+
+    @pl.when(needed & interior)
+    def _compute_interior():
+        _update(_segment_mask(qseg_ref, kseg_ref) if use_segments else None)
 
     @pl.when(ik == nk - 1)
     def _finalize():
@@ -293,6 +340,7 @@ def _bwd_dkv_kernel(
     n_q_blocks: int,
     seq_len: int,
     scale: float,
+    use_segments: bool,
 ):
     ik, j = pl.program_id(2), pl.program_id(3)
     n_inner = pl.num_programs(3)   # = group * n_q_blocks
@@ -307,9 +355,10 @@ def _bwd_dkv_kernel(
 
     # this q block contributes iff its last query can see the block's first key
     needed = (iq + 1) * bq - 1 >= ik * bk
+    # all pairs causally valid AND no padded q rows: mask passes skippable
+    interior = ((ik + 1) * bk - 1 <= iq * bq) & ((iq + 1) * bq <= seq_len)
 
-    @pl.when(needed)
-    def _compute():
+    def _update(mask):
         # storage-dtype (bf16) matmul inputs + f32 accumulation — see the
         # forward kernel's note; the scale folds in after the s dot and at
         # the dK finalize (it used to ride on a pre-scaled f32 q)
@@ -323,11 +372,9 @@ def _bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                              # (bq, bk)
-        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = (q_pos >= k_pos) & (q_pos < seq_len)
-        mask &= qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
 
         # dV += pᵀ · dO
         dv_acc[...] += jax.lax.dot_general(
@@ -344,6 +391,18 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
+    @pl.when(needed & ~interior)
+    def _compute_masked():
+        q_pos, k_pos = _block_positions(iq, ik, bq, bk)
+        mask = (q_pos >= k_pos) & (q_pos < seq_len)
+        if use_segments:
+            mask &= _segment_mask(qseg_ref, kseg_ref)
+        _update(mask)
+
+    @pl.when(needed & interior)
+    def _compute_interior():
+        _update(_segment_mask(qseg_ref, kseg_ref) if use_segments else None)
+
     @pl.when(j == n_inner - 1)
     def _finalize():
         dk_ref[0, 0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
@@ -352,7 +411,7 @@ def _bwd_dkv_kernel(
 
 def _flash_backward(
     q, k, v, segment_ids, out, lse, g,
-    *, block_q: int, block_k: int, interpret: bool,
+    *, block_q: int, block_k: int, interpret: bool, use_segments: bool = True,
 ):
     b, s, h, d = q.shape
     hkv = k.shape[2]
@@ -385,7 +444,8 @@ def _flash_backward(
     nk = pl.cdiv(s_pad, bk)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, seq_len=s, scale=scale),
+        functools.partial(_bwd_dq_kernel, seq_len=s, scale=scale,
+                          use_segments=use_segments),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -411,7 +471,8 @@ def _flash_backward(
     # accumulates in VMEM scratch — no per-q-head f32 partials in HBM.
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, n_q_blocks=nq, seq_len=s, scale=scale
+            _bwd_dkv_kernel, n_q_blocks=nq, seq_len=s, scale=scale,
+            use_segments=use_segments,
         ),
         grid=(b, hkv, nk, group * nq),
         in_specs=[
@@ -465,18 +526,20 @@ def _flash_backward(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_attention(q, k, v, segment_ids, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention(q, k, v, segment_ids, block_q, block_k, interpret, use_segments):
     out, _ = _flash_forward(
         q, k, v, segment_ids,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        use_segments=use_segments,
     )
     return out
 
 
-def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret, use_segments):
     out, lse = _flash_forward(
-        q, k, v, segment_ids, block_q=block_q, block_k=block_k, interpret=interpret
+        q, k, v, segment_ids, block_q=block_q, block_k=block_k,
+        interpret=interpret, use_segments=use_segments,
     )
     # Named so a remat policy (models/llama.py remat_policy_fn, e.g.
     # "mlp_flash") can SAVE these residuals: under plain per-layer remat the
@@ -490,11 +553,12 @@ def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret):
     return out, (q, k, v, segment_ids, res_out, res_lse)
 
 
-def _flash_bwd(block_q, block_k, interpret, residuals, g):
+def _flash_bwd(block_q, block_k, interpret, use_segments, residuals, g):
     q, k, v, segment_ids, out, lse = residuals
     dq, dk, dv = _flash_backward(
         q, k, v, segment_ids, out, lse, g,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        use_segments=use_segments,
     )
     return dq, dk, dv, None
 
@@ -520,8 +584,12 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, _, _ = q.shape
+    # no segments -> the kernels statically skip every segment-mask pass
+    # (they are VPU-bound; see the interior-block note in _fwd_kernel)
+    use_segments = segment_ids is not None
     if segment_ids is None:
         segment_ids = jnp.zeros((b, s), jnp.int32)
     return _flash_attention(
-        q, k, v, segment_ids.astype(jnp.int32), block_q, block_k, interpret
+        q, k, v, segment_ids.astype(jnp.int32), block_q, block_k, interpret,
+        use_segments,
     )

@@ -96,6 +96,20 @@ def test_render_jobset_multislice():
            for e in rj["template"]["spec"]["template"]["spec"]["containers"][0]["env"]}
     assert env["FTC_NUM_PROCESSES"] == "8"  # 2 slices x 4 hosts
     assert js["metadata"]["labels"]["ftc/chips"] == "32"
+    # multi-slice jobs carry the libtpu DCN contract alongside the FTC_* seam
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith(_job().job_id)
+    assert "MEGASCALE_SLICE_ID" in env  # downward-API valueFrom (value=None)
+
+    # single-slice jobs must NOT get MEGASCALE env (libtpu would try DCN init)
+    js1 = render_jobset(
+        _job(), tiny_job_spec(), flavor,
+        namespace="ftc", image="ftc:test",
+        dataset_uri=None, artifacts_uri="obj://artifacts/x",
+    )
+    env1 = {e["name"] for e in
+            js1["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert not any(n.startswith("MEGASCALE") for n in env1)
 
 
 def test_render_trainer_spec_mesh_covers_slice():
