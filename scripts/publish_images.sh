@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Build and push the controller image (reference: scripts/publish_local.sh +
+# publish_git.sh — build from the working tree or a clean git archive, tag,
+# push to a registry).
+#
+# One image serves all three roles (API / monitor / trainer pod) — the
+# rendered Deployments override the command (scripts/render_crds.py), so
+# nothing consumes a separate monitor image. Dockerfile.monitor exists for
+# operators who want a dedicated monitor image and can be built the same way.
+#
+# Usage:
+#   scripts/publish_images.sh REGISTRY [TAG] [--git]
+#
+#   REGISTRY  e.g. us-docker.pkg.dev/my-proj/ftc or ghcr.io/my-org
+#   TAG       defaults to the short git SHA (plus -dirty when the working
+#             tree is and the build uses it)
+#   --git     build from `git archive HEAD` instead of the working tree, so
+#             the image provably matches a commit
+set -euo pipefail
+
+REGISTRY="${1:?usage: publish_images.sh REGISTRY [TAG] [--git]}"
+TAG="${2:-}"
+MODE="${3:-}"
+
+if [[ "${TAG}" == "--git" ]]; then
+  MODE="--git"
+  TAG=""
+fi
+if [[ -z "${TAG}" ]]; then
+  TAG="$(git rev-parse --short HEAD)"
+  # a --git build comes from the clean HEAD archive — it IS the commit,
+  # dirty working tree or not; only working-tree builds get the suffix
+  if [[ "${MODE}" != "--git" && -n "$(git status --porcelain)" ]]; then
+    TAG="${TAG}-dirty"
+  fi
+fi
+
+CTX="."
+CLEANUP=""
+if [[ "${MODE}" == "--git" ]]; then
+  CTX="$(mktemp -d)"
+  CLEANUP="${CTX}"
+  trap '[[ -n "${CLEANUP}" ]] && rm -rf "${CLEANUP}"' EXIT
+  git archive HEAD | tar -x -C "${CTX}"
+  echo "==> building from clean git archive of $(git rev-parse HEAD)"
+fi
+
+IMAGE="${REGISTRY}/finetune-controller-tpu:${TAG}"
+echo "==> building ${IMAGE}"
+# the Dockerfile must come from the build context too, or a --git build
+# would silently use uncommitted Dockerfile edits
+docker build -f "${CTX}/Dockerfile" -t "${IMAGE}" "${CTX}"
+echo "==> pushing ${IMAGE}"
+docker push "${IMAGE}"
+
+echo "==> done. Deploy with IMAGE=${IMAGE} scripts/cluster_install.sh"

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Run the control plane locally for development (reference: the local serve
+# wrappers in scripts/): API server with the in-process monitor and the
+# subprocess "fake cluster" local backend — the full submit -> train ->
+# metrics -> promote lifecycle with zero cluster dependencies.
+#
+# Usage: scripts/serve_local.sh [port]
+set -euo pipefail
+
+PORT="${1:-8787}"
+
+export FTC_ENVIRONMENT="${FTC_ENVIRONMENT:-local}"
+export FTC_BACKEND="${FTC_BACKEND:-local}"
+export FTC_MONITOR_IN_PROCESS="${FTC_MONITOR_IN_PROCESS:-true}"
+# pre-warmed trainer processes: first submit skips the JAX import wait
+export FTC_WARM_WORKERS="${FTC_WARM_WORKERS:-1}"
+# local training runs on the CPU backend unless the host has TPUs
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+exec python -m finetune_controller_tpu.controller.server --port "${PORT}"
