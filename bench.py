@@ -219,6 +219,7 @@ def main() -> None:
         model_cfg = model_cfg.replace(
             logits_dtype=_jnp.dtype(os.environ["BENCH_LOGITS_DTYPE"])
         )
+    probe_steps = min(5, steps)  # individually-blocked spread probe
     mesh = MeshSpec(fsdp=-1).build(devices)
     # bf16 storage for the frozen base halves its HBM footprint (measured
     # ~1% step win on its own, and the headroom is what lets the "mlp" remat
@@ -229,13 +230,13 @@ def main() -> None:
         # 3 warmup + the individually-blocked probe window + the timed window
         # must all fit inside the LR schedule (steps past total_steps would
         # train at the clamped min-LR floor, not the declared regime)
-        total_steps=steps + 3 + min(5, steps),
+        total_steps=steps + 3 + probe_steps,
         log_every=10**9, checkpoint_every=10**9,
         frozen_dtype=os.environ.get("BENCH_FROZEN_DTYPE", frozen_default) or None,
     )
     trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
     state = trainer.init_state()
-    image_size = getattr(getattr(model_cfg, "vision", None), "image_size", 0) if mm else 0
+    image_size = model_cfg.image_size  # 0 on text-only configs
     batches = synthetic_batches(
         batch, seq, model_cfg.vocab_size, seed=0,
         task="brightness" if mm else "increment",
@@ -253,7 +254,7 @@ def main() -> None:
     # (compile stragglers, tunnel hiccups) that the overlapped window hides.
     probe_times: list[float] = []
     timed_losses: list[float] = []
-    for _ in range(min(5, steps)):
+    for _ in range(probe_steps):
         step_batch = next(batches)
         t0 = time.perf_counter()
         state, metrics = trainer.step(state, step_batch)

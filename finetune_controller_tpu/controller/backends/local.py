@@ -376,6 +376,7 @@ class LocalProcessBackend(TrainingBackend):
             handle.event("Started", f"attempt {attempt}: {shlex.join(cmd)}")
             log_f = open(handle.logs_path, "ab")
             try:
+                # the child inherits the fd; the parent's copy closes either way
                 proc = await asyncio.create_subprocess_exec(
                     *cmd,
                     stdout=log_f,
@@ -383,10 +384,8 @@ class LocalProcessBackend(TrainingBackend):
                     env=handle.env,
                     cwd=str(handle.sandbox),
                 )
-            except Exception:
+            finally:
                 log_f.close()
-                raise
-            log_f.close()
         handle.proc = proc
         if handle.start_time is None:
             handle.start_time = time.time()

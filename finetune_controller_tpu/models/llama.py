@@ -127,6 +127,13 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.head_dim_override or self.d_model // self.n_heads
 
+    @property
+    def image_size(self) -> int:
+        """Pixels-per-side of the vision input (0 = text-only model) — the
+        duck-type surface multimodal configs override, so data pipelines can
+        size pixel batches without model-family checks."""
+        return 0
+
     def replace(self, **kw) -> "LlamaConfig":
         return dataclasses.replace(self, **kw)
 

@@ -76,6 +76,10 @@ class LlavaConfig:
     def attention_impl(self) -> str:
         return self.text.attention_impl
 
+    @property
+    def image_size(self) -> int:
+        return self.vision.image_size
+
     def replace(self, **kw) -> "LlavaConfig":
         # route llama-level overrides (lora=...) into the text config
         text_keys = {f.name for f in dataclasses.fields(LlamaConfig)}

@@ -102,7 +102,7 @@ def build_batches(
         return None
     synth = ds.get("synthetic", {})
     # multimodal configs get pixels sized to their vision tower automatically
-    image_size = getattr(getattr(model_cfg, "vision", None), "image_size", 0)
+    image_size = model_cfg.image_size
     # the eval stream draws from a disjoint region of the generator's seed
     # space so held-out rows never coincide with training rows
     seed = train_cfg.seed + shard_index + (100_003 if split == "eval" else 0)

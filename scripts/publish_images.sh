@@ -26,6 +26,12 @@ if [[ "${TAG}" == "--git" ]]; then
   MODE="--git"
   TAG=""
 fi
+if [[ -n "${MODE}" && "${MODE}" != "--git" ]]; then
+  # a typo'd --git must not silently publish a working-tree build that
+  # claims commit provenance
+  echo "error: unrecognized argument '${MODE}' (expected --git)" >&2
+  exit 2
+fi
 if [[ -z "${TAG}" ]]; then
   TAG="$(git rev-parse --short HEAD)"
   # a --git build comes from the clean HEAD archive — it IS the commit,

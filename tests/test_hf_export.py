@@ -118,3 +118,48 @@ def test_cli_run_ships_adapter(tmp_path):
     assert (art / "adapter" / "adapter_config.json").exists()
     assert (art / "merged" / "model.safetensors").exists()
     assert (art / "merged" / "config.json").exists()
+
+
+def test_gemma_adapter_roundtrip_through_peft(tmp_path):
+    """The PEFT adapter export is model-family-agnostic: a Gemma base
+    (tied head, decoupled head_dim, GeGLU) round-trips through peft with
+    matching logits."""
+    torch = pytest.importorskip("torch")
+    peft = pytest.importorskip("peft")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    cfg = PRESETS["tiny-gemma-test"].replace(
+        dtype=jnp.float32, lora=LoRAConfig(rank=4)
+    )
+    torch.manual_seed(0)
+    hf_cfg = GemmaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads, intermediate_size=cfg.d_ff,
+        head_dim=cfg.head_dim, rms_norm_eps=cfg.rms_eps,
+        rope_theta=cfg.rope_theta, max_position_embeddings=cfg.max_seq_len,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    hf_model = GemmaForCausalLM(hf_cfg).eval()
+    ckpt = tmp_path / "gemma-base"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+
+    params = load_llama_params(ckpt, cfg, dtype=jnp.float32)
+    ours = LlamaForCausalLM(cfg)
+    init_vars = ours.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    lora = _random_lora(init_vars)
+
+    adapter_dir = export_lora_adapter(
+        cfg, lora, tmp_path / "gemma-adapter", base_model_name=str(ckpt)
+    )
+    peft_model = peft.PeftModel.from_pretrained(hf_model, str(adapter_dir)).eval()
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16))
+    with torch.no_grad():
+        ref = peft_model(torch.tensor(tokens)).logits.float().numpy()
+    out = ours.apply(
+        {"params": params, "lora": lora}, jnp.asarray(tokens, jnp.int32)
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
