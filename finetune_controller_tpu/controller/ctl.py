@@ -1,0 +1,267 @@
+"""``ftc-ctl`` — terminal client for the control-plane API.
+
+The reference pairs its API with a browser frontend; this is the equivalent
+surface for terminals and scripts: submit, watch, stream logs, fetch metrics,
+promote — against any running controller (local `scripts/serve_local.sh` or
+an on-cluster deployment).
+
+    python -m finetune_controller_tpu.controller.ctl [--api URL] [--token T] CMD ...
+
+Commands:
+    models                              list submittable models
+    submit MODEL [--arg k=v ...] [--device D] [--dataset-file F | --dataset-url U | --dataset-id I] [--watch]
+    jobs [--page N]                     paginated job table
+    status JOB_ID [--watch]             one job (``--watch`` polls to final)
+    logs JOB_ID [--follow]              job logs (REST; --follow re-polls)
+    metrics JOB_ID                      metrics rows (latest last)
+    promote JOB_ID / unpromote JOB_ID
+    cancel JOB_ID
+    dev-token [USER_ID]                 mint a dev token (local envs only)
+
+Auth: ``--token`` or the FTC_CTL_TOKEN env var; the API URL defaults to
+``FTC_CTL_API`` or http://localhost:8787.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from typing import Any
+
+FINAL_STATES = {"succeeded", "failed", "cancelled", "unknown"}
+
+
+class ApiError(RuntimeError):
+    pass
+
+
+class Client:
+    def __init__(self, base: str, token: str | None):
+        self.base = base.rstrip("/")
+        self.token = token
+        self._session = None
+
+    async def __aenter__(self):
+        import aiohttp
+
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        self._session = aiohttp.ClientSession(headers=headers)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self._session.close()
+
+    async def request(self, method: str, path: str, **kw) -> Any:
+        url = f"{self.base}/api/v1{path}"
+        async with self._session.request(method, url, **kw) as r:
+            if r.status >= 400:
+                raise ApiError(f"{method} {path} -> {r.status}: {await r.text()}")
+            if "json" in r.headers.get("Content-Type", ""):
+                return await r.json()
+            return await r.text()
+
+    async def get(self, path: str, **kw) -> Any:
+        return await self.request("GET", path, **kw)
+
+    async def post(self, path: str, **kw) -> Any:
+        return await self.request("POST", path, **kw)
+
+
+def _parse_args_kv(pairs: list[str]) -> dict[str, Any]:
+    """k=v pairs with JSON-typed values (`lr=0.001 steps=50 name=run1`)."""
+    out: dict[str, Any] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--arg expects k=v, got {pair!r}")
+        k, _, v = pair.partition("=")
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
+def _print_json(obj: Any) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+async def _watch_job(client: Client, job_id: str, interval_s: float = 2.0) -> dict:
+    last = None
+    while True:
+        job = await client.get(f"/jobs/{job_id}")
+        line = f"{job['status']}"
+        if job.get("queue_position"):
+            line += f" (queue #{job['queue_position']})"
+        if line != last:
+            print(f"[{time.strftime('%H:%M:%S')}] {line}", file=sys.stderr)
+            last = line
+        if job["status"] in FINAL_STATES:
+            return job
+        await asyncio.sleep(interval_s)
+
+
+async def cmd_submit(client: Client, ns: argparse.Namespace) -> int:
+    import aiohttp
+
+    arguments = _parse_args_kv(ns.arg or [])
+    if ns.dataset_file:
+        form = aiohttp.FormData()
+        form.add_field("model_name", ns.model)
+        if ns.device:
+            form.add_field("device", ns.device)
+        form.add_field("arguments", json.dumps(arguments))
+        with open(ns.dataset_file, "rb") as f:
+            form.add_field("dataset_file", f.read(),
+                           filename=os.path.basename(ns.dataset_file))
+        result = await client.post("/jobs", data=form)
+    else:
+        body: dict[str, Any] = {"model_name": ns.model, "arguments": arguments}
+        if ns.device:
+            body["device"] = ns.device
+        if ns.dataset_url:
+            body["dataset_url"] = ns.dataset_url
+        if ns.dataset_id:
+            body["dataset_id"] = ns.dataset_id
+        result = await client.post("/jobs", json=body)
+    _print_json(result)
+    if ns.watch:
+        job = await _watch_job(client, result["job_id"])
+        _print_json(job)
+        return 0 if job["status"] == "succeeded" else 1
+    return 0
+
+
+async def cmd_jobs(client: Client, ns: argparse.Namespace) -> int:
+    page = await client.get("/jobs", params={"page": str(ns.page)})
+    rows = page.get("items", [])
+    if not rows:
+        print("no jobs")
+        return 0
+    width = max(len(r["job_id"]) for r in rows)
+    for r in rows:
+        dur = r.get("duration") or ""
+        print(f"{r['job_id']:<{width}}  {r['status']:<10}  {dur}")
+    print(f"(page {ns.page}, total {page.get('total')})")
+    return 0
+
+
+async def cmd_status(client: Client, ns: argparse.Namespace) -> int:
+    if ns.watch:
+        job = await _watch_job(client, ns.job_id)
+        _print_json(job)
+        return 0 if job["status"] == "succeeded" else 1
+    _print_json(await client.get(f"/jobs/{ns.job_id}"))
+    return 0
+
+
+async def cmd_logs(client: Client, ns: argparse.Namespace) -> int:
+    seen = 0
+    while True:
+        body = await client.get(f"/jobs/{ns.job_id}/logs")
+        lines = body.get("lines", []) if isinstance(body, dict) else body.splitlines()
+        for line in lines[seen:]:
+            print(line)
+        seen = len(lines)
+        if not ns.follow:
+            return 0
+        job = await client.get(f"/jobs/{ns.job_id}")
+        if job["status"] in FINAL_STATES:
+            return 0
+        await asyncio.sleep(2.0)
+
+
+async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:
+    body = await client.get(f"/jobs/{ns.job_id}/metrics")
+    _print_json(body.get("records", body))
+    return 0
+
+
+async def amain(ns: argparse.Namespace) -> int:
+    async with Client(ns.api, ns.token) as client:
+        if ns.cmd == "models":
+            _print_json(await client.get("/models"))
+            return 0
+        if ns.cmd == "submit":
+            return await cmd_submit(client, ns)
+        if ns.cmd == "jobs":
+            return await cmd_jobs(client, ns)
+        if ns.cmd == "status":
+            return await cmd_status(client, ns)
+        if ns.cmd == "logs":
+            return await cmd_logs(client, ns)
+        if ns.cmd == "metrics":
+            return await cmd_metrics(client, ns)
+        if ns.cmd in ("promote", "unpromote", "cancel"):
+            _print_json(await client.post(f"/jobs/{ns.job_id}/{ns.cmd}"))
+            return 0
+        if ns.cmd == "dev-token":
+            body = await client.post("/auth/dev-token",
+                                     json={"user_id": ns.user_id})
+            print(body["token"])
+            return 0
+        raise SystemExit(f"unknown command {ns.cmd!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ftc-ctl", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--api", default=os.environ.get("FTC_CTL_API", "http://localhost:8787"))
+    p.add_argument("--token", default=os.environ.get("FTC_CTL_TOKEN"))
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("models")
+    s = sub.add_parser("submit")
+    s.add_argument("model")
+    s.add_argument("--arg", action="append", metavar="K=V")
+    s.add_argument("--device")
+    s.add_argument("--dataset-file")
+    s.add_argument("--dataset-url")
+    s.add_argument("--dataset-id")
+    s.add_argument("--watch", action="store_true")
+    s = sub.add_parser("jobs")
+    s.add_argument("--page", type=int, default=1)
+    for name in ("status", "logs", "metrics", "promote", "unpromote", "cancel"):
+        s = sub.add_parser(name)
+        s.add_argument("job_id")
+        if name == "status":
+            s.add_argument("--watch", action="store_true")
+        if name == "logs":
+            s.add_argument("--follow", action="store_true")
+    s = sub.add_parser("dev-token")
+    s.add_argument("user_id", nargs="?", default="dev")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    ns = build_parser().parse_args(argv)
+    try:
+        import aiohttp  # noqa: F401 — the whole client needs it
+    except ImportError:
+        print(
+            "ftc-ctl needs the control-plane extras: "
+            "pip install 'finetune-controller-tpu[control]'",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        return asyncio.run(amain(ns))
+    except ApiError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # downstream pipe closed early (| head ...) — the unix-polite exit
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -512,3 +512,37 @@ def test_prometheus_metrics_endpoint(tmp_path):
         await client.close()
 
     run_async(main())
+
+
+def test_api_metrics_json_valid_with_eval_columns(tmp_path):
+    """An eval-enabled job's metrics (ragged eval columns) must serve as
+    RFC-valid JSON through the API — empty cells become null, never NaN."""
+
+    async def main():
+        client = await _client(_runtime(tmp_path))  # monitor in-process
+        body = {
+            "model_name": "tiny-test-lora",
+            "device": "chip-1",
+            "arguments": {"total_steps": 4, "warmup_steps": 1, "batch_size": 2,
+                          "seq_len": 16, "lora_rank": 2, "eval_every": 2,
+                          "eval_steps": 1},
+        }
+        r = await client.post("/api/v1/jobs", json=body)
+        assert r.status == 200, await r.text()
+        job_id = (await r.json())["job_id"]
+        job = await _wait_final(client, job_id)
+        assert job["status"] == "succeeded", job
+
+        r = await client.get(f"/api/v1/jobs/{job_id}/metrics")
+        raw = await r.read()
+        # strict parse: literal NaN tokens are RFC-invalid and must not appear
+        body = json.loads(raw.decode(), parse_constant=lambda c: (_ for _ in ()).throw(
+            AssertionError(f"non-RFC JSON constant {c!r} in metrics response")))
+        eval_rows = [rec for rec in body["records"] if rec.get("eval_loss") is not None]
+        assert eval_rows, body["records"]
+        assert all(rec["eval_loss"] > 0 for rec in eval_rows)
+        # (ragged-cell -> null conversion is unit-tested at the store level:
+        # tests/test_lifecycle.py + objectstore.get_metrics_records)
+        await client.close()
+
+    run_async(main())

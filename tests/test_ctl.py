@@ -1,0 +1,61 @@
+"""ftc-ctl terminal client tests: drive the real CLI against a real
+(socket-bound) control-plane server — the operator surface the reference
+only offered through its browser frontend."""
+
+import json
+
+from conftest import run_async
+
+from test_api import _runtime  # reuse the API tests' runtime builder
+
+from finetune_controller_tpu.controller import ctl
+
+
+def test_ctl_submit_watch_metrics_logs(tmp_path, capsys):
+    async def main():
+        from aiohttp.test_utils import TestServer
+
+        from finetune_controller_tpu.controller.server import build_app
+
+        rt = _runtime(tmp_path)
+        await rt.start(with_monitor=True)
+        server = TestServer(build_app(rt))
+        await server.start_server()
+        api = f"http://{server.host}:{server.port}"
+        try:
+            rc = await ctl.amain(ctl.build_parser().parse_args([
+                "--api", api, "submit", "tiny-test-lora",
+                "--arg", "total_steps=2", "--arg", "batch_size=2",
+                "--arg", "seq_len=16", "--arg", "lora_rank=2",
+                "--arg", "warmup_steps=1",
+                "--device", "chip-1",
+                "--watch",
+            ]))
+            assert rc == 0
+            out = capsys.readouterr().out
+            job_id = json.loads(out[: out.index("}\n") + 2])["job_id"]
+
+            assert await ctl.amain(ctl.build_parser().parse_args(
+                ["--api", api, "jobs"])) == 0
+            assert job_id in capsys.readouterr().out
+
+            assert await ctl.amain(ctl.build_parser().parse_args(
+                ["--api", api, "metrics", job_id])) == 0
+            rows = json.loads(capsys.readouterr().out)
+            assert rows and "loss" in rows[-1]
+
+            assert await ctl.amain(ctl.build_parser().parse_args(
+                ["--api", api, "logs", job_id])) == 0
+            assert "finished" in capsys.readouterr().out
+
+            # unknown job -> ApiError (main() maps it to exit 1)
+            import pytest
+
+            with pytest.raises(ctl.ApiError):
+                await ctl.amain(ctl.build_parser().parse_args(
+                    ["--api", api, "status", "nope"]))
+        finally:
+            await server.close()
+            await rt.close()
+
+    run_async(main())
