@@ -96,6 +96,21 @@ class Gemma7B_LoRA(BaseFineTuneJob):
     training_arguments: LoRASFTArguments
 
 
+class Qwen2_7B_LoRA(BaseFineTuneJob):
+    """Qwen-2 family (q/k/v projection biases) — numerics verified against
+    transformers' Qwen2ForCausalLM (tests/test_hf_import.py)."""
+
+    model_name = "qwen2-7b-lora"
+    description = "Qwen2-7B LoRA SFT on TPU"
+    task = TrainingTask.CAUSAL_LM
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "qwen2-7b"
+    default_device = "v5e-8"
+    promotion_path = "models/qwen2-7b"
+
+    training_arguments: LoRASFTArguments
+
+
 class Mistral7B_QLoRA(BaseFineTuneJob):
     """BASELINE config #3 — int4-quantized base weights, LoRA deltas."""
 
@@ -192,6 +207,7 @@ BUILTIN_JOB_SPECS: list[type[BaseFineTuneJob]] = [
     TinyLlamaLoRA,
     Llama3_8B_LoRA,
     Gemma7B_LoRA,
+    Qwen2_7B_LoRA,
     Mistral7B_QLoRA,
     Mixtral8x7B_MoE_LoRA,
     Llava15LoRA,

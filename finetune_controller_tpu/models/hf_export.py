@@ -119,6 +119,15 @@ def export_merged_checkpoint(
     models only (the importer's inverse)."""
     if cfg.n_experts:
         raise NotImplementedError("merged export currently covers dense models")
+    # Gemma-specific semantics (norm offset, embed scaling, GeGLU) have no
+    # Llama-config encoding — refuse up front (before any file is written)
+    # rather than emitting a checkpoint transformers would evaluate
+    # differently.
+    if cfg.norm_offset or cfg.embed_scale or cfg.mlp_act != "silu":
+        raise NotImplementedError(
+            "merged export covers the Llama/Qwen-2 layouts; export the PEFT "
+            "adapter and merge against the original Gemma base instead"
+        )
     out_dir = Path(out_dir).expanduser()
     out_dir.mkdir(parents=True, exist_ok=True)
     params = variables["params"]
@@ -155,11 +164,21 @@ def export_merged_checkpoint(
                     b = np.asarray(ladder["lora_b"][i], np.float32)
                     kernel = kernel + scale * (a @ b)
                 tensors[f"{prefix}.{_HF_MODULE[proj]}.weight"] = kernel.T
+                if "bias" in leaves:  # Qwen-2 q/k/v biases (frozen, no LoRA)
+                    tensors[f"{prefix}.{_HF_MODULE[proj]}.bias"] = np.asarray(
+                        leaves["bias"][i], np.float32
+                    )
 
     _save_safetensors(out_dir / "model.safetensors", tensors)
+    # Qwen-2-family configs (q/k/v biases) export under the Qwen2
+    # architecture; everything else uses the Llama layout
+    if cfg.attention_qkv_bias:
+        arch, model_type = "Qwen2ForCausalLM", "qwen2"
+    else:
+        arch, model_type = "LlamaForCausalLM", "llama"
     hf_config = {
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
+        "architectures": [arch],
+        "model_type": model_type,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.d_model,
         "intermediate_size": cfg.d_ff,
@@ -170,7 +189,7 @@ def export_merged_checkpoint(
         "rope_theta": cfg.rope_theta,
         "max_position_embeddings": cfg.max_seq_len,
         "tie_word_embeddings": cfg.tie_embeddings,
-        "attention_bias": False,
+        "attention_bias": cfg.attention_qkv_bias,
         "mlp_bias": False,
         "torch_dtype": "float32",
     }

@@ -125,6 +125,10 @@ def load_llama_params(
                 "o_proj": {"kernel": proj(rest, "self_attn.o_proj.weight")},
             },
         }
+        if cfg.attention_qkv_bias:
+            # Qwen-2 family: q/k/v carry biases (o_proj does not)
+            for p in ("q_proj", "k_proj", "v_proj"):
+                tree["attn"][p]["bias"] = rest.pop(f"self_attn.{p}.bias")
         if cfg.n_experts:
             gate = []
             up = []

@@ -122,6 +122,9 @@ class LlamaConfig:
     norm_offset: float = 0.0
     #: multiply embedding output by sqrt(d_model) (Gemma input scaling)
     embed_scale: bool = False
+    #: bias terms on the q/k/v projections (Qwen-2 family; o_proj and the
+    #: MLP stay bias-free there, matching the HF architecture)
+    attention_qkv_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -196,6 +199,16 @@ PRESETS: dict[str, LlamaConfig] = {
         d_ff=128, max_seq_len=128, head_dim_override=32, mlp_act="gelu",
         norm_offset=1.0, embed_scale=True, tie_embeddings=True, rms_eps=1e-6,
     ),
+    # Qwen-2 family: Llama-shaped with q/k/v projection biases
+    "qwen2-7b": LlamaConfig(
+        vocab_size=152064, d_model=3584, n_layers=28, n_heads=28, n_kv_heads=4,
+        d_ff=18944, rope_theta=1_000_000.0, max_seq_len=8192, rms_eps=1e-6,
+        attention_qkv_bias=True, attention_impl="auto", remat_policy="mlp",
+    ),
+    "tiny-qwen-test": LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, rms_eps=1e-6, attention_qkv_bias=True,
+    ),
     "tiny-moe-test": LlamaConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=128, n_experts=4, moe_top_k=2,
@@ -241,12 +254,14 @@ class RMSNorm(nn.Module):
 
 def _proj(cfg: LlamaConfig, name: str, features: int) -> LoRADense:
     lora_on = cfg.lora.enabled_for(name)
+    qkv_bias = cfg.attention_qkv_bias and name in ("q_proj", "k_proj", "v_proj")
     return LoRADense(
         features=features,
         name=name,
         lora_rank=cfg.lora.rank if lora_on else 0,
         lora_alpha=cfg.lora.alpha,
         lora_dropout=cfg.lora.dropout,
+        use_bias=qkv_bias,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
         quantize_base=cfg.quantize_base,

@@ -163,3 +163,61 @@ def test_gemma_adapter_roundtrip_through_peft(tmp_path):
         {"params": params, "lora": lora}, jnp.asarray(tokens, jnp.int32)
     )
     np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
+
+
+def test_qwen2_merged_checkpoint_keeps_biases(tmp_path):
+    """Merged export for a Qwen-2-family model must carry the q/k/v biases
+    and declare the qwen2 architecture — silent bias loss would corrupt the
+    deployed model's logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM, Qwen2Config, Qwen2ForCausalLM
+
+    cfg = PRESETS["tiny-qwen-test"].replace(
+        dtype=jnp.float32, lora=LoRAConfig(rank=4)
+    )
+    torch.manual_seed(0)
+    hf_cfg = Qwen2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads, intermediate_size=cfg.d_ff,
+        rms_norm_eps=cfg.rms_eps, rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_seq_len, tie_word_embeddings=False,
+    )
+    base = Qwen2ForCausalLM(hf_cfg).eval()
+    ckpt = tmp_path / "qwen-base"
+    base.save_pretrained(str(ckpt), safe_serialization=True)
+
+    params = load_llama_params(ckpt, cfg, dtype=jnp.float32)
+    ours = LlamaForCausalLM(cfg)
+    init_vars = ours.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    lora = _random_lora(init_vars)
+
+    merged_dir = export_merged_checkpoint(
+        cfg, {"params": params, "lora": lora}, tmp_path / "qwen-merged"
+    )
+    reloaded = AutoModelForCausalLM.from_pretrained(str(merged_dir)).eval()
+    assert reloaded.config.model_type == "qwen2"
+
+    tokens = np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 16))
+    out = ours.apply(
+        {"params": params, "lora": lora}, jnp.asarray(tokens, jnp.int32)
+    )
+    with torch.no_grad():
+        ref = reloaded(torch.tensor(tokens)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
+
+
+def test_gemma_merged_export_refuses(tmp_path):
+    """Gemma semantics have no Llama-config encoding — merged export must
+    refuse loudly, not emit a checkpoint transformers evaluates differently."""
+    cfg = PRESETS["tiny-gemma-test"].replace(
+        dtype=jnp.float32, lora=LoRAConfig(rank=2)
+    )
+    ours = LlamaForCausalLM(cfg)
+    variables = ours.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    with pytest.raises(NotImplementedError, match="adapter"):
+        export_merged_checkpoint(cfg, variables, tmp_path / "nope")

@@ -185,3 +185,33 @@ def test_gemma_import_matches_transformers(tmp_path):
         ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
     out = ours.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
     np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
+
+
+def test_qwen2_import_matches_transformers(tmp_path):
+    """Qwen-2 family: Llama-shaped decoder with q/k/v projection biases —
+    verified numerically against transformers' Qwen2ForCausalLM."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = PRESETS["tiny-qwen-test"].replace(dtype=jnp.float32)
+    torch.manual_seed(0)
+    hf_cfg = Qwen2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads, intermediate_size=cfg.d_ff,
+        rms_norm_eps=cfg.rms_eps, rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_seq_len, tie_word_embeddings=False,
+    )
+    hf_model = Qwen2ForCausalLM(hf_cfg).eval()
+    ckpt = tmp_path / "hf-qwen"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+
+    params = load_llama_params(ckpt, cfg, dtype=jnp.float32)
+    # biases actually landed (all-zero biases would hide a dropped mapping)
+    assert "bias" in params["blocks"]["block"]["attn"]["q_proj"]
+    ours = LlamaForCausalLM(cfg)
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    out = ours.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
