@@ -203,7 +203,7 @@ async def amain(ns: argparse.Namespace) -> int:
         if ns.cmd == "dev-token":
             body = await client.post("/auth/dev-token",
                                      json={"user_id": ns.user_id})
-            print(body["token"])
+            print(body["access_token"])
             return 0
         raise SystemExit(f"unknown command {ns.cmd!r}")
 

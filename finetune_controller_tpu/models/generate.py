@@ -1,0 +1,81 @@
+"""Sampling utility: sanity-check a fine-tuned model by generating from it.
+
+This is a *verification* tool, not a serving path: each step re-runs the
+full forward over the sequence so far (no KV cache), which is O(n²) in
+generated length but exactly matches training numerics — the property that
+matters when the question is "did my fine-tune learn the task?". The
+reference has no equivalent surface at all (inference happens wherever the
+promoted artifacts are deployed); PEFT/merged exports (``hf_export.py``)
+remain the deployment path.
+
+Works with any of the text families (Llama/Gemma/Qwen/Mixtral) and the
+trainer's assembled variables::
+
+    toks = greedy_generate(model, variables, prompt, max_new_tokens=32)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _logits_fn(model: Any, variables: dict, tokens: jax.Array) -> jax.Array:
+    """Last-position logits (B, V); MoE models sow aux state we discard."""
+    n_experts = getattr(getattr(model, "cfg", None), "n_experts", 0)
+    if n_experts:
+        logits, _ = model.apply(variables, tokens, mutable=("moe_aux",))
+    else:
+        logits = model.apply(variables, tokens)
+    return logits[:, -1].astype(jnp.float32)
+
+
+def generate(
+    model: Any,
+    variables: dict,
+    prompt_tokens: jax.Array,      # (B, S) int32
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,      # 0 = greedy
+    top_k: int = 0,                # 0 = full distribution
+    eos_id: int | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressive sampling; returns (B, S + max_new_tokens) tokens.
+
+    Rows that emit ``eos_id`` keep emitting it (a poor man's stop mask), so
+    callers can trim on the first EOS per row.
+    """
+    tokens = jnp.asarray(prompt_tokens, jnp.int32)
+    if tokens.ndim != 2:
+        raise ValueError(f"prompt_tokens must be (B, S), got {tokens.shape}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    done = jnp.zeros((tokens.shape[0],), bool)
+
+    for _ in range(max_new_tokens):
+        logits = _logits_fn(model, variables, tokens)        # (B, V)
+        if temperature <= 0.0:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            scaled = logits / temperature
+            if top_k:
+                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        tokens = jnp.concatenate([tokens, nxt[:, None].astype(jnp.int32)], axis=1)
+    return tokens
+
+
+def greedy_generate(model, variables, prompt_tokens, *, max_new_tokens=32,
+                    eos_id=None):
+    return generate(
+        model, variables, prompt_tokens,
+        max_new_tokens=max_new_tokens, temperature=0.0, eos_id=eos_id,
+    )

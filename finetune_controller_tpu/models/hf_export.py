@@ -185,6 +185,9 @@ def export_merged_checkpoint(
         "num_hidden_layers": cfg.n_layers,
         "num_attention_heads": cfg.n_heads,
         "num_key_value_heads": cfg.n_kv_heads,
+        # explicit so a decoupled head_dim (head_dim_override) reconstructs
+        # the same attention shapes in transformers
+        "head_dim": cfg.head_dim,
         "rms_norm_eps": cfg.rms_eps,
         "rope_theta": cfg.rope_theta,
         "max_position_embeddings": cfg.max_seq_len,

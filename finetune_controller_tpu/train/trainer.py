@@ -570,9 +570,14 @@ class Trainer:
                 lambda x: np.asarray(jax.device_get(x)), dict(state.frozen)
             )
             variables = self._assemble(frozen_host, host["trainable"])
-            export_merged_checkpoint(
-                self.model_cfg, variables, f"{artifacts_dir}/merged"
-            )
+            try:
+                export_merged_checkpoint(
+                    self.model_cfg, variables, f"{artifacts_dir}/merged"
+                )
+            except NotImplementedError as exc:
+                # an unsupported merged layout (e.g. Gemma semantics) must not
+                # fail a completed training run — the adapter already shipped
+                logger.warning("export_merged skipped: %s", exc)
 
     def state_to_host(
         self,

@@ -238,3 +238,38 @@ def test_qwen_family_trains():
         if first is None:
             first = float(metrics["loss"])
     assert float(metrics["loss"]) < first * 0.8, (first, float(metrics["loss"]))
+
+
+def test_generate_learns_increment_task():
+    """End-to-end sanity loop: train tiny LoRA on the increment task, then
+    greedy-generate and check the model actually continues the sequence —
+    the verification surface a fine-tuning framework owes its users."""
+    from finetune_controller_tpu.data.synthetic import synthetic_batches
+    from finetune_controller_tpu.models.generate import generate, greedy_generate
+    from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=8))
+    tc = TrainConfig(
+        mode="lora", learning_rate=0.03, batch_size=16, seq_len=32,
+        total_steps=120, warmup_steps=5, log_every=10**9, checkpoint_every=10**9,
+    )
+    tr = Trainer(cfg, tc)
+    state = tr.init_state()
+    batches = synthetic_batches(16, 32, cfg.vocab_size, seed=0, task="increment")
+    for _ in range(120):
+        state, metrics = tr.step(state, next(batches))
+    assert float(metrics["accuracy"]) > 0.9, float(metrics["accuracy"])
+
+    variables = tr._assemble(state.frozen, state.trainable)
+    # increment task: tokens count upward mod vocab; continuation must too
+    prompt = jnp.asarray([[10, 11, 12, 13, 14, 15, 16, 17]], jnp.int32)
+    out = greedy_generate(tr.model, variables, prompt, max_new_tokens=6)
+    continuation = np.asarray(out[0, 8:])
+    np.testing.assert_array_equal(continuation, np.arange(18, 24))
+
+    # sampling path shapes + eos latching
+    out2 = generate(
+        tr.model, variables, prompt, max_new_tokens=4,
+        temperature=0.8, top_k=5, eos_id=19, rng=jax.random.PRNGKey(1),
+    )
+    assert out2.shape == (1, 12)
