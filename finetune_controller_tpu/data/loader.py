@@ -11,6 +11,9 @@ Supported formats:
   * ``.jsonl`` SFT rows — ``{"prompt": ..., "completion": ...}`` (text) or
     ``{"prompt_tokens": [...], "completion_tokens": [...]}`` — where the loss
     counts ONLY completion tokens (the mask rides through packing);
+  * ``.jsonl`` chat rows — ``{"messages": [{"role", "content"}, ...]}``
+    rendered with a fixed template; loss counts assistant content only
+    (see :func:`_render_chat`);
   * ``.npy`` — a flat int32 token stream.
 
 Packing: documents are concatenated into a flat stream with per-document
@@ -36,6 +39,42 @@ def _byte_tokenize(text: str) -> list[int]:
     return list(text.encode("utf-8"))
 
 
+def _render_chat(messages, encode_fragment, header_cache: dict) -> "Document":
+    """Render a chat row (``{"messages": [{"role", "content"}, ...]}``) with
+    a fixed, deterministic template::
+
+        <|role|>\\ncontent\\n
+
+    Loss counts ONLY assistant-message content (+ its terminating newline);
+    role headers and user/system turns are masked — every assistant turn in
+    a multi-turn conversation contributes. Custom chat templates belong in
+    preprocessing: render them to ``prompt``/``completion`` (or token) rows.
+
+    ``encode_fragment`` must NOT add special tokens — fragments are
+    concatenated, and a post-processor's per-call BOS/EOS would litter the
+    stream mid-sequence. ``header_cache`` memoizes the handful of role
+    headers across the whole file.
+    """
+    if not isinstance(messages, list) or not all(
+        isinstance(m, dict) for m in messages
+    ):
+        raise ValueError(
+            "'messages' must be a list of {'role', 'content'} objects"
+        )
+    toks: list[int] = []
+    flags: list[int] = []
+    for msg in messages:
+        role = str(msg.get("role", "user"))
+        header = header_cache.get(role)
+        if header is None:
+            header = header_cache[role] = encode_fragment(f"<|{role}|>\n")
+        body = encode_fragment(str(msg.get("content", "")) + "\n")
+        toks += header + body
+        flags += [0] * len(header)
+        flags += [1] * len(body) if role == "assistant" else [0] * len(body)
+    return toks, flags
+
+
 #: a document is (tokens, loss_flags) — flags mark the positions whose
 #: prediction counts (1 everywhere for plain LM rows, completion-only for SFT)
 Document = tuple[list[int], list[int]]
@@ -56,6 +95,14 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
             return tokenizer.encode(text).ids
         return _byte_tokenize(text)
 
+    def encode_fragment(text: str) -> list[int]:
+        # fragments get concatenated — a post-processor's BOS/EOS per call
+        # would land mid-sequence
+        if tokenizer is not None:
+            return tokenizer.encode(text, add_special_tokens=False).ids
+        return _byte_tokenize(text)
+
+    header_cache: dict[str, list[int]] = {}
     docs: list[Document] = []
     with open(path) as f:
         for line in f:
@@ -76,10 +123,14 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
             elif "prompt" in row and "completion" in row:
                 p, c = encode(row["prompt"]), encode(row["completion"])
                 docs.append((p + c, [0] * len(p) + [1] * len(c)))
+            elif "messages" in row:
+                docs.append(
+                    _render_chat(row["messages"], encode_fragment, header_cache)
+                )
             else:
                 raise ValueError(
-                    "jsonl rows must have 'tokens', 'text', or "
-                    "'prompt'/'completion' fields"
+                    "jsonl rows must have 'tokens', 'text', "
+                    "'prompt'/'completion', or 'messages' fields"
                 )
     if not docs:
         raise ValueError(f"no documents found in {path}")
@@ -210,9 +261,11 @@ def jsonl_token_batches(
 
 
 def _sniff_sft_jsonl(path: str, head_bytes: int = 1 << 16) -> bool:
-    """Whether the file's HEAD uses the SFT prompt/completion schema. Bounded
-    read so multi-GB plain-LM files don't pay a full extra Python pass before
-    the native packer; an SFT row hiding past the window is still handled —
-    the native packer rejects it and the caller falls back to Python."""
+    """Whether the file's HEAD uses a loss-masked schema (SFT
+    prompt/completion or chat messages). Bounded read so multi-GB plain-LM
+    files don't pay a full extra Python pass before the native packer; a
+    masked row hiding past the window is still handled — the native packer
+    rejects it and the caller falls back to Python."""
     with open(path, "rb") as f:
-        return b'"prompt' in f.read(head_bytes)
+        head = f.read(head_bytes)
+    return b'"prompt' in head or b'"messages"' in head

@@ -82,3 +82,89 @@ def test_sft_prompt_completion_masking(tmp_path):
     np.testing.assert_array_equal(b["loss_mask"], expect)
     # plain-LM rows in the same schema family still mask everything on
     assert b["segment_ids"].tolist() == [[1, 1, 1, 1, 2, 2, 2, 2, 2]]
+
+
+def test_chat_messages_rows_mask_assistant_only(tmp_path):
+    """{"messages": [...]} rows render with the fixed template; loss counts
+    ONLY assistant content (every assistant turn in a multi-turn chat), and
+    the mask rides through packing into batches."""
+    import json
+
+    import numpy as np
+
+    from finetune_controller_tpu.data.loader import (
+        jsonl_token_batches,
+        load_token_documents,
+    )
+
+    rows = [
+        {"messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "hello"},
+            {"role": "user", "content": "more"},
+            {"role": "assistant", "content": "ok"},
+        ]},
+    ]
+    path = tmp_path / "chat.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+
+    docs = load_token_documents(str(path))
+    toks, flags = docs[0]
+    assert len(toks) == len(flags)
+    # byte-level template: assistant bodies are "hello\n" and "ok\n"
+    assert sum(flags) == len(b"hello\n") + len(b"ok\n")
+    # the masked-in bytes are exactly the assistant content
+    masked = bytes(t for t, fl in zip(toks, flags) if fl)
+    assert masked == b"hello\nok\n"
+    # headers are masked out
+    unmasked = bytes(t for t, fl in zip(toks, flags) if not fl)
+    assert b"<|assistant|>" in unmasked and b"<|user|>" in unmasked
+
+    # and through the batch pipeline: loss_mask present and sparse
+    batches = jsonl_token_batches(str(path), batch_size=2, seq_len=32, seed=0)
+    batch = next(batches)
+    assert "loss_mask" in batch
+    assert 0 < np.sum(batch["loss_mask"]) < batch["loss_mask"].size
+
+
+def test_chat_messages_with_real_tokenizer_no_special_token_litter(tmp_path):
+    """Fragments must encode WITHOUT special tokens: a tokenizer whose
+    post-processor adds BOS per call must not litter BOS mid-sequence."""
+    import json
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.processors import TemplateProcessing
+
+    from finetune_controller_tpu.data.loader import load_token_documents
+
+    vocab = {"<s>": 0, "hi": 1, "hello": 2, "<|user|>": 3, "<|assistant|>": 4,
+             "[UNK]": 5}
+    tok = Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    tok.post_processor = TemplateProcessing(
+        single="<s> $A", special_tokens=[("<s>", 0)]
+    )
+    tok_file = tmp_path / "tok.json"
+    tok.save(str(tok_file))
+
+    path = tmp_path / "chat.jsonl"
+    path.write_text(json.dumps({"messages": [
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello"},
+    ]}) + "\n")
+    docs = load_token_documents(str(path), tokenizer_file=str(tok_file))
+    toks, flags = docs[0]
+    assert toks.count(0) == 0, toks  # no BOS anywhere in the fragments
+    # assistant body is exactly "hello"
+    assert [t for t, fl in zip(toks, flags) if fl] == [2]
+
+    # malformed messages fail with the loader's ValueError contract
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"messages": "hi"}) + "\n")
+    import pytest
+
+    with pytest.raises(ValueError, match="messages"):
+        load_token_documents(str(bad))
