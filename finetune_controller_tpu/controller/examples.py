@@ -51,6 +51,12 @@ class LoRASFTArguments(TrainingArguments):
     eval_steps: int = Field(
         8, ge=1, le=1024, description="Batches averaged per evaluation pass"
     )
+    grad_accum_steps: int = Field(
+        1, ge=1, le=1024,
+        description="Microbatches accumulated per optimizer step (batch_size "
+                    "must divide by it) — for batches whose activations "
+                    "exceed HBM",
+    )
 
 
 class TinyLlamaLoRA(BaseFineTuneJob):

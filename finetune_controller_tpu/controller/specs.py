@@ -181,7 +181,7 @@ class BaseFineTuneJob(BaseModel):
             "learning_rate", "warmup_steps", "total_steps", "schedule",
             "weight_decay", "clip_norm", "batch_size", "seq_len", "seed",
             "log_every", "checkpoint_every", "profile_steps", "export_merged",
-            "eval_every", "eval_steps", "frozen_dtype",
+            "eval_every", "eval_steps", "frozen_dtype", "grad_accum_steps",
         ):
             if key in args:
                 training[key] = args.pop(key)

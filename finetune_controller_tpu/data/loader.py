@@ -103,6 +103,8 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
         return _byte_tokenize(text)
 
     header_cache: dict[str, list[int]] = {}
+    saw_chat = False
+    chat_flagged = 0
     docs: list[Document] = []
     with open(path) as f:
         for line in f:
@@ -124,9 +126,10 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
                 p, c = encode(row["prompt"]), encode(row["completion"])
                 docs.append((p + c, [0] * len(p) + [1] * len(c)))
             elif "messages" in row:
-                docs.append(
-                    _render_chat(row["messages"], encode_fragment, header_cache)
-                )
+                doc = _render_chat(row["messages"], encode_fragment, header_cache)
+                saw_chat = True
+                chat_flagged += sum(doc[1])
+                docs.append(doc)
             else:
                 raise ValueError(
                     "jsonl rows must have 'tokens', 'text', "
@@ -134,6 +137,15 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
                 )
     if not docs:
         raise ValueError(f"no documents found in {path}")
+    if saw_chat and chat_flagged == 0:
+        # an all-masked chat corpus would train on NOTHING and still report
+        # success — the classic wrong-role-name footgun ({"role": "model"})
+        raise ValueError(
+            f"chat rows in {path} produced no assistant-content tokens: "
+            "the loss mask is empty. The template counts loss only for "
+            "messages with role == 'assistant' — rename roles (or render "
+            "custom templates to prompt/completion rows in preprocessing)"
+        )
     return docs
 
 

@@ -87,6 +87,12 @@ class TrainConfig:
     eval_every: int = 0
     #: batches averaged per evaluation pass
     eval_steps: int = 8
+    #: split each optimizer step's global batch into N sequential
+    #: microbatches (``lax.scan`` inside the jitted step), accumulating
+    #: gradients — the standard dial for batch sizes whose activations don't
+    #: fit HBM. batch_size must divide by it; numerics match the unsplit
+    #: step up to float reduction order (tested).
+    grad_accum_steps: int = 1
 
 
 class PreemptionGuard:
@@ -204,6 +210,19 @@ class Trainer:
                 )
             if model_cfg.lora.rank > 0 and model_cfg.lora.dropout > 0:
                 raise ValueError("pp > 1 does not support LoRA dropout yet")
+        if train_cfg.grad_accum_steps > 1:
+            if train_cfg.batch_size % train_cfg.grad_accum_steps:
+                raise ValueError(
+                    f"batch_size {train_cfg.batch_size} not divisible by "
+                    f"grad_accum_steps {train_cfg.grad_accum_steps}"
+                )
+            batch_shards = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
+            micro = train_cfg.batch_size // train_cfg.grad_accum_steps
+            if micro % batch_shards:
+                raise ValueError(
+                    f"microbatch size {micro} (batch_size/grad_accum_steps) "
+                    f"not divisible over the {batch_shards}-way batch sharding"
+                )
         self.tx, self.sched = build_optimizer(
             learning_rate=train_cfg.learning_rate,
             warmup_steps=train_cfg.warmup_steps,
@@ -395,7 +414,57 @@ class Trainer:
     def _train_step(self, state: TrainState, batch: dict):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), state.step)
         grad_fn = jax.value_and_grad(self._loss_fn, has_aux=True)
-        (_, aux), grads = grad_fn(state.trainable, state.frozen, batch, dropout_rng)
+        accum = self.cfg.grad_accum_steps
+        if accum > 1:
+            # microbatch scan: rows stay sharded over the batch axes within
+            # each microbatch; the accum axis is sequential. Grads/metrics
+            # are averaged over microbatches — identical semantics to the
+            # unsplit step (each microbatch's loss is already a per-token
+            # mean, so equality is exact only for uniform token counts; SFT
+            # masks make it the standard per-microbatch-mean approximation).
+            def split(x):
+                r = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                # the scan (accum) axis must stay UNSHARDED — it is
+                # sequential; rows keep their batch-axis sharding within
+                # each microbatch
+                spec = self._batch_leaf_sharding(x).spec
+                return jax.lax.with_sharding_constraint(
+                    r, NamedSharding(self.mesh, P(None, *spec))
+                )
+
+            micro = jax.tree.map(split, batch)
+
+            def body(carry, mb):
+                rng = jax.random.fold_in(dropout_rng, carry["i"])
+                (_, aux), grads = grad_fn(state.trainable, state.frozen, mb, rng)
+                acc = jax.tree.map(jnp.add, carry["grads"], grads)
+                auxes = jax.tree.map(jnp.add, carry["aux"], aux)
+                return {"grads": acc, "aux": auxes, "i": carry["i"] + 1}, None
+
+            zero_grads = jax.tree.map(jnp.zeros_like, state.trainable)
+            aux_shape = jax.eval_shape(
+                lambda: grad_fn(
+                    state.trainable, state.frozen,
+                    jax.tree.map(lambda x: x[0], micro), dropout_rng,
+                )[0][1]
+            )
+            zero_aux = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), aux_shape
+            )
+            carry, _ = jax.lax.scan(
+                body,
+                {"grads": zero_grads, "aux": zero_aux, "i": jnp.zeros((), jnp.int32)},
+                micro,
+            )
+            inv = 1.0 / accum
+            grads = jax.tree.map(lambda g: g * inv, carry["grads"])
+            # means average over microbatches; counts keep their exact sum
+            aux = {
+                k: (v if k == "target_tokens" else v * inv)
+                for k, v in carry["aux"].items()
+            }
+        else:
+            (_, aux), grads = grad_fn(state.trainable, state.frozen, batch, dropout_rng)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.trainable)
         trainable = optax.apply_updates(state.trainable, updates)
         metrics = {
@@ -448,13 +517,25 @@ class Trainer:
         sums: dict[str, float] = {}
         n = 0
         for _ in range(max(1, self.cfg.eval_steps)):
-            batch = self._shard_batch(next(eval_batches))
-            fn = self._get_eval_jit(batch)
-            with self.mesh, ring_mesh(self.mesh):
-                metrics = fn(state, batch)
-            for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
-            n += 1
+            host_batch = next(eval_batches)
+            # grad accumulation exists because the full batch's activations
+            # don't fit HBM — eval must microbatch the same way or it OOMs
+            # at the first eval step of exactly those configs
+            accum = self.cfg.grad_accum_steps
+            rows = next(iter(host_batch.values())).shape[0]
+            chunks = accum if accum > 1 and rows % accum == 0 else 1
+            for c in range(chunks):
+                piece = {
+                    k: v[c * (rows // chunks):(c + 1) * (rows // chunks)]
+                    for k, v in host_batch.items()
+                }
+                batch = self._shard_batch(piece)
+                fn = self._get_eval_jit(batch)
+                with self.mesh, ring_mesh(self.mesh):
+                    metrics = fn(state, batch)
+                for k, v in metrics.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                n += 1
         # target_tokens is a per-batch count — averaging it is meaningless,
         # and only declared columns survive the CSV header
         return {

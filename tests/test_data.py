@@ -168,3 +168,21 @@ def test_chat_messages_with_real_tokenizer_no_special_token_litter(tmp_path):
 
     with pytest.raises(ValueError, match="messages"):
         load_token_documents(str(bad))
+
+
+def test_chat_rows_without_assistant_role_fail_loudly(tmp_path):
+    """An all-masked chat corpus (wrong role name) must error, not silently
+    train on nothing."""
+    import json
+
+    import pytest
+
+    from finetune_controller_tpu.data.loader import load_token_documents
+
+    path = tmp_path / "model_role.jsonl"
+    path.write_text(json.dumps({"messages": [
+        {"role": "user", "content": "hi"},
+        {"role": "model", "content": "hello"},  # Gemini-style role name
+    ]}) + "\n")
+    with pytest.raises(ValueError, match="assistant"):
+        load_token_documents(str(path))

@@ -120,3 +120,56 @@ def test_metrics_writer_resume_gains_columns(tmp_path):
     rows = list(csv.DictReader(open(tmp_path / "metrics.csv")))
     assert rows[0]["loss"] == "2.0" and rows[0]["eval_loss"] == ""
     assert rows[1]["eval_loss"] == "1.8" and rows[1]["eval_accuracy"] == "0.4"
+
+
+def test_grad_accumulation_matches_unsplit_step(devices8):
+    """grad_accum_steps=N on a sharded mesh produces (near-)identical
+    parameter updates to the unsplit step on the same global batch, and the
+    invalid configurations fail loudly at construction."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from finetune_controller_tpu.models import PRESETS, LoRAConfig
+    from finetune_controller_tpu.parallel.mesh import MeshSpec
+    from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = PRESETS["tiny-test"].replace(
+        lora=LoRAConfig(rank=4), dtype=jnp.float32
+    )
+    mesh = MeshSpec(dp=2, fsdp=2, tp=2).build(devices8)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32),
+        "loss_mask": np.ones((8, 32), np.float32),
+    }
+
+    def one_step(accum):
+        tc = TrainConfig(
+            mode="lora", batch_size=8, seq_len=32, total_steps=1,
+            learning_rate=0.01, warmup_steps=0, clip_norm=0.0,
+            log_every=10**9, checkpoint_every=10**9, grad_accum_steps=accum,
+        )
+        tr = Trainer(cfg, tc, mesh=mesh)
+        state = tr.init_state()
+        state, metrics = tr.step(state, dict(batch))
+        host = jax.tree.map(lambda x: np.asarray(x), state.trainable)
+        return host, {k: float(v) for k, v in metrics.items()}
+
+    t1, m1 = one_step(1)
+    t4, m4 = one_step(2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), t1, t4
+    )
+    assert abs(m1["loss"] - m4["loss"]) < 1e-4
+    assert m1["target_tokens"] == m4["target_tokens"] == 8 * 31
+
+    with pytest.raises(ValueError, match="not divisible by"):
+        Trainer(cfg, TrainConfig(mode="lora", batch_size=8, grad_accum_steps=3),
+                mesh=mesh)
+    with pytest.raises(ValueError, match="batch sharding"):
+        Trainer(cfg, TrainConfig(mode="lora", batch_size=8, grad_accum_steps=8),
+                mesh=mesh)
