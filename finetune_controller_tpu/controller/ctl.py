@@ -14,6 +14,7 @@ Commands:
     status JOB_ID [--watch]             one job (``--watch`` polls to final)
     logs JOB_ID [--follow]              job logs (REST; --follow re-polls)
     metrics JOB_ID                      metrics rows (latest last)
+    artifacts JOB_ID [-o out.zip]       artifact inventory (or zip download)
     promote JOB_ID / unpromote JOB_ID
     cancel JOB_ID
     dev-token [USER_ID]                 mint a dev token (local envs only)
@@ -71,6 +72,17 @@ class Client:
 
     async def post(self, path: str, **kw) -> Any:
         return await self.request("POST", path, **kw)
+
+    async def download(self, path: str, dest: str) -> None:
+        """Stream a GET response body to ``dest`` (same URL/auth/error
+        semantics as :meth:`request`)."""
+        url = f"{self.base}/api/v1{path}"
+        async with self._session.get(url) as r:
+            if r.status >= 400:
+                raise ApiError(f"GET {path} -> {r.status}: {await r.text()}")
+            with open(dest, "wb") as f:
+                async for chunk in r.content.iter_chunked(1 << 16):
+                    f.write(chunk)
 
 
 def _parse_args_kv(pairs: list[str]) -> dict[str, Any]:
@@ -182,6 +194,17 @@ async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:
     return 0
 
 
+async def cmd_artifacts(client: Client, ns: argparse.Namespace) -> int:
+    if ns.output:
+        await client.download(f"/jobs/{ns.job_id}/artifacts", ns.output)
+        print(f"wrote {ns.output}", file=sys.stderr)
+        return 0
+    body = await client.get(f"/jobs/{ns.job_id}/artifacts", params={"list": "1"})
+    for a in body.get("artifacts", []):
+        print(f"{a['size']:>12}  {a['path']}")
+    return 0
+
+
 async def amain(ns: argparse.Namespace) -> int:
     async with Client(ns.api, ns.token) as client:
         if ns.cmd == "models":
@@ -197,6 +220,8 @@ async def amain(ns: argparse.Namespace) -> int:
             return await cmd_logs(client, ns)
         if ns.cmd == "metrics":
             return await cmd_metrics(client, ns)
+        if ns.cmd == "artifacts":
+            return await cmd_artifacts(client, ns)
         if ns.cmd in ("promote", "unpromote", "cancel"):
             _print_json(await client.post(f"/jobs/{ns.job_id}/{ns.cmd}"))
             return 0
@@ -226,13 +251,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--watch", action="store_true")
     s = sub.add_parser("jobs")
     s.add_argument("--page", type=int, default=1)
-    for name in ("status", "logs", "metrics", "promote", "unpromote", "cancel"):
+    for name in ("status", "logs", "metrics", "artifacts", "promote",
+                 "unpromote", "cancel"):
         s = sub.add_parser(name)
         s.add_argument("job_id")
         if name == "status":
             s.add_argument("--watch", action="store_true")
         if name == "logs":
             s.add_argument("--follow", action="store_true")
+        if name == "artifacts":
+            s.add_argument("--output", "-o",
+                           help="download the artifact zip to this path "
+                                "(default: list the inventory)")
     s = sub.add_parser("dev-token")
     s.add_argument("user_id", nargs="?", default="dev")
     return p

@@ -103,8 +103,6 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
         return _byte_tokenize(text)
 
     header_cache: dict[str, list[int]] = {}
-    saw_chat = False
-    chat_flagged = 0
     docs: list[Document] = []
     with open(path) as f:
         for line in f:
@@ -127,8 +125,15 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
                 docs.append((p + c, [0] * len(p) + [1] * len(c)))
             elif "messages" in row:
                 doc = _render_chat(row["messages"], encode_fragment, header_cache)
-                saw_chat = True
-                chat_flagged += sum(doc[1])
+                if not any(doc[1]):
+                    # an all-masked chat doc trains on NOTHING — the classic
+                    # wrong-role footgun ({"role": "model"}), caught per row
+                    # so a mixed corpus can't hide it
+                    raise ValueError(
+                        "chat row produced no assistant-content tokens (the "
+                        "loss mask is empty): the template counts loss only "
+                        f"for role == 'assistant'. Row: {line[:120]}"
+                    )
                 docs.append(doc)
             else:
                 raise ValueError(
@@ -137,15 +142,6 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
                 )
     if not docs:
         raise ValueError(f"no documents found in {path}")
-    if saw_chat and chat_flagged == 0:
-        # an all-masked chat corpus would train on NOTHING and still report
-        # success — the classic wrong-role-name footgun ({"role": "model"})
-        raise ValueError(
-            f"chat rows in {path} produced no assistant-content tokens: "
-            "the loss mask is empty. The template counts loss only for "
-            "messages with role == 'assistant' — rename roles (or render "
-            "custom templates to prompt/completion rows in preprocessing)"
-        )
     return docs
 
 

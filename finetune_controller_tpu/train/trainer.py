@@ -233,6 +233,7 @@ class Trainer:
         )
         self._state_shardings = None
         self._init_jit = None
+        self._warned_eval_unsplit = False
         self._build()
 
     # ---- construction ----------------------------------------------------
@@ -524,6 +525,16 @@ class Trainer:
             accum = self.cfg.grad_accum_steps
             rows = next(iter(host_batch.values())).shape[0]
             chunks = accum if accum > 1 and rows % accum == 0 else 1
+            if accum > 1 and chunks == 1 and not self._warned_eval_unsplit:
+                # per-host rows not divisible: the unsplit eval forward may
+                # not fit HBM on exactly the configs accumulation targets
+                self._warned_eval_unsplit = True
+                logger.warning(
+                    "eval batch rows (%d per host) not divisible by "
+                    "grad_accum_steps (%d): evaluating UNSPLIT — if this "
+                    "OOMs, make batch_size/process_count divisible by "
+                    "grad_accum_steps", rows, accum,
+                )
             for c in range(chunks):
                 piece = {
                     k: v[c * (rows // chunks):(c + 1) * (rows // chunks)]

@@ -48,6 +48,19 @@ def test_ctl_submit_watch_metrics_logs(tmp_path, capsys):
                 ["--api", api, "logs", job_id])) == 0
             assert "finished" in capsys.readouterr().out
 
+            # artifacts: inventory listing + zip download
+            assert await ctl.amain(ctl.build_parser().parse_args(
+                ["--api", api, "artifacts", job_id])) == 0
+            inv = capsys.readouterr().out
+            assert "metrics.csv" in inv and "done.txt" in inv
+            zip_path = tmp_path / "artifacts.zip"
+            assert await ctl.amain(ctl.build_parser().parse_args(
+                ["--api", api, "artifacts", job_id, "-o", str(zip_path)])) == 0
+            import zipfile
+
+            with zipfile.ZipFile(zip_path) as zf:
+                assert any("metrics" in n for n in zf.namelist())
+
             # unknown job -> ApiError (main() maps it to exit 1)
             import pytest
 
