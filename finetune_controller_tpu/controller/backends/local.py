@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import hashlib
 import json
 import logging
 import os
@@ -191,10 +192,27 @@ class LocalProcessBackend(TrainingBackend):
 
     # ------------------------------------------------------- warm worker pool
 
-    @staticmethod
-    def _env_key(env: dict[str, str]) -> tuple:
-        """Workers are only interchangeable within one platform config."""
-        return (env.get("JAX_PLATFORMS", ""), env.get("XLA_FLAGS", ""))
+    def _env_key(self, env: dict[str, str]) -> tuple:
+        """Workers are only interchangeable within one runtime environment.
+
+        Keyed on the platform vars + PYTHONPATH + a digest of the
+        controller's ``extra_env`` overlay: a worker prewarmed before
+        ``extra_env`` changed must not be claimed by a job that expects the
+        new values — it inherited its env at spawn time and cannot be
+        re-pointed.  Deliberately NOT a digest of the full ``os.environ``
+        snapshot: unrelated env mutations (libraries setdefault-ing vars)
+        would orphan every pooled worker under a key nothing ever claims.
+        """
+        extra = hashlib.sha256(
+            "\x00".join(f"{k}={v}" for k, v in sorted(self.extra_env.items()))
+            .encode()
+        ).hexdigest()
+        return (
+            env.get("JAX_PLATFORMS", ""),
+            env.get("XLA_FLAGS", ""),
+            env.get("PYTHONPATH", ""),
+            extra,
+        )
 
     async def _spawn_warm(self, env: dict[str, str]) -> None:
         if self._closing or self.warm_workers <= 0:

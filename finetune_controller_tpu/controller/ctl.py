@@ -173,19 +173,25 @@ async def cmd_status(client: Client, ns: argparse.Namespace) -> int:
 
 
 async def cmd_logs(client: Client, ns: argparse.Namespace) -> int:
-    seen = 0
-    while True:
+    async def fetch_new(seen: int) -> int:
         body = await client.get(f"/jobs/{ns.job_id}/logs")
         lines = body.get("lines", []) if isinstance(body, dict) else body.splitlines()
         for line in lines[seen:]:
             print(line)
-        seen = len(lines)
-        if not ns.follow:
-            return 0
+        return len(lines)
+
+    seen = await fetch_new(0)
+    if not ns.follow:
+        return 0
+    while True:
         job = await client.get(f"/jobs/{ns.job_id}")
         if job["status"] in FINAL_STATES:
+            # the job reached a final state after our last fetch: drain the
+            # tail once more so lines written in between aren't dropped
+            await fetch_new(seen)
             return 0
         await asyncio.sleep(2.0)
+        seen = await fetch_new(seen)
 
 
 async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:

@@ -99,8 +99,14 @@ def order_devices_for_dcn(devices: Sequence, sizes: dict[str, int]) -> list:
     collectives — but bandwidth-bound.  Single-slice and CPU/test devices
     (no ``slice_index``) come back unchanged.
     """
-    slice_of = [getattr(d, "slice_index", None) for d in devices]
-    distinct = {s for s in slice_of if s is not None}
+    # None slice_index (e.g. a CPU device mixed in) becomes its own -1
+    # "slice": it must neither raise a None-vs-int TypeError in the sort nor
+    # be excluded from the per-slice tiling arithmetic below.
+    slice_of = [
+        s if (s := getattr(d, "slice_index", None)) is not None else -1
+        for d in devices
+    ]
+    distinct = set(slice_of)
     if len(distinct) <= 1:
         return list(devices)
     ordered = [

@@ -53,13 +53,17 @@ class MetricsWriter:
                     with open(self.path, newline="") as f:
                         old_rows = list(csv.DictReader(f))
                     self._resume_fields = self._resume_fields + missing
-                    with open(self.path, "w", newline="") as f:
+                    # Atomic swap: a crash mid-rewrite must not lose the
+                    # run's whole metrics history.
+                    tmp_path = self.path + ".tmp"
+                    with open(tmp_path, "w", newline="") as f:
                         rewriter = csv.DictWriter(f, fieldnames=self._resume_fields)
                         rewriter.writeheader()
                         for old in old_rows:
                             rewriter.writerow(
                                 {k: old.get(k, "") for k in self._resume_fields}
                             )
+                    os.replace(tmp_path, self.path)
                 # Preemption-resume: keep prior rows, reuse the existing header.
                 self._file = open(self.path, "a", newline="")
                 self._writer = csv.DictWriter(self._file, fieldnames=self._resume_fields)

@@ -888,13 +888,17 @@ class Trainer:
         finally:
             if profiling:
                 jax.profiler.stop_trace()
+            # Must be read before the inner except handler runs: inside an
+            # except block sys.exc_info() reports the just-caught exception,
+            # which would make a wait() failure always look "propagating".
+            propagating = sys.exc_info()[1] is not None
             try:
                 # durability barrier: an async checkpoint save must commit
                 # before the process exits (especially the preemption path —
                 # the point of the save-on-SIGTERM is surviving the kill)
                 ckpt.wait()
             except Exception:
-                if sys.exc_info()[1] is not None:
+                if propagating:
                     # an exception (e.g. the preemption SystemExit 143) is
                     # already propagating: log the save failure rather than
                     # masking the original exit semantics
