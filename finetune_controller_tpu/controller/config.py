@@ -50,6 +50,10 @@ class Settings(BaseModel):
 
     # --- State store (reference: Mongo URL/creds, app/core/config.py:44-49) ---
     state_dir: str = "~/.finetune_controller_tpu/state"
+    #: "sqlite" (WAL database — safe for the deployed API+monitor two-process
+    #: layout, like the reference's shared MongoDB) | "jsonl" (single-process
+    #: append-only log)
+    state_backend: str = "sqlite"
 
     # --- Object store (reference: S3 buckets, app/core/config.py:53-58) ---
     #: "local" (filesystem root, hermetic CI) | "gcs" (cloud buckets)

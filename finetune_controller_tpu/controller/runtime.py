@@ -64,7 +64,7 @@ def build_runtime(
     """Assemble a runtime from settings (reference startup flow §3.5, made lazy)."""
     settings = settings or get_settings()
     load_model_modules(plugin_dir)
-    state = StateStore(settings.state_path)
+    state = StateStore(settings.state_path, backend=settings.state_backend)
     store = build_object_store(settings)
     catalog = load_catalog(settings.device_config_file or None)
     backend: TrainingBackend

@@ -4,11 +4,25 @@ Capability parity with the reference's ``MongoDBManager`` (``app/database/db.py`
 710 LoC — SURVEY.md §2 component 7): jobs / metrics / datasets / archived_jobs
 collections, indexed lookups, paginated job queries with server-side computed
 fields, metadata merge on status updates, archive-on-delete. The engine is an
-in-repo async document store (JSON-file persistence + in-memory indexes) instead
-of an external MongoDB server — the reference's Mongo is an external C++ process
-(SURVEY.md §2.2), so "external document store" is the delegation seam we replace
-with an embedded one. The public API is transport-agnostic, so a Mongo-backed
-implementation can be swapped in behind the same interface.
+embedded document store instead of an external MongoDB server — the reference's
+Mongo is an external C++ process (SURVEY.md §2.2), so "external document store"
+is the delegation seam we replace with an embedded one. The public API is
+transport-agnostic, so a Mongo-backed implementation can be swapped in behind
+the same interface.
+
+Two engines behind one interface:
+
+- ``sqlite`` (default when a state dir is given) — one WAL-mode SQLite file,
+  a table per collection, every read served from the database and every
+  read-modify-write inside a ``BEGIN IMMEDIATE`` transaction.  This is the
+  **multi-process-safe** engine: the deployed layout runs the API server and
+  the monitor as separate processes against one state dir, exactly like the
+  reference's two deployments share one MongoDB (``app/database/db.py:51``,
+  ``Dockerfile.monitor:30``), so job state written by the monitor must be
+  immediately visible to — and never clobbered by — the API process.
+- ``jsonl`` — append-only JSONL log + in-memory indexes.  Single-process
+  only (no cross-process locking or reload); kept for inspectability and as
+  the in-memory engine's persistence format.
 
 Fixes a reference wart on the way: the monitor's N+1 per-job DB reads
 (``app/core/monitor.py:151-158``) are avoided by :meth:`StateStore.get_jobs_by_ids`.
@@ -18,6 +32,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
+import sqlite3
+import threading
 import time
 import uuid
 from pathlib import Path
@@ -223,23 +240,267 @@ class Collection:
         return len(await self.find(predicate))
 
 
+class _SqliteDB:
+    """One shared WAL-mode SQLite database holding every collection's table.
+
+    All statements run on worker threads (via ``asyncio.to_thread``) under a
+    process-local mutex — SQLite's cross-PROCESS coordination is the WAL +
+    busy-timeout machinery; the mutex only serializes this process's threads
+    over the single connection.
+    """
+
+    def __init__(self, path: Path):
+        self._path = path
+        self._lock = threading.Lock()
+        self._conn: sqlite3.Connection | None = None
+        self._pid: int | None = None
+
+    def run(self, fn: Callable[[sqlite3.Connection], Any]) -> Any:
+        with self._lock:
+            if self._conn is None or self._pid != os.getpid():
+                # (re)connect lazily; a forked child must not reuse the
+                # parent's connection (sqlite documents this as corruption)
+                self._path.parent.mkdir(parents=True, exist_ok=True)
+                conn = sqlite3.connect(
+                    self._path, timeout=30.0, check_same_thread=False,
+                    isolation_level=None,  # autocommit; RMW uses BEGIN IMMEDIATE
+                )
+                conn.execute("PRAGMA journal_mode=WAL")
+                conn.execute("PRAGMA synchronous=NORMAL")
+                conn.execute("PRAGMA busy_timeout=30000")
+                self._conn, self._pid = conn, os.getpid()
+            return fn(self._conn)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None and self._pid == os.getpid():
+                self._conn.close()
+            self._conn = None
+
+
+class SqliteCollection:
+    """``Collection``-compatible engine over a shared :class:`_SqliteDB`.
+
+    Every read goes to the database (no in-memory cache to go stale under a
+    concurrent writer process) and every read-modify-write runs inside a
+    ``BEGIN IMMEDIATE`` transaction, so two processes interleaving
+    ``update``/``update_if``/``merge_subdoc`` cannot lose each other's writes.
+    """
+
+    def __init__(self, db: _SqliteDB, name: str, key: str,
+                 index_fields: tuple[str, ...] = ()):
+        self._db = db
+        self._name = name
+        self._key = key
+        self._index_fields = index_fields
+        self._ready = False
+
+    def _ensure(self, conn: sqlite3.Connection) -> None:
+        if self._ready:
+            return
+        conn.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._name}" '
+            "(key TEXT PRIMARY KEY, doc TEXT NOT NULL)"
+        )
+        for f in self._index_fields:
+            # expression index = the Mongo secondary index of the jsonl engine
+            conn.execute(
+                f'CREATE INDEX IF NOT EXISTS "idx_{self._name}_{f}" '
+                f"ON \"{self._name}\" (json_extract(doc, '$.{f}'))"
+            )
+        self._ready = True
+
+    async def insert(self, doc: dict[str, Any]) -> None:
+        doc = dict(doc)
+
+        def op(conn: sqlite3.Connection) -> None:
+            self._ensure(conn)
+            conn.execute(
+                f'INSERT INTO "{self._name}" (key, doc) VALUES (?, ?) '
+                "ON CONFLICT(key) DO UPDATE SET doc = excluded.doc",
+                (doc[self._key], json.dumps(doc)),
+            )
+
+        await asyncio.to_thread(self._db.run, op)
+
+    async def get(self, key: str) -> dict[str, Any] | None:
+        def op(conn: sqlite3.Connection) -> dict[str, Any] | None:
+            self._ensure(conn)
+            row = conn.execute(
+                f'SELECT doc FROM "{self._name}" WHERE key = ?', (key,)
+            ).fetchone()
+            return json.loads(row[0]) if row else None
+
+        return await asyncio.to_thread(self._db.run, op)
+
+    def _rmw(
+        self,
+        key: str,
+        mutate: Callable[[dict[str, Any]], dict[str, Any] | None],
+    ) -> bool:
+        """Transactional read-modify-write; ``mutate`` returns the new doc or
+        ``None`` to abort (predicate failed)."""
+
+        def op(conn: sqlite3.Connection) -> bool:
+            self._ensure(conn)
+            conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = conn.execute(
+                    f'SELECT doc FROM "{self._name}" WHERE key = ?', (key,)
+                ).fetchone()
+                if row is None:
+                    conn.execute("ROLLBACK")
+                    return False
+                new = mutate(json.loads(row[0]))
+                if new is None:
+                    conn.execute("ROLLBACK")
+                    return False
+                conn.execute(
+                    f'UPDATE "{self._name}" SET doc = ? WHERE key = ?',
+                    (json.dumps(new), key),
+                )
+                conn.execute("COMMIT")
+                return True
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+
+        return self._db.run(op)
+
+    async def update(self, key: str, fields: dict[str, Any]) -> bool:
+        return await asyncio.to_thread(
+            self._rmw, key, lambda doc: {**doc, **fields}
+        )
+
+    async def update_if(
+        self,
+        key: str,
+        fields: dict[str, Any],
+        predicate: Callable[[dict[str, Any]], bool],
+    ) -> bool:
+        return await asyncio.to_thread(
+            self._rmw, key,
+            lambda doc: {**doc, **fields} if predicate(doc) else None,
+        )
+
+    async def merge_subdoc(self, key: str, field: str, patch: dict[str, Any]) -> bool:
+        def mutate(doc: dict[str, Any]) -> dict[str, Any]:
+            sub = dict(doc.get(field) or {})
+            sub.update(patch)
+            return {**doc, field: sub}
+
+        return await asyncio.to_thread(self._rmw, key, mutate)
+
+    async def delete(self, key: str) -> dict[str, Any] | None:
+        def op(conn: sqlite3.Connection) -> dict[str, Any] | None:
+            self._ensure(conn)
+            conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = conn.execute(
+                    f'SELECT doc FROM "{self._name}" WHERE key = ?', (key,)
+                ).fetchone()
+                if row is None:
+                    conn.execute("ROLLBACK")
+                    return None
+                conn.execute(
+                    f'DELETE FROM "{self._name}" WHERE key = ?', (key,)
+                )
+                conn.execute("COMMIT")
+                return json.loads(row[0])
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+
+        return await asyncio.to_thread(self._db.run, op)
+
+    async def find(
+        self,
+        predicate: Callable[[dict[str, Any]], bool] | None = None,
+        *,
+        eq: dict[str, Any] | None = None,
+    ) -> list[dict[str, Any]]:
+        def op(conn: sqlite3.Connection) -> list[dict[str, Any]]:
+            self._ensure(conn)
+            if eq:
+                clauses, params = [], []
+                for f, v in eq.items():
+                    if f not in self._index_fields:
+                        raise KeyError(
+                            f"field {f!r} is not indexed on this collection"
+                        )
+                    # IS (not =) so eq-on-None matches missing/null fields,
+                    # mirroring the jsonl engine's dict.get semantics
+                    clauses.append(f"json_extract(doc, '$.{f}') IS ?")
+                    params.append(v)
+                rows = conn.execute(
+                    f'SELECT doc FROM "{self._name}" '
+                    f"WHERE {' AND '.join(clauses)} ORDER BY key",
+                    params,
+                ).fetchall()
+            else:
+                rows = conn.execute(
+                    f'SELECT doc FROM "{self._name}" ORDER BY rowid'
+                ).fetchall()
+            return [json.loads(r[0]) for r in rows]
+
+        docs = await asyncio.to_thread(self._db.run, op)
+        if predicate is not None:
+            docs = [d for d in docs if predicate(d)]
+        return docs
+
+    async def count(
+        self, predicate: Callable[[dict[str, Any]], bool] | None = None
+    ) -> int:
+        return len(await self.find(predicate))
+
+
 class StateStore:
     """Domain-level store over four collections (reference: ``MongoDBManager``).
 
     ``state_dir=None`` keeps everything in memory (the unit-test seam the
-    reference never had).
+    reference never had).  With a state dir, ``backend`` picks the engine:
+    ``"sqlite"`` (default; multi-process-safe WAL database) or ``"jsonl"``
+    (single-process append-only log).  Existing jsonl state is migrated into
+    the database on :meth:`connect`, so a round-2 state dir upgrades in place.
     """
 
-    def __init__(self, state_dir: Path | str | None = None):
+    _COLLECTIONS: tuple[tuple[str, str, tuple[str, ...]], ...] = (
+        ("jobs", "job_id", ("user_id", "status")),
+        ("archived_jobs", "job_id", ()),
+        ("metrics", "job_id", ()),
+        ("datasets", "dataset_id", ("user_id",)),
+    )
+
+    def __init__(
+        self,
+        state_dir: Path | str | None = None,
+        backend: str | None = None,
+    ):
         self._dir = Path(state_dir).expanduser() if state_dir is not None else None
+        if backend is None:
+            backend = os.environ.get("FTC_STATE_BACKEND", "sqlite")
+        if backend not in ("sqlite", "jsonl"):
+            # a typo'd value silently running the single-process jsonl engine
+            # under the two-process deployment would be exactly the
+            # lost-update corruption the sqlite engine exists to prevent
+            raise ValueError(
+                f"unknown state backend {backend!r}: expected 'sqlite' or 'jsonl'"
+            )
+        self._backend = backend if self._dir is not None else "memory"
+        self._db: _SqliteDB | None = None
 
-        def path(name: str) -> Path | None:
-            return None if self._dir is None else self._dir / f"{name}.jsonl"
+        if self._dir is not None and self._backend == "sqlite":
+            self._db = _SqliteDB(self._dir / "state.db")
 
-        self.jobs = Collection(path("jobs"), "job_id", index_fields=("user_id", "status"))
-        self.archived_jobs = Collection(path("archived_jobs"), "job_id")
-        self.metrics = Collection(path("metrics"), "job_id")
-        self.datasets = Collection(path("datasets"), "dataset_id", index_fields=("user_id",))
+            def make(name: str, key: str, idx: tuple[str, ...]):
+                return SqliteCollection(self._db, name, key, idx)
+        else:
+            def make(name: str, key: str, idx: tuple[str, ...]):
+                path = None if self._dir is None else self._dir / f"{name}.jsonl"
+                return Collection(path, key, index_fields=idx)
+
+        for name, key, idx in self._COLLECTIONS:
+            setattr(self, name, make(name, key, idx))
         self._connected = False
 
     # -- lifecycle (reference: connect/_ensure_indexes, db.py:33-105) --------
@@ -247,9 +508,58 @@ class StateStore:
     async def connect(self) -> None:
         if self._dir is not None:
             self._dir.mkdir(parents=True, exist_ok=True)
+            if self._backend == "sqlite":
+                await self._migrate_jsonl()
         self._connected = True
 
+    async def _migrate_jsonl(self) -> None:
+        """One-way import of legacy jsonl logs into the sqlite engine.
+
+        The emptiness check and the import run inside ONE ``BEGIN IMMEDIATE``
+        transaction per collection: two processes starting concurrently must
+        not both see "empty" and have the late importer resurrect stale
+        legacy docs over the early one's fresh writes.  After the import the
+        legacy log is renamed to ``*.jsonl.migrated`` — once sqlite owns the
+        dir the jsonl is never authoritative again, so a later restart with a
+        legitimately-empty table (all jobs archived) must not re-import
+        deleted documents from it.
+        """
+        for name, key, idx in self._COLLECTIONS:
+            legacy = self._dir / f"{name}.jsonl"
+            coll = getattr(self, name)
+            if not legacy.exists():
+                continue
+            old = Collection(legacy, key, index_fields=idx)
+            docs = await old.find()
+
+            def op(conn: sqlite3.Connection, coll=coll, docs=docs) -> None:
+                coll._ensure(conn)
+                conn.execute("BEGIN IMMEDIATE")
+                try:
+                    n = conn.execute(
+                        f'SELECT COUNT(*) FROM "{coll._name}"'
+                    ).fetchone()[0]
+                    if n == 0:
+                        for doc in docs:
+                            conn.execute(
+                                f'INSERT INTO "{coll._name}" (key, doc) '
+                                "VALUES (?, ?)",
+                                (doc[coll._key], json.dumps(doc)),
+                            )
+                    conn.execute("COMMIT")
+                except BaseException:
+                    conn.execute("ROLLBACK")
+                    raise
+
+            await asyncio.to_thread(self._db.run, op)
+            try:
+                legacy.rename(legacy.with_suffix(".jsonl.migrated"))
+            except OSError:
+                pass  # a concurrent starter renamed it first — fine
+
     async def close(self) -> None:
+        if self._db is not None:
+            await asyncio.to_thread(self._db.close)
         self._connected = False
 
     # -- jobs (reference: db.py:107-379) -------------------------------------

@@ -25,60 +25,85 @@ from finetune_controller_tpu.controller.devices import load_catalog
 
 
 def controller_deployments(namespace: str, image: str) -> list[dict]:
-    """API + monitor Deployments (reference: scripts/cluster_install.sh
-    deploys both processes; SURVEY.md §1)."""
+    """ONE Deployment running the API and monitor as two containers in the
+    same pod, sharing the state volume.
 
-    def deployment(name: str, command: list[str], port: int | None) -> dict:
-        container = {
-            "name": name,
-            "image": image,
-            "command": command,
-            "env": [
-                {"name": "FTC_BACKEND", "value": "k8s"},
-                {"name": "FTC_OBJECT_STORE_BACKEND", "value": "gcs"},
-                {"name": "FTC_NAMESPACE", "value": namespace},
-            ],
-        }
-        if port is not None:
-            container["ports"] = [{"containerPort": port}]
-        return {
-            "apiVersion": "apps/v1",
-            "kind": "Deployment",
-            "metadata": {"name": name, "namespace": namespace},
-            "spec": {
-                "replicas": 1,
-                "selector": {"matchLabels": {"app": name}},
-                "template": {
-                    "metadata": {"labels": {"app": name}},
-                    "spec": {
-                        "serviceAccountName": "finetune-controller",
-                        "containers": [container],
-                    },
+    The reference deploys the two processes as separate Deployments sharing
+    an external MongoDB (``scripts/cluster_install.sh``; SURVEY.md §1); the
+    rebuild's store is an embedded WAL-mode SQLite file, which is
+    multi-process-safe only on one host — so the layout co-locates the two
+    processes in one pod (same node, shared volume) rather than pretending
+    two Deployments could land anywhere and still share the file.
+    """
+    state_mount = {"name": "state", "mountPath": "/state"}
+    shared_env = [
+        {"name": "FTC_BACKEND", "value": "k8s"},
+        {"name": "FTC_OBJECT_STORE_BACKEND", "value": "gcs"},
+        {"name": "FTC_NAMESPACE", "value": namespace},
+        {"name": "FTC_STATE_DIR", "value": "/state"},
+        {"name": "FTC_STATE_BACKEND", "value": "sqlite"},
+    ]
+    api = {
+        "name": "api",
+        "image": image,
+        "command": ["python", "-m", "finetune_controller_tpu.controller.server",
+                    "--host", "0.0.0.0", "--port", "8787"],
+        "env": shared_env,
+        "ports": [{"containerPort": 8787}],
+        "volumeMounts": [state_mount],
+    }
+    monitor = {
+        "name": "monitor",
+        "image": image,
+        "command": ["python", "-m",
+                    "finetune_controller_tpu.controller.monitor_main"],
+        "env": shared_env,
+        "volumeMounts": [state_mount],
+    }
+    pvc = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "finetune-controller-state", "namespace": namespace},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": "10Gi"}},
+        },
+    }
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "finetune-controller", "namespace": namespace},
+        "spec": {
+            # single writer-pod by construction: the embedded store is shared
+            # within the pod, not across replicas
+            "replicas": 1,
+            "strategy": {"type": "Recreate"},  # two pods must never share the PVC
+            "selector": {"matchLabels": {"app": "finetune-controller"}},
+            "template": {
+                "metadata": {"labels": {"app": "finetune-controller"}},
+                "spec": {
+                    "serviceAccountName": "finetune-controller",
+                    "containers": [api, monitor],
+                    "volumes": [{
+                        "name": "state",
+                        "persistentVolumeClaim": {
+                            "claimName": "finetune-controller-state"
+                        },
+                    }],
                 },
             },
-        }
-
-    api = deployment(
-        "finetune-controller-api",
-        ["python", "-m", "finetune_controller_tpu.controller.server",
-         "--host", "0.0.0.0", "--port", "8787"],
-        8787,
-    )
-    monitor = deployment(
-        "finetune-controller-monitor",
-        ["python", "-m", "finetune_controller_tpu.controller.monitor_main"],
-        None,
-    )
+        },
+    }
     service = {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {"name": "finetune-controller-api", "namespace": namespace},
         "spec": {
-            "selector": {"app": "finetune-controller-api"},
+            "selector": {"app": "finetune-controller"},
             "ports": [{"port": 80, "targetPort": 8787}],
         },
     }
-    return [api, monitor, service]
+    return [pvc, deployment, service]
 
 
 def main() -> int:

@@ -309,7 +309,7 @@ def test_object_store_rejects_path_escape(tmp_path):
 
 
 def test_statestore_log_compaction(tmp_path):
-    store = StateStore(tmp_path / "state")
+    store = StateStore(tmp_path / "state", backend="jsonl")
 
     async def go():
         await store.connect()
@@ -323,7 +323,7 @@ def test_statestore_log_compaction(tmp_path):
         lines = (tmp_path / "state" / "jobs.jsonl").read_text().splitlines()
         assert len(lines) < 600
         # reload still correct
-        store2 = StateStore(tmp_path / "state")
+        store2 = StateStore(tmp_path / "state", backend="jsonl")
         await store2.connect()
         assert (await store2.get_job("j0")).queue_position == 1099
 
