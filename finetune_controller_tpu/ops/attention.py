@@ -60,6 +60,31 @@ def xla_causal_attention(
     return out.reshape(b, s, h, d)
 
 
+def single_token_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    idx: jax.Array,
+) -> jax.Array:
+    """One decode step against a static-length KV cache.
+
+    q: (B, 1, H, D); caches (B, M, Hkv, D); ``idx`` is the scalar position of
+    the query token — cache slots > idx are masked out.  Same f32-softmax and
+    1/sqrt(D) conventions as :func:`xla_causal_attention`, so a cached decode
+    matches the uncached oracle bit-for-bit up to dtype rounding.
+    """
+    b, s, h, d = q.shape
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    qh = (q * d ** -0.5).reshape(b, s, hkv, g, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qh, k_cache).astype(jnp.float32)
+    valid = jnp.arange(k_cache.shape[1])[None, None, None, None, :] <= idx
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache)
+    return out.reshape(b, s, h, d)
+
+
 def causal_attention(
     q: jax.Array,
     k: jax.Array,
