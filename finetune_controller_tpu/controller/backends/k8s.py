@@ -682,34 +682,108 @@ class AiohttpKubeClient(KubeClient):
             )
         return self._session
 
-    async def create(self, api_path: str, body: dict[str, Any]) -> dict[str, Any]:
+    #: transient apiserver statuses worth retrying (rate limit + 5xx); 401
+    #: additionally forces a token re-read (a rotated SA token mid-flight)
+    RETRY_STATUSES = frozenset({429, 500, 502, 503, 504})
+    MAX_TRIES = 4
+    BASE_DELAY_S = 0.25
+
+    async def _request(
+        self,
+        method: str,
+        url: str,
+        *,
+        params: dict[str, Any] | None = None,
+        json_body: dict[str, Any] | None = None,
+    ) -> tuple[int, Any]:
+        """One apiserver call with bounded retry/backoff.
+
+        Retries 429 (honoring ``Retry-After``), 5xx, and transport errors
+        with exponential backoff; a 401 re-reads the projected SA token once
+        per attempt (kubelet rotates it on disk).  Terminal statuses (2xx,
+        404, 409, 403...) return ``(status, parsed-body)`` for the caller to
+        interpret.  The reference leaned on the official SDKs for this
+        (``app/utils/kube_config.py:22-23``); the hand-rolled client must
+        carry its own retry discipline.
+        """
+        import aiohttp
+
         s = self._get_session()
-        async with s.post(f"{self.base_url}{api_path}", json=body, headers=self._headers()) as resp:
-            if resp.status >= 300:
-                raise BackendError(f"create failed ({resp.status}): {await resp.text()}")
-            return await resp.json()
+        delay = self.BASE_DELAY_S
+        last_err: Exception | None = None
+        for attempt in range(self.MAX_TRIES):
+            try:
+                async with s.request(
+                    method, url, params=params, json=json_body,
+                    headers=self._headers(),
+                ) as resp:
+                    retriable = resp.status in self.RETRY_STATUSES or (
+                        resp.status == 401 and self._static_token is None
+                    )
+                    if not retriable or attempt == self.MAX_TRIES - 1:
+                        ctype = resp.content_type or ""
+                        body = (
+                            await resp.json() if "json" in ctype
+                            else await resp.text()
+                        )
+                        return resp.status, body
+                    if resp.status == 401:
+                        self._token_read_at = 0.0  # force token re-read
+                    retry_after = resp.headers.get("Retry-After")
+                    if retry_after:
+                        try:
+                            delay = max(delay, float(retry_after))
+                        except ValueError:
+                            pass
+                    last_err = BackendError(
+                        f"{method} {url} -> {resp.status} (attempt {attempt + 1})"
+                    )
+            except aiohttp.ClientError as e:
+                if attempt == self.MAX_TRIES - 1:
+                    raise BackendError(f"{method} {url} failed: {e}") from e
+                last_err = e
+            await asyncio.sleep(delay)
+            delay *= 2
+        raise BackendError(f"{method} {url} failed after retries: {last_err}")
+
+    async def create(self, api_path: str, body: dict[str, Any]) -> dict[str, Any]:
+        url = f"{self.base_url}{api_path}"
+        status, payload = await self._request("POST", url, json_body=body)
+        if status == 409:
+            # AlreadyExists — idempotent create: a resubmit after a crashed
+            # ack must not fail the job; adopt the live object instead
+            name = body.get("metadata", {}).get("name", "")
+            existing = await self.get(api_path, name) if name else None
+            if existing is not None:
+                return existing
+        if status >= 300:
+            raise BackendError(f"create failed ({status}): {payload}")
+        return payload
 
     async def get(self, api_path: str, name: str) -> dict[str, Any] | None:
-        s = self._get_session()
-        async with s.get(f"{self.base_url}{api_path}/{name}", headers=self._headers()) as resp:
-            if resp.status == 404:
-                return None
-            if resp.status >= 300:
-                raise BackendError(f"get failed ({resp.status})")
-            return await resp.json()
+        status, payload = await self._request(
+            "GET", f"{self.base_url}{api_path}/{name}"
+        )
+        if status == 404:
+            return None
+        if status >= 300:
+            raise BackendError(f"get failed ({status}): {payload}")
+        return payload
 
     async def list(self, api_path: str, label_selector: str = "") -> list[dict[str, Any]]:
-        s = self._get_session()
-        params = {"labelSelector": label_selector} if label_selector else {}
-        async with s.get(f"{self.base_url}{api_path}", params=params, headers=self._headers()) as resp:
-            if resp.status >= 300:
-                raise BackendError(f"list failed ({resp.status})")
-            return (await resp.json()).get("items", [])
+        params = {"labelSelector": label_selector} if label_selector else None
+        status, payload = await self._request(
+            "GET", f"{self.base_url}{api_path}", params=params
+        )
+        if status >= 300:
+            raise BackendError(f"list failed ({status}): {payload}")
+        return payload.get("items", [])
 
     async def delete(self, api_path: str, name: str) -> bool:
-        s = self._get_session()
-        async with s.delete(f"{self.base_url}{api_path}/{name}", headers=self._headers()) as resp:
-            return resp.status < 300
+        status, _ = await self._request(
+            "DELETE", f"{self.base_url}{api_path}/{name}"
+        )
+        return status < 300
 
     async def pod_log_lines(
         self, namespace: str, pod: str, *, container: str, follow: bool,
