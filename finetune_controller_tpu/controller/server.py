@@ -336,6 +336,15 @@ async def start_job(request: web.Request) -> web.Response:
     user = _user(request)
     fields, ds = await _read_submission(request)
 
+    # unknown fields are rejected, not ignored: a typo'd "training_arguments"
+    # silently training 100 default steps is far costlier than a 400
+    known = {"model_name", "model", "arguments", "task", "device", "num_slices"}
+    unknown = sorted(set(fields) - known)
+    if unknown:
+        return _json_error(
+            400, f"unknown submission fields {unknown}; accepted: {sorted(known)}"
+        )
+
     model_name = fields.get("model_name") or fields.get("model")
     if not model_name:
         return _json_error(400, "model_name is required")

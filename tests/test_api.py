@@ -195,6 +195,14 @@ def test_submit_validation_errors(tmp_path):
         bad = dict(SUBMIT_BODY, task="classification")
         r = await client.post("/api/v1/jobs", json=bad)
         assert r.status == 400
+
+        # unknown top-level field rejected, not silently defaulted — a typo'd
+        # "training_arguments" must not train 100 default steps
+        bad = {"model_name": "tiny-test-lora",
+               "training_arguments": SUBMIT_BODY["arguments"]}
+        r = await client.post("/api/v1/jobs", json=bad)
+        assert r.status == 400
+        assert "training_arguments" in (await r.json())["detail"]
         await client.close()
 
     run_async(main())
