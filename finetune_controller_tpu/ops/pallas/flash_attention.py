@@ -84,11 +84,13 @@ def _fwd_kernel(
     seq_len: int,
     scale: float,
     use_segments: bool,
+    exp_dtype: str = "float32",
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
+    edt = jnp.dtype(exp_dtype)
 
     @pl.when(ik == 0)
     def _init():
@@ -114,12 +116,20 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # zero p under the mask explicitly: for a fully-masked row m_new is
         # still NEG_INF and exp(s - m_new) would be exp(0) = 1 per lane,
-        # accumulating l = block count instead of 0
-        p = jnp.exp(s - m_new)                                # (bq, bk)
+        # accumulating l = block count instead of 0.
+        # exp_dtype="bfloat16" computes the S²-elementwise exp — the VPU-bound
+        # hot loop at small head dims — in bf16 after the f32 max-subtract
+        # (safe: arguments are <= 0, so the bf16 range is never stressed;
+        # precision is ~3 decimal digits on a probability-like quantity).
+        # f32 stays the default until the chip A/B proves a win.
+        diff = s - m_new
+        p = jnp.exp(diff if edt == jnp.float32 else diff.astype(edt))
         if mask is not None:
-            p = jnp.where(mask, p, 0.0)
+            p = jnp.where(mask, p, jnp.zeros((), p.dtype))
         alpha = jnp.exp(m_prev - m_new)
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(
+            p, axis=-1, keepdims=True, dtype=jnp.float32
+        )
         # p rounds to the value dtype for the MXU (the FlashAttention-2
         # recipe); accumulation stays f32 in VMEM scratch
         v = v_ref[0, 0]
@@ -190,6 +200,7 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
     use_segments: bool = True,
+    exp_dtype: str = "float32",
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (out (B, S, H, D), lse (B, H, S_pad, 1) f32)."""
     b, s, h, d = q.shape
@@ -215,7 +226,7 @@ def _flash_forward(
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, seq_len=s, scale=scale,
-                          use_segments=use_segments),
+                          use_segments=use_segments, exp_dtype=exp_dtype),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -266,11 +277,13 @@ def _bwd_dq_kernel(
     seq_len: int,
     scale: float,
     use_segments: bool,
+    exp_dtype: str = "float32",
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
+    edt = jnp.dtype(exp_dtype)
 
     @pl.when(ik == 0)
     def _init():
@@ -293,9 +306,10 @@ def _bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        p = jnp.exp(s - lse)                                   # (bq, bk) f32
+        diff = s - lse
+        p = jnp.exp(diff if edt == jnp.float32 else diff.astype(edt))
         if mask is not None:
-            p = jnp.where(mask, p, 0.0)
+            p = jnp.where(mask, p, jnp.zeros((), p.dtype))
 
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -341,12 +355,14 @@ def _bwd_dkv_kernel(
     seq_len: int,
     scale: float,
     use_segments: bool,
+    exp_dtype: str = "float32",
 ):
     ik, j = pl.program_id(2), pl.program_id(3)
     n_inner = pl.num_programs(3)   # = group * n_q_blocks
     iq = j % n_q_blocks            # q block within the current group member
     bk = k_ref.shape[2]
     bq = q_ref.shape[2]
+    edt = jnp.dtype(exp_dtype)
 
     @pl.when(j == 0)
     def _init():
@@ -372,9 +388,10 @@ def _bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                              # (bq, bk)
-        p = jnp.exp(s - lse)
+        diff = s - lse
+        p = jnp.exp(diff if edt == jnp.float32 else diff.astype(edt))
         if mask is not None:
-            p = jnp.where(mask, p, 0.0)
+            p = jnp.where(mask, p, jnp.zeros((), p.dtype))
 
         # dV += pᵀ · dO
         dv_acc[...] += jax.lax.dot_general(
@@ -412,6 +429,7 @@ def _bwd_dkv_kernel(
 def _flash_backward(
     q, k, v, segment_ids, out, lse, g,
     *, block_q: int, block_k: int, interpret: bool, use_segments: bool = True,
+    exp_dtype: str = "float32",
 ):
     b, s, h, d = q.shape
     hkv = k.shape[2]
@@ -445,7 +463,7 @@ def _flash_backward(
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, seq_len=s, scale=scale,
-                          use_segments=use_segments),
+                          use_segments=use_segments, exp_dtype=exp_dtype),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -472,7 +490,7 @@ def _flash_backward(
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, n_q_blocks=nq, seq_len=s, scale=scale,
-            use_segments=use_segments,
+            use_segments=use_segments, exp_dtype=exp_dtype,
         ),
         grid=(b, hkv, nk, group * nq),
         in_specs=[
@@ -526,20 +544,22 @@ def _flash_backward(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention(q, k, v, segment_ids, block_q, block_k, interpret, use_segments):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, segment_ids, block_q, block_k, interpret,
+                     use_segments, exp_dtype):
     out, _ = _flash_forward(
         q, k, v, segment_ids,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        use_segments=use_segments,
+        use_segments=use_segments, exp_dtype=exp_dtype,
     )
     return out
 
 
-def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret, use_segments):
+def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret,
+               use_segments, exp_dtype):
     out, lse = _flash_forward(
         q, k, v, segment_ids, block_q=block_q, block_k=block_k,
-        interpret=interpret, use_segments=use_segments,
+        interpret=interpret, use_segments=use_segments, exp_dtype=exp_dtype,
     )
     # Named so a remat policy (models/llama.py remat_policy_fn, e.g.
     # "mlp_flash") can SAVE these residuals: under plain per-layer remat the
@@ -553,12 +573,13 @@ def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret, use_segments):
     return out, (q, k, v, segment_ids, res_out, res_lse)
 
 
-def _flash_bwd(block_q, block_k, interpret, use_segments, residuals, g):
+def _flash_bwd(block_q, block_k, interpret, use_segments, exp_dtype,
+               residuals, g):
     q, k, v, segment_ids, out, lse = residuals
     dq, dk, dv = _flash_backward(
         q, k, v, segment_ids, out, lse, g,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        use_segments=use_segments,
+        use_segments=use_segments, exp_dtype=exp_dtype,
     )
     return dq, dk, dv, None
 
@@ -575,6 +596,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool | None = None,
+    exp_dtype: str = "float32",
 ) -> jax.Array:
     """Causal GQA flash attention. Shapes as ``ops.attention.causal_attention``.
 
@@ -591,5 +613,5 @@ def flash_attention(
         segment_ids = jnp.zeros((b, s), jnp.int32)
     return _flash_attention(
         q, k, v, segment_ids.astype(jnp.int32), block_q, block_k, interpret,
-        use_segments,
+        use_segments, exp_dtype,
     )

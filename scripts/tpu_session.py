@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""One-shot TPU measurement session — run when the axon tunnel is up.
+
+Runs, in order, everything round 3 owes the chip (VERDICT r2 next-round
+items 1, 3, 5 + the pending compiled-segments parity check), recording every
+result to a JSONL log so a mid-session tunnel drop loses nothing:
+
+1. compiled-with-segments Pallas parity (fwd + grads vs XLA, real TPU — the
+   CPU CI only exercises interpreter mode);
+2. headline bench (TinyLlama bs8 seq2048) — target MFU >= 0.406;
+3. long-context kernel A/B: exp dtype {f32, bf16} x block {512, 1024} on the
+   seq-8192 flash grad microbench;
+4. long-context bench: TinyLlama seq8192 with the A/B winner, and
+   Mistral-7B QLoRA seq8192 (head-dim-128 shapes);
+5. Gemma-7B + Qwen2-7B QLoRA measurements (first batch size that fits HBM).
+
+Usage:  python scripts/tpu_session.py [--log tpu_session.jsonl] [--only STEP]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def log_result(log_path: Path, record: dict) -> None:
+    record = {"ts": round(time.time(), 1), **record}
+    with log_path.open("a") as f:
+        f.write(json.dumps(record) + "\n")
+    print("LOGGED:", json.dumps(record), flush=True)
+
+
+def run_bench(env_overrides: dict[str, str], timeout: float = 1500.0) -> dict:
+    """Run bench.py with overrides; return its JSON line (or error record)."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env.setdefault("BENCH_NO_CPU_FALLBACK", "1")  # this session IS the probe
+    try:
+        out = subprocess.run(
+            [sys.executable, str(REPO / "bench.py")],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "timeout", "env": env_overrides}
+    if out.returncode != 0:
+        tail = "\n".join(out.stderr.strip().splitlines()[-8:])
+        oom = "Exceeded hbm capacity" in out.stderr or "RESOURCE_EXHAUSTED" in out.stderr
+        return {"error": "oom" if oom else "failed", "env": env_overrides,
+                "stderr_tail": tail}
+    try:
+        return {"env": env_overrides,
+                **json.loads(out.stdout.strip().splitlines()[-1])}
+    except (json.JSONDecodeError, IndexError):
+        return {"error": "no-json", "env": env_overrides,
+                "stdout_tail": out.stdout[-500:]}
+
+
+# ---------------------------------------------------------------------------
+# step 1: compiled-with-segments parity on real TPU
+# ---------------------------------------------------------------------------
+
+PARITY_SNIPPET = r"""
+import jax, numpy as np
+import jax.numpy as jnp
+from finetune_controller_tpu.ops.pallas.flash_attention import flash_attention
+from finetune_controller_tpu.ops.attention import xla_causal_attention
+
+assert jax.devices()[0].platform == "tpu", jax.devices()
+rng = np.random.default_rng(0)
+b, s, h, hkv, d = 2, 2048, 8, 4, 64
+q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
+# packed-document segments: monotone ids with ragged boundaries + padded tail
+seg = np.zeros((b, s), np.int32)
+for row in range(b):
+    bounds = sorted(rng.choice(np.arange(64, s - 64), 5, replace=False))
+    for i, lo in enumerate(bounds):
+        seg[row, lo:] = i + 1
+seg[:, -37:] = 99  # padding segment
+seg = jnp.asarray(seg)
+
+ref = xla_causal_attention(q, k, v, segment_ids=seg)
+out = jax.jit(
+    lambda q, k, v: flash_attention(q, k, v, segment_ids=seg, interpret=False)
+)(q, k, v)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+
+def loss_flash(q, k, v):
+    o = flash_attention(q, k, v, segment_ids=seg, interpret=False)
+    return jnp.sum(o.astype(jnp.float32) ** 2)
+
+def loss_ref(q, k, v):
+    return jnp.sum(xla_causal_attention(q, k, v, segment_ids=seg).astype(jnp.float32) ** 2)
+
+gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+gerr = max(
+    float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+    for a, b in zip(gf, gr)
+)
+import json
+print(json.dumps({"fwd_max_err": err, "grad_max_err": gerr,
+                  "ok": bool(err < 3e-2 and gerr < 2.0)}))
+"""
+
+
+def _run_snippet(log_path: Path, step: str, snippet: str, timeout: float) -> dict | None:
+    """Run a measurement snippet in a TPU subprocess; log-and-continue on any
+    failure (timeout, crash, or chatty/non-JSON stdout) so one bad step never
+    kills the rest of the session."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", snippet],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "tpu"}, cwd=str(REPO),
+        )
+    except subprocess.TimeoutExpired:
+        log_result(log_path, {"step": step, "error": "timeout"})
+        return None
+    if out.returncode != 0:
+        log_result(log_path, {"step": step, "error": "failed",
+                              "stderr_tail": out.stderr[-1000:]})
+        return None
+    for line in reversed(out.stdout.strip().splitlines() or [""]):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    log_result(log_path, {"step": step, "error": "no-json",
+                          "stdout_tail": out.stdout[-500:]})
+    return None
+
+
+def step_parity(log_path: Path) -> None:
+    rec = _run_snippet(log_path, "segment_parity_tpu", PARITY_SNIPPET, 900)
+    if rec is not None:
+        log_result(log_path, {"step": "segment_parity_tpu", **rec})
+
+
+# ---------------------------------------------------------------------------
+# step 3: long-context kernel A/B (exp dtype x block size)
+# ---------------------------------------------------------------------------
+
+KERNEL_AB_SNIPPET = r"""
+import functools, time, json
+import jax, numpy as np
+import jax.numpy as jnp
+from finetune_controller_tpu.ops.pallas.flash_attention import flash_attention
+
+assert jax.devices()[0].platform == "tpu"
+rng = np.random.default_rng(0)
+b, s, h, hkv, d = 2, 8192, 32, 4, 64   # TinyLlama long-context shape
+q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
+results = {}
+for edt in ("float32", "bfloat16"):
+    for blk in (512, 1024):
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, block_q=blk, block_k=blk,
+                                interpret=False, exp_dtype=edt)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        r = g(q, k, v); jax.block_until_ready(r)   # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(8):
+            r = g(q, k, v)
+        jax.block_until_ready(r)
+        results[f"{edt}-b{blk}"] = round((time.perf_counter() - t0) / 8 * 1e3, 2)
+print(json.dumps(results))
+"""
+
+
+def step_kernel_ab(log_path: Path) -> None:
+    rec = _run_snippet(log_path, "kernel_ab_seq8192", KERNEL_AB_SNIPPET, 1200)
+    if rec is not None:
+        log_result(log_path, {"step": "kernel_ab_seq8192",
+                              "grad_ms_per_call": rec})
+
+
+# ---------------------------------------------------------------------------
+# bench steps
+# ---------------------------------------------------------------------------
+
+
+def step_headline(log_path: Path) -> None:
+    rec = run_bench({})
+    log_result(log_path, {"step": "headline_tinyllama_seq2048", **rec})
+
+
+def step_longctx(log_path: Path, winner_env: dict[str, str]) -> None:
+    rec = run_bench({"BENCH_SEQ": "8192", "BENCH_BATCH": "2", **winner_env})
+    log_result(log_path, {"step": "longctx_tinyllama_seq8192", **rec})
+    # head-dim-128 long-context shapes (VERDICT r2 #3): Mistral-7B QLoRA
+    for batch in ("2", "1"):
+        rec = run_bench({
+            "BENCH_MODE": "qlora", "BENCH_SEQ": "8192", "BENCH_BATCH": batch,
+            "BENCH_LOGITS_DTYPE": "bfloat16", **winner_env,
+        })
+        log_result(log_path, {"step": f"longctx_mistral7b_seq8192_bs{batch}", **rec})
+        if "error" not in rec:
+            break
+
+
+def step_new_families(log_path: Path) -> None:
+    for preset, batches in (("gemma-7b", ("4", "2", "1")),
+                            ("qwen2-7b", ("4", "2", "1"))):
+        for batch in batches:
+            rec = run_bench({
+                "BENCH_MODE": "qlora", "BENCH_PRESET": preset,
+                "BENCH_BATCH": batch, "BENCH_LOGITS_DTYPE": "bfloat16",
+            })
+            log_result(log_path, {"step": f"qlora_{preset}_bs{batch}", **rec})
+            if "error" not in rec:
+                break
+
+
+def winner_from_log(log_path: Path) -> dict[str, str]:
+    """Latest kernel_ab verdict recorded in the session log, as env vars."""
+    best: dict[str, str] = {}
+    if not log_path.exists():
+        return best
+    for line in log_path.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        times = rec.get("grad_ms_per_call")
+        if rec.get("step") == "kernel_ab_seq8192" and times:
+            fastest = min(times, key=times.get)
+            edt, blk = fastest.rsplit("-b", 1)
+            best = {"FTC_FLASH_EXP_DTYPE": edt,
+                    "FTC_FLASH_BLOCK_Q": blk,
+                    "FTC_FLASH_BLOCK_K": blk}
+    return best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log", default=str(REPO / "tpu_session.jsonl"))
+    ap.add_argument("--only", default="",
+                    help="parity|headline|kernel_ab|longctx|families")
+    args = ap.parse_args()
+    log_path = Path(args.log)
+
+    steps = args.only.split(",") if args.only else [
+        "parity", "headline", "kernel_ab", "longctx", "families"
+    ]
+    for step in steps:
+        print(f"=== step: {step} ===", flush=True)
+        if step == "parity":
+            step_parity(log_path)
+        elif step == "headline":
+            step_headline(log_path)
+        elif step == "kernel_ab":
+            step_kernel_ab(log_path)
+        elif step == "longctx":
+            # winner comes from the log, so a --only longctx resume after a
+            # tunnel drop still applies the recorded kernel_ab verdict
+            winner_env = winner_from_log(log_path)
+            if winner_env:
+                print("kernel A/B winner env:", winner_env, flush=True)
+            step_longctx(log_path, winner_env)
+        elif step == "families":
+            step_new_families(log_path)
+        else:
+            print(f"unknown step {step!r}", file=sys.stderr)
+            return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
