@@ -964,20 +964,106 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
 
 def main(argv: list[str] | None = None) -> int:
     """``python -m finetune_controller_tpu.controller.server --port 8787``
-    (reference: ``uvicorn app.main:app``, ``Dockerfile:28``)."""
-    import argparse
+    (reference: ``uvicorn app.main:app``, ``Dockerfile:28``).
 
+    ``--workers N`` serves from N processes sharing the port via
+    ``SO_REUSEPORT`` — the reference's ``uvicorn --workers 4``.  Requires the
+    k8s backend (stateless against the apiserver; job/dataset state shared
+    through the sqlite WAL store, which is multi-process-safe on one host).
+    The local fake-cluster backend holds per-process job handles, so it
+    refuses to fan out.  The monitor runs in worker 0 only.
+    """
+    import argparse
+    import os
+    import signal
+
+    from .config import get_settings
     from .logging_config import setup_logging
 
     parser = argparse.ArgumentParser(prog="ftc-serve")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8787)
     parser.add_argument("--plugin-dir", default=None, help="model plugin directory")
+    parser.add_argument("--workers", type=int, default=1,
+                        help="server processes sharing the port (k8s backend only)")
     args = parser.parse_args(argv)
     setup_logging()
-    runtime = build_runtime(plugin_dir=args.plugin_dir)
-    app = build_app(runtime)
-    web.run_app(app, host=args.host, port=args.port)
+
+    workers = max(1, args.workers)
+    settings = get_settings()
+    if workers > 1 and settings.backend == "local":
+        parser.error(
+            "--workers > 1 requires FTC_BACKEND=k8s: the local backend's "
+            "job handles live in one process"
+        )
+    if workers > 1 and settings.state_backend != "sqlite":
+        parser.error("--workers > 1 requires FTC_STATE_BACKEND=sqlite")
+
+    worker_idx, children = 0, []
+    for i in range(1, workers):
+        pid = os.fork()
+        if pid == 0:
+            worker_idx, children = i, []
+            break
+        children.append(pid)
+
+    if children:
+        # reap + log dead workers so an OOM-killed child is neither a silent
+        # capacity loss nor a zombie for the parent's lifetime
+        def _reap(signum, frame):
+            while True:
+                try:
+                    pid, status = os.waitpid(-1, os.WNOHANG)
+                except ChildProcessError:
+                    return
+                if pid == 0:
+                    return
+                if pid in children:
+                    children.remove(pid)
+                    logger.error(
+                        "worker %d died (status %d): serving capacity reduced",
+                        pid, status,
+                    )
+
+        signal.signal(signal.SIGCHLD, _reap)
+
+    try:
+        # each worker builds its own runtime AFTER the fork (no shared
+        # fds/locks); the try covers the build too — a parent-side build
+        # failure must not orphan already-forked children on the port
+        runtime = build_runtime(plugin_dir=args.plugin_dir)
+        # monitor in worker 0 only — and only if the operator wants an
+        # in-process monitor at all (a separate monitor deployment sets it
+        # false)
+        with_monitor = (
+            None if workers == 1
+            else (worker_idx == 0 and settings.monitor_in_process)
+        )
+        app = build_app(runtime, with_monitor=with_monitor)
+        web.run_app(
+            app, host=args.host, port=args.port, reuse_port=workers > 1
+        )
+    finally:
+        if children:
+            signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+        for pid in list(children):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                continue
+        deadline = time.monotonic() + 10
+        for pid in list(children):
+            try:
+                while time.monotonic() < deadline:
+                    done, _ = os.waitpid(pid, os.WNOHANG)
+                    if done:
+                        break
+                    time.sleep(0.1)
+                else:
+                    os.kill(pid, signal.SIGKILL)
+                    os.waitpid(pid, 0)
+            except (ChildProcessError, ProcessLookupError):
+                continue  # already reaped by the SIGCHLD handler
     return 0
 
 

@@ -1,0 +1,111 @@
+"""Multi-process API serving (--workers N over SO_REUSEPORT).
+
+The reference serves with ``uvicorn --workers 4`` (``Dockerfile:28``); the
+rebuild's equivalent is N forked aiohttp processes sharing the port.  Safe
+only with the k8s backend + sqlite state store — the guard rails and the
+actual fan-out are both tested here with real OS processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env(tmp_path, **extra) -> dict[str, str]:
+    env = dict(os.environ)
+    env.update({
+        "FTC_STATE_DIR": str(tmp_path / "state"),
+        "FTC_OBJECT_STORE_ROOT": str(tmp_path / "objects"),
+        "FTC_ENVIRONMENT": "local",
+        "JAX_PLATFORMS": "cpu",
+        # fake in-cluster env: the client is constructed lazily and /health
+        # never touches the apiserver
+        "KUBERNETES_SERVICE_HOST": "127.0.0.1",
+        "KUBERNETES_SERVICE_PORT": "1",
+        **extra,
+    })
+    return env
+
+
+def test_workers_refused_on_local_backend(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "finetune_controller_tpu.controller.server",
+         "--port", str(_free_port()), "--workers", "2"],
+        env=_env(tmp_path, FTC_BACKEND="local"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode != 0
+    assert "FTC_BACKEND=k8s" in out.stderr
+
+
+def test_workers_refused_on_jsonl_store(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "finetune_controller_tpu.controller.server",
+         "--port", str(_free_port()), "--workers", "2"],
+        env=_env(tmp_path, FTC_BACKEND="k8s", FTC_STATE_BACKEND="jsonl"),
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode != 0
+    assert "FTC_STATE_BACKEND=sqlite" in out.stderr
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="SO_REUSEPORT fan-out")
+def test_two_workers_share_the_port(tmp_path):
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "finetune_controller_tpu.controller.server",
+         "--port", str(port), "--workers", "2"],
+        env=_env(tmp_path, FTC_BACKEND="k8s", FTC_MONITOR_IN_PROCESS="false"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        url = f"http://127.0.0.1:{port}/api/v1/health"
+        deadline = time.time() + 60
+        up = False
+        while time.time() < deadline and not up:
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    up = json.load(r)["status"] == "ok"
+            except OSError:
+                time.sleep(0.5)
+        assert up, "service never came up"
+        # SO_REUSEPORT fan-out: the shared port keeps answering...
+        for _ in range(5):
+            with urllib.request.urlopen(url, timeout=5) as r:
+                assert json.load(r)["status"] == "ok"
+            time.sleep(0.2)
+        # ...and a forked worker child exists next to the parent
+        assert proc.poll() is None
+        kids = _children_of(proc.pid)
+        assert len(kids) >= 1, "expected a forked worker child"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _children_of(pid: int) -> list[int]:
+    try:
+        out = subprocess.run(
+            ["ps", "--ppid", str(pid), "-o", "pid="],
+            capture_output=True, text=True, timeout=10,
+        )
+        return [int(p) for p in out.stdout.split()]
+    except Exception:
+        return []

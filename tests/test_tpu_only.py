@@ -1,0 +1,43 @@
+"""Tests that only run on real TPU hardware (skipped on the CPU CI mesh).
+
+CPU CI exercises the Pallas kernels in interpreter mode only; a Mosaic
+miscompile — particularly in the segment-mask path — would ship unnoticed
+without a compiled-on-TPU parity check.  ``scripts/tpu_session.py`` runs the
+same check as part of the measurement session; this is the pytest-gated
+form for TPU-equipped CI.
+
+Run with:  JAX_PLATFORMS=tpu python -m pytest tests/test_tpu_only.py -q
+(the conftest pins the suite to CPU, so the TPU run must override it via
+FTC_TEST_TPU=1).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+requires_tpu = pytest.mark.skipif(
+    not os.environ.get("FTC_TEST_TPU"),
+    reason="TPU-only: set FTC_TEST_TPU=1 on a TPU host",
+)
+
+
+@requires_tpu
+def test_compiled_flash_attention_with_segments_matches_xla():
+    import subprocess
+    import sys
+
+    from scripts.tpu_session import PARITY_SNIPPET  # single source of truth
+
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["JAX_PLATFORMS"] = "tpu"
+    out = subprocess.run(
+        [sys.executable, "-c", PARITY_SNIPPET],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    import json
+
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["ok"], rec
