@@ -80,6 +80,44 @@ def greedy_generate(model, variables, prompt_tokens, *, max_new_tokens=32,
     )
 
 
+#: jitted (fill, decode_step) pairs keyed by (model class, decode config) —
+#: defined at module level so REPEATED cached_generate calls (the whole point
+#: of a usable 7B sanity loop) reuse compilations instead of re-tracing.
+#: Configs are frozen dataclasses, hence hashable; bounded to stay tiny.
+_DECODE_FNS_CACHE: dict = {}
+
+
+def _decode_fns(model_type, dcfg):
+    key = (model_type, dcfg)
+    cached = _DECODE_FNS_CACHE.get(key)
+    if cached is not None:
+        return cached
+    dmodel = model_type(cfg=dcfg)
+    mutable = ("cache", "moe_aux") if dcfg.n_experts else ("cache",)
+
+    @jax.jit
+    def fill(variables, tokens):
+        logits, updated = dmodel.apply(
+            variables, tokens, deterministic=True, decode=True,
+            mutable=mutable,
+        )
+        return logits[:, -1].astype(jnp.float32), updated["cache"]
+
+    @jax.jit
+    def decode_step(variables, token, pos):
+        positions = jnp.broadcast_to(pos[None, None], (token.shape[0], 1))
+        logits, updated = dmodel.apply(
+            variables, token, positions, deterministic=True, decode=True,
+            mutable=mutable,
+        )
+        return logits[:, -1].astype(jnp.float32), updated["cache"]
+
+    if len(_DECODE_FNS_CACHE) >= 8:
+        _DECODE_FNS_CACHE.clear()
+    _DECODE_FNS_CACHE[key] = (fill, decode_step)
+    return fill, decode_step
+
+
 def _sample(logits, *, temperature, top_k, rng):
     """Shared sampling rule — cached and uncached paths must pick the same
     token from the same logits."""
@@ -130,26 +168,7 @@ def cached_generate(
     dcfg = model.cfg.replace(
         remat=False, attention_impl="xla", max_seq_len=cache_len
     )
-    dmodel = type(model)(cfg=dcfg)
-    mutable = ("cache", "moe_aux") if dcfg.n_experts else ("cache",)
-
-    @jax.jit
-    def fill(variables, tokens):
-        logits, updated = dmodel.apply(
-            variables, tokens, deterministic=True, decode=True,
-            mutable=mutable,
-        )
-        return logits[:, -1].astype(jnp.float32), updated["cache"]
-
-    @jax.jit
-    def decode_step(variables, token, pos):
-        positions = jnp.broadcast_to(pos[None, None], (token.shape[0], 1))
-        logits, updated = dmodel.apply(
-            variables, token, positions, deterministic=True, decode=True,
-            mutable=mutable,
-        )
-        return logits[:, -1].astype(jnp.float32), updated["cache"]
-
+    fill, decode_step = _decode_fns(type(model), dcfg)
     logits, cache = fill(variables, tokens)
     done = jnp.zeros((b,), bool)
     for t in range(max_new_tokens):
