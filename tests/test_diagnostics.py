@@ -1,0 +1,28 @@
+"""Collective-bandwidth diagnostics (the nccl-tests workflow, TPU-native)."""
+
+from __future__ import annotations
+
+import jax
+
+from finetune_controller_tpu.parallel.diagnostics import collective_diagnostics
+
+
+def test_sweep_on_virtual_mesh(devices8):
+    rep = collective_diagnostics(sizes_mb=(0.25,), devices=devices8)
+    assert rep["n_devices"] == 8
+    assert set(rep["collectives"]) == {"psum", "all_gather", "ppermute"}
+    for op, rows in rep["collectives"].items():
+        row = rows["0.25"]
+        assert row["time_ms"] > 0
+        assert row["algo_bw_gbps"] > 0
+        assert row["bus_bw_gbps"] > 0
+    # nccl-tests convention: all-reduce bus bandwidth accounts 2(n-1)/n
+    # (loose tolerance: the reported values are rounded to 3 decimals)
+    ar = rep["collectives"]["psum"]["0.25"]
+    assert abs(ar["bus_bw_gbps"] / ar["algo_bw_gbps"] - 2 * 7 / 8) < 0.06
+
+
+def test_single_device_degrades_gracefully():
+    rep = collective_diagnostics(sizes_mb=(0.25,), devices=jax.devices()[:1])
+    assert rep["n_devices"] == 1
+    assert "note" in rep and rep["collectives"] == {}
