@@ -242,21 +242,21 @@ def jsonl_token_batches(
     shard_count: int = 1,
 ) -> Iterator[dict]:
     tokens = segments = loss_flags = None
-    if tokenizer_file is None and path.endswith(".jsonl") and not _sniff_sft_jsonl(path):
-        # native C++ parse+tokenize+pack hot path (data/native_loader.py);
-        # byte-parity with the Python path, gate with FTC_NATIVE=0. SFT
-        # prompt/completion rows carry loss flags the native packer doesn't
-        # know about — those take the Python path (cheap head sniff; a
-        # deep SFT row past the sniff window makes the native packer raise
-        # and we fall back below).
+    if tokenizer_file is None and path.endswith(".jsonl"):
+        # native C++ parse+tokenize+pack hot path (data/native_loader.py):
+        # covers every byte-level row schema incl. SFT prompt/completion and
+        # chat messages, with loss flags; byte-parity with the Python path,
+        # gate with FTC_NATIVE=0. Anything it can't own (malformed rows,
+        # non-string chat content it would have to stringify) raises and the
+        # Python loader decides — including raising the user-facing error.
         from .native_loader import pack_jsonl_native
 
         try:
             packed = pack_jsonl_native(path, seq_len)
         except ValueError:
-            packed = None  # mixed/SFT schema: the Python loader decides
+            packed = None  # odd schema: the Python loader decides
         if packed is not None:
-            tokens, segments = packed
+            tokens, segments, loss_flags = packed
             logger.debug("native packer produced %d blocks", tokens.shape[0])
     if tokens is None:
         docs = load_token_documents(path, tokenizer_file)
@@ -268,12 +268,3 @@ def jsonl_token_batches(
     )
 
 
-def _sniff_sft_jsonl(path: str, head_bytes: int = 1 << 16) -> bool:
-    """Whether the file's HEAD uses a loss-masked schema (SFT
-    prompt/completion or chat messages). Bounded read so multi-GB plain-LM
-    files don't pay a full extra Python pass before the native packer; a
-    masked row hiding past the window is still handled — the native packer
-    rejects it and the caller falls back to Python."""
-    with open(path, "rb") as f:
-        head = f.read(head_bytes)
-    return b'"prompt' in head or b'"messages"' in head

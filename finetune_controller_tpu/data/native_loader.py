@@ -2,10 +2,11 @@
 
 The parse+tokenize+pack hot path runs in C++ (~order-of-magnitude over the
 Python loop on large corpora); shuffling and batch assembly stay in
-``data.loader`` (numpy, already fast). Output parity with
-``loader.load_token_documents`` + ``loader.pack_documents`` for byte-level
-tokenization is enforced by tests; rows needing a real tokenizer file keep
-using the Python path.
+``data.loader`` (numpy, already fast). Covers every byte-level row schema —
+plain LM, SFT prompt/completion (text or tokens), and chat messages, with
+loss flags. Output parity with ``loader.load_token_documents`` +
+``loader.pack_documents`` is enforced by tests; rows needing a real
+tokenizer file keep using the Python path.
 """
 
 from __future__ import annotations
@@ -48,6 +49,7 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
         ]
         lib.ftc_last_error.restype = ctypes.c_char_p
         lib.ftc_free.argtypes = [ctypes.c_void_p]
@@ -59,11 +61,13 @@ def available() -> bool:
     return _load() is not None
 
 
-def pack_jsonl_native(path: str, seq_len: int) -> tuple[np.ndarray, np.ndarray] | None:
+def pack_jsonl_native(
+    path: str, seq_len: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
     """Native parse+tokenize+pack; None when the library is unavailable.
 
-    Raises ValueError on malformed datasets (same contract as the Python
-    loader).
+    Returns (tokens, segments, loss_flags). Raises ValueError on malformed
+    datasets (same contract as the Python loader).
     """
     lib = _load()
     if lib is None:
@@ -76,13 +80,15 @@ def pack_jsonl_native(path: str, seq_len: int) -> tuple[np.ndarray, np.ndarray] 
     try:
         tokens = np.empty((n_blocks, seq_len), np.int32)
         segments = np.empty((n_blocks, seq_len), np.int32)
+        flags = np.empty((n_blocks, seq_len), np.int32)
         rc = lib.ftc_copy_packed(
             handle,
             tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             segments.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            flags.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
         if rc != 0:
             raise ValueError("native packer copy failed")
-        return tokens, segments
+        return tokens, segments, flags.astype(np.float32)
     finally:
         lib.ftc_free(handle)

@@ -3,16 +3,23 @@
 // The reference delegates all native concerns to external systems (SURVEY.md
 // §2.2 — "no C++/Rust/CUDA code in-repo"); this framework keeps the training
 // loop in JAX and the IO-bound preprocessing here: parse a JSONL dataset,
-// tokenize "text" rows byte-level (exact parity with
+// tokenize byte-level (exact parity with
 // finetune_controller_tpu/data/loader.py::_byte_tokenize, including \uXXXX
-// escapes decoded to UTF-8), accept pre-tokenized "tokens" rows, and pack
-// everything into (n_blocks, seq_len) int32 token/segment arrays with
-// per-document segment ids.
+// escapes decoded to UTF-8), and pack everything into (n_blocks, seq_len)
+// int32 token/segment/loss-flag arrays with per-document segment ids.
+//
+// Row schemas (parity with loader.load_token_documents, same precedence):
+//   {"tokens": [...]}                        flags all 1
+//   {"text": "..."}                          flags all 1
+//   {"prompt_tokens": [], "completion_tokens": []}  completion-only flags
+//   {"prompt": "...", "completion": "..."}   completion-only flags
+//   {"messages": [{"role","content"}, ...]}  chat template <|role|>\ncontent\n,
+//                                            assistant content (+\n) flagged
 //
 // Exposed as a tiny C ABI for ctypes (no pybind11 in the image):
-//   ftc_pack_file(path, seq_len, &handle)  -> n_blocks (<0 = error code)
-//   ftc_copy_packed(handle, tokens, segs)  -> 0 on success
-//   ftc_last_error()                       -> static error string
+//   ftc_pack_file(path, seq_len, &handle)        -> n_blocks (<0 = error)
+//   ftc_copy_packed(handle, tokens, segs, flags) -> 0 on success
+//   ftc_last_error()                             -> static error string
 //   ftc_free(handle)
 //
 // Build: finetune_controller_tpu/native/build.py (g++ -O3 -shared -fPIC).
@@ -30,6 +37,7 @@ thread_local std::string g_error;
 struct Packed {
   std::vector<int32_t> tokens;
   std::vector<int32_t> segments;
+  std::vector<int32_t> flags;  // 1 = position counts toward the loss
   int64_t n_blocks = 0;
   int64_t seq_len = 0;
 };
@@ -161,6 +169,110 @@ bool find_key(const std::string& s, const char* key, size_t* value_start) {
   return false;
 }
 
+// Skip any JSON value starting at s[i] (string, number, object, array,
+// literal); advances i past it. Used to pass over message keys we ignore.
+bool skip_value(const std::string& s, size_t* i) {
+  while (*i < s.size() && (s[*i] == ' ' || s[*i] == '\t')) ++*i;
+  if (*i >= s.size()) return false;
+  char c = s[*i];
+  if (c == '"') {
+    std::string tmp;
+    return parse_json_string(s, i, &tmp);
+  }
+  if (c == '{' || c == '[') {
+    int depth = 0;
+    while (*i < s.size()) {
+      char d = s[*i];
+      if (d == '"') {
+        std::string tmp;
+        if (!parse_json_string(s, i, &tmp)) return false;
+        continue;
+      }
+      if (d == '{' || d == '[') ++depth;
+      if (d == '}' || d == ']') {
+        --depth;
+        if (depth == 0) { ++*i; return true; }
+      }
+      ++*i;
+    }
+    return false;
+  }
+  // number / true / false / null: scan to a structural delimiter
+  while (*i < s.size() && s[*i] != ',' && s[*i] != '}' && s[*i] != ']' &&
+         s[*i] != ' ' && s[*i] != '\t') {
+    ++*i;
+  }
+  return true;
+}
+
+// Parse {"messages": [...]} starting at the array and render the fixed chat
+// template (loader._render_chat): header "<|role|>\n" (masked) + content
+// "\n" (flagged iff role == "assistant"). Byte-level tokens.
+bool parse_messages(const std::string& s, size_t i,
+                    std::vector<int32_t>* toks, std::vector<int32_t>* flags) {
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) ++i;
+  if (i >= s.size() || s[i] != '[') return false;
+  ++i;
+  toks->clear();
+  flags->clear();
+  // the closing ']' is REQUIRED: a truncated row (interrupted download cut
+  // mid-array) must error like the Python loader's json.loads, not train
+  bool closed = false;
+  while (i < s.size()) {
+    while (i < s.size() &&
+           (s[i] == ' ' || s[i] == '\t' || s[i] == ',')) {
+      ++i;
+    }
+    if (i < s.size() && s[i] == ']') {
+      closed = true;
+      break;
+    }
+    if (i >= s.size() || s[i] != '{') return false;  // must be an object
+    ++i;
+    std::string role = "user";  // loader default: msg.get("role", "user")
+    std::string content;        // loader default: ""
+    bool in_obj = true;
+    while (in_obj && i < s.size()) {
+      while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == ',')) ++i;
+      if (i < s.size() && s[i] == '}') {
+        ++i;
+        in_obj = false;
+        break;
+      }
+      std::string key;
+      if (i >= s.size() || !parse_json_string(s, &i, &key)) return false;
+      while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) ++i;
+      if (i >= s.size() || s[i] != ':') return false;
+      ++i;
+      while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) ++i;
+      if ((key == "role" || key == "content") && i < s.size() && s[i] == '"') {
+        std::string val;
+        if (!parse_json_string(s, &i, &val)) return false;
+        if (key == "role") role = val;
+        else content = val;
+      } else {
+        // non-string role/content (loader stringifies) or extra keys: the
+        // Python path owns those rows
+        if (key == "role" || key == "content") return false;
+        if (!skip_value(s, &i)) return false;
+      }
+    }
+    if (in_obj) return false;  // unterminated object
+    std::string header = "<|" + role + "|>\n";
+    for (unsigned char ch : header) {
+      toks->push_back(ch);
+      flags->push_back(0);
+    }
+    int32_t body_flag = role == "assistant" ? 1 : 0;
+    content.push_back('\n');
+    for (unsigned char ch : content) {
+      toks->push_back(ch);
+      flags->push_back(body_flag);
+    }
+  }
+  return closed && !toks->empty();
+}
+
 bool parse_int_array(const std::string& s, size_t i, std::vector<int32_t>* out) {
   while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) ++i;
   if (i >= s.size() || s[i] != '[') return false;
@@ -205,17 +317,36 @@ int64_t ftc_pack_file(const char* path, int64_t seq_len, void** out_handle) {
   std::vector<int32_t>& stream = packed->tokens;
   std::vector<int32_t>& segs = packed->segments;
 
+  std::vector<int32_t>& lflags = packed->flags;
   std::string line;
   std::vector<int32_t> tok_buf;
+  std::vector<int32_t> tok_buf2;
+  std::vector<int32_t> flag_buf;
   std::string text_buf;
+  std::string text_buf2;
   int32_t doc_id = 0;
   char buf[1 << 16];
   line.reserve(1 << 16);
   bool pending = false;
+  // all-ones flags (plain LM rows)
   auto flush_doc = [&](const std::vector<int32_t>& toks) {
     ++doc_id;
     stream.insert(stream.end(), toks.begin(), toks.end());
     segs.insert(segs.end(), toks.size(), doc_id);
+    lflags.insert(lflags.end(), toks.size(), 1);
+  };
+  // explicit flags (SFT/chat rows)
+  auto flush_doc_flags = [&](const std::vector<int32_t>& toks,
+                             const std::vector<int32_t>& fl) {
+    ++doc_id;
+    stream.insert(stream.end(), toks.begin(), toks.end());
+    segs.insert(segs.end(), toks.size(), doc_id);
+    lflags.insert(lflags.end(), fl.begin(), fl.end());
+  };
+  auto parse_string_field = [&](const std::string& row, size_t vi,
+                                std::string* out) -> bool {
+    while (vi < row.size() && (row[vi] == ' ' || row[vi] == '\t')) ++vi;
+    return parse_json_string(row, &vi, out);
   };
   auto process_line = [&]() -> bool {
     // trim
@@ -223,6 +354,7 @@ int64_t ftc_pack_file(const char* path, int64_t seq_len, void** out_handle) {
     if (b == std::string::npos) return true;
     size_t e = line.find_last_not_of(" \t\r\n");
     std::string row = line.substr(b, e - b + 1);
+    // schema precedence mirrors loader.load_token_documents exactly
     size_t vi = 0;
     if (find_key(row, "tokens", &vi)) {
       if (!parse_int_array(row, vi, &tok_buf)) {
@@ -244,7 +376,61 @@ int64_t ftc_pack_file(const char* path, int64_t seq_len, void** out_handle) {
       flush_doc(tok_buf);
       return true;
     }
-    g_error = "jsonl rows must have a 'tokens' or 'text' field";
+    size_t pi = 0, ci = 0;
+    if (find_key(row, "prompt_tokens", &pi) &&
+        find_key(row, "completion_tokens", &ci)) {
+      if (!parse_int_array(row, pi, &tok_buf) ||
+          !parse_int_array(row, ci, &tok_buf2)) {
+        g_error = "malformed prompt/completion token arrays: " +
+                  row.substr(0, 80);
+        return false;
+      }
+      flag_buf.assign(tok_buf.size(), 0);
+      flag_buf.insert(flag_buf.end(), tok_buf2.size(), 1);
+      tok_buf.insert(tok_buf.end(), tok_buf2.begin(), tok_buf2.end());
+      flush_doc_flags(tok_buf, flag_buf);
+      return true;
+    }
+    if (find_key(row, "prompt", &pi) && find_key(row, "completion", &ci)) {
+      if (!parse_string_field(row, pi, &text_buf) ||
+          !parse_string_field(row, ci, &text_buf2)) {
+        g_error = "malformed prompt/completion strings: " + row.substr(0, 80);
+        return false;
+      }
+      tok_buf.clear();
+      flag_buf.clear();
+      for (unsigned char ch : text_buf) {
+        tok_buf.push_back(ch);
+        flag_buf.push_back(0);
+      }
+      for (unsigned char ch : text_buf2) {
+        tok_buf.push_back(ch);
+        flag_buf.push_back(1);
+      }
+      flush_doc_flags(tok_buf, flag_buf);
+      return true;
+    }
+    if (find_key(row, "messages", &vi)) {
+      if (!parse_messages(row, vi, &tok_buf, &flag_buf)) {
+        g_error = "unsupported 'messages' row (the Python loader owns it): " +
+                  row.substr(0, 80);
+        return false;
+      }
+      bool any = false;
+      for (int32_t f : flag_buf) any |= (f != 0);
+      if (!any) {
+        // parity with the Python loader's wrong-role footgun check — the
+        // caller falls back and the Python path raises the detailed error
+        g_error = "chat row produced no assistant-content tokens: " +
+                  row.substr(0, 80);
+        return false;
+      }
+      flush_doc_flags(tok_buf, flag_buf);
+      return true;
+    }
+    g_error =
+        "jsonl rows must have 'tokens', 'text', 'prompt'/'completion', "
+        "or 'messages' fields";
     return false;
   };
 
@@ -278,19 +464,23 @@ int64_t ftc_pack_file(const char* path, int64_t seq_len, void** out_handle) {
   if (static_cast<int64_t>(stream.size()) < seq_len) {
     stream.resize(seq_len, 0);
     segs.resize(seq_len, 0);
+    lflags.resize(seq_len, 0);
   }
   stream.resize(n_blocks * seq_len);
   segs.resize(n_blocks * seq_len);
+  lflags.resize(n_blocks * seq_len);
   packed->n_blocks = n_blocks;
   *out_handle = packed;
   return n_blocks;
 }
 
-int32_t ftc_copy_packed(void* handle, int32_t* tokens, int32_t* segments) {
+int32_t ftc_copy_packed(void* handle, int32_t* tokens, int32_t* segments,
+                        int32_t* flags) {
   auto* p = static_cast<Packed*>(handle);
   if (!p) return -1;
   std::memcpy(tokens, p->tokens.data(), p->tokens.size() * sizeof(int32_t));
   std::memcpy(segments, p->segments.data(), p->segments.size() * sizeof(int32_t));
+  std::memcpy(flags, p->flags.data(), p->flags.size() * sizeof(int32_t));
   return 0;
 }
 

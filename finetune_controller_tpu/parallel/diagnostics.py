@@ -109,9 +109,14 @@ def collective_diagnostics(
         rows = {}
         for size_mb in sizes_mb:
             # per-DEVICE payload S: size_mb of f32, rounded up to whole
-            # lanes; the global array is (elems*n,) sharded over x
+            # lanes; the global (elems*n,) array is created ALREADY sharded —
+            # materializing it on one device first would OOM the very slices
+            # this tool targets (128 MB x 256 chips = 32 GB on device 0)
             elems = max(8, int(size_mb * (1 << 20) // 4))
-            x = jax.device_put(jnp.ones((elems * n,), jnp.float32), spec)
+            x = jax.jit(
+                lambda: jnp.ones((elems * n,), jnp.float32),
+                out_shardings=spec,
+            )()
             sec = _timed_chain(fn, x)
             payload = elems * 4  # bytes contributed per device
             if op == "all_gather":

@@ -112,3 +112,96 @@ def test_native_top_level_key_matching(tmp_path):
     nat = pack_jsonl_native(str(p), 16)
     np.testing.assert_array_equal(nat[0], py_tokens)
     np.testing.assert_array_equal(nat[1], py_segs)
+
+
+SFT_ROWS = [
+    {"prompt": "Q: what is 2+2?\nA: ", "completion": "4"},
+    {"prompt_tokens": [10, 11, 12], "completion_tokens": [13, 14]},
+    {"prompt": "unicodé prompt ♞ ", "completion": "réponse 😀"},
+    {"text": "a plain LM row mixed into the SFT corpus"},
+    {"tokens": [7, 8, 9]},
+]
+
+CHAT_ROWS = [
+    {"messages": [
+        {"role": "system", "content": "be terse"},
+        {"role": "user", "content": "hi there"},
+        {"role": "assistant", "content": "hello!"},
+        {"role": "user", "content": "more?"},
+        {"role": "assistant", "content": 'sure: "quoted" ♘ text'},
+    ]},
+    {"messages": [
+        {"role": "user", "content": "only\nturn"},
+        {"role": "assistant", "content": ""},
+    ]},
+]
+
+
+def test_native_sft_parity_with_python(tmp_path):
+    """SFT prompt/completion rows: tokens, segments AND loss flags match the
+    Python loader byte-for-byte (completion-only loss)."""
+    p = tmp_path / "sft.jsonl"
+    _write_jsonl(p, SFT_ROWS)
+    for seq_len in (16, 128):
+        docs = load_token_documents(str(p))
+        py_tokens, py_segs, py_flags = pack_documents(docs, seq_len)
+        nat = pack_jsonl_native(str(p), seq_len)
+        assert nat is not None
+        np.testing.assert_array_equal(nat[0], py_tokens)
+        np.testing.assert_array_equal(nat[1], py_segs)
+        np.testing.assert_array_equal(nat[2], py_flags)
+        assert 0.0 < py_flags.mean() < 1.0  # genuinely masked
+
+
+def test_native_chat_parity_with_python(tmp_path):
+    """Chat rows render the same template with assistant-only loss."""
+    p = tmp_path / "chat.jsonl"
+    _write_jsonl(p, CHAT_ROWS)
+    docs = load_token_documents(str(p))
+    py_tokens, py_segs, py_flags = pack_documents(docs, 64)
+    nat = pack_jsonl_native(str(p), 64)
+    assert nat is not None
+    np.testing.assert_array_equal(nat[0], py_tokens)
+    np.testing.assert_array_equal(nat[1], py_segs)
+    np.testing.assert_array_equal(nat[2], py_flags)
+
+
+def test_native_chat_raw_utf8(tmp_path):
+    p = tmp_path / "chat_raw.jsonl"
+    with open(p, "w") as f:
+        for row in CHAT_ROWS:
+            f.write(json.dumps(row, ensure_ascii=False) + "\n")
+    docs = load_token_documents(str(p))
+    py_tokens, _, py_flags = pack_documents(docs, 64)
+    nat = pack_jsonl_native(str(p), 64)
+    np.testing.assert_array_equal(nat[0], py_tokens)
+    np.testing.assert_array_equal(nat[2], py_flags)
+
+
+def test_native_all_masked_chat_rejected(tmp_path):
+    """The wrong-role footgun ({'role': 'model'}) errors in the native path
+    too, so the fallback re-raises the Python loader's detailed message."""
+    p = tmp_path / "bad_chat.jsonl"
+    _write_jsonl(p, [{"messages": [{"role": "model", "content": "hi"}]}])
+    with pytest.raises(ValueError):
+        pack_jsonl_native(str(p), 16)
+
+
+def test_jsonl_token_batches_native_sft_mask(tmp_path):
+    """End-to-end: the batch iterator's loss_mask carries the native flags
+    (completion-only) AND the packing-boundary zeros."""
+    p = tmp_path / "sft2.jsonl"
+    _write_jsonl(p, [{"prompt": "ppppp", "completion": "cc"}] * 10)
+    it = jsonl_token_batches(str(p), batch_size=2, seq_len=14)
+    batch = next(it)
+    assert batch["loss_mask"].shape == batch["tokens"].shape
+    m = batch["loss_mask"].mean()
+    assert 0.0 < m < 0.5  # 2 of 7 positions per doc, minus boundary masking
+
+
+def test_native_truncated_chat_row_rejected(tmp_path):
+    """A row cut mid-array (interrupted download) must error, not train."""
+    p = tmp_path / "trunc.jsonl"
+    p.write_text('{"messages": [{"role": "assistant", "content": "x"}\n')
+    with pytest.raises(ValueError):
+        pack_jsonl_native(str(p), 16)
