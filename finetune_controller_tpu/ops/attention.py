@@ -85,6 +85,40 @@ def single_token_attention(
     return out.reshape(b, s, h, d)
 
 
+def flash_tuning_kwargs() -> dict:
+    """Validated flash-kernel overrides from the env — shared by every flash
+    call site (the plain dispatch and the ring inner), so a tuning sweep
+    (``scripts/tpu_session.py``) moves all of them together.
+
+    Knobs (``docs/performance.md``): ``FTC_FLASH_BLOCK_Q``/``K`` (positive
+    multiples of 128) and ``FTC_FLASH_EXP_DTYPE`` (``float32``/``bfloat16``).
+    """
+    import os
+
+    kwargs: dict = {}
+    for env_name, kw in (("FTC_FLASH_BLOCK_Q", "block_q"),
+                         ("FTC_FLASH_BLOCK_K", "block_k")):
+        raw = os.environ.get(env_name)
+        if raw:
+            try:
+                val = int(raw)
+            except ValueError:
+                raise ValueError(f"{env_name}={raw!r}: not an integer") from None
+            if val < 128 or val % 128:
+                raise ValueError(
+                    f"{env_name}={val}: must be a positive multiple of 128"
+                )
+            kwargs[kw] = val
+    raw = os.environ.get("FTC_FLASH_EXP_DTYPE")
+    if raw:
+        if raw not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"FTC_FLASH_EXP_DTYPE={raw!r}: expected float32 or bfloat16"
+            )
+        kwargs["exp_dtype"] = raw
+    return kwargs
+
+
 def causal_attention(
     q: jax.Array,
     k: jax.Array,
@@ -109,33 +143,9 @@ def causal_attention(
                 "attention impl='pallas' requires ops.pallas.flash_attention "
                 "(not built in this installation); use impl='xla'"
             ) from e
-        # perf-tuning knobs (ops/kernel_bench.py sweeps; operator override for
-        # long-sequence shapes where the best block size differs from the
-        # seq-2048 defaults): FTC_FLASH_BLOCK_Q/K, FTC_FLASH_EXP_DTYPE
-        import os
-
-        kwargs: dict = {}
-        for env_name, kw in (("FTC_FLASH_BLOCK_Q", "block_q"),
-                             ("FTC_FLASH_BLOCK_K", "block_k")):
-            raw = os.environ.get(env_name)
-            if raw:
-                try:
-                    val = int(raw)
-                except ValueError:
-                    raise ValueError(f"{env_name}={raw!r}: not an integer") from None
-                if val < 128 or val % 128:
-                    raise ValueError(
-                        f"{env_name}={val}: must be a positive multiple of 128"
-                    )
-                kwargs[kw] = val
-        raw = os.environ.get("FTC_FLASH_EXP_DTYPE")
-        if raw:
-            if raw not in ("float32", "bfloat16"):
-                raise ValueError(
-                    f"FTC_FLASH_EXP_DTYPE={raw!r}: expected float32 or bfloat16"
-                )
-            kwargs["exp_dtype"] = raw
-        return flash_attention(q, k, v, segment_ids=segment_ids, **kwargs)
+        return flash_attention(
+            q, k, v, segment_ids=segment_ids, **flash_tuning_kwargs()
+        )
     if impl == "ring":
         from ..parallel.ring import get_ring_mesh, ring_attention_sharded
 

@@ -85,6 +85,7 @@ def _fwd_kernel(
     scale: float,
     use_segments: bool,
     exp_dtype: str = "float32",
+    causal: bool = True,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -98,15 +99,21 @@ def _fwd_kernel(
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # causal frontier: this k block is live iff its first key position is
-    # <= the q block's last query position
-    needed = ik * bk <= (iq + 1) * bq - 1
-    # interior = every (q, k) pair in the block is causally valid AND inside
-    # the real sequence: the iota/compare/where mask passes can be skipped.
-    # The attention kernel is VPU-bound (S^2 elementwise vs 2dS^2 MXU flops
-    # at small head dims), so dropping mask passes on the ~N^2/2 interior
-    # blocks is a direct win at long sequence.
-    interior = ((ik + 1) * bk - 1 <= iq * bq) & ((ik + 1) * bk <= seq_len)
+    if causal:
+        # causal frontier: this k block is live iff its first key position is
+        # <= the q block's last query position
+        needed = ik * bk <= (iq + 1) * bq - 1
+        # interior = every (q, k) pair in the block is causally valid AND
+        # inside the real sequence: the iota/compare/where mask passes can be
+        # skipped. The attention kernel is VPU-bound (S^2 elementwise vs
+        # 2dS^2 MXU flops at small head dims), so dropping mask passes on the
+        # ~N^2/2 interior blocks is a direct win at long sequence.
+        interior = ((ik + 1) * bk - 1 <= iq * bq) & ((ik + 1) * bk <= seq_len)
+    else:
+        # full (non-causal) attention — the ring-attention off-diagonal
+        # steps, where every key is in the query's global past
+        needed = ik * bk < seq_len
+        interior = (ik + 1) * bk <= seq_len
 
     def _online_update(s, mask):
         """Shared online-softmax update; ``mask`` None = fully valid block."""
@@ -151,8 +158,9 @@ def _fwd_kernel(
     def _compute_masked():
         s = _scores()
         q_pos, k_pos = _block_positions(iq, ik, bq, bk)
-        mask = q_pos >= k_pos
-        mask &= k_pos < seq_len  # tail block: beyond-S lanes are padding
+        mask = k_pos < seq_len  # tail block: beyond-S lanes are padding
+        if causal:
+            mask &= q_pos >= k_pos
         if use_segments:
             mask &= _segment_mask(qseg_ref, kseg_ref)
         _online_update(s, mask)
@@ -174,12 +182,14 @@ def _fwd_kernel(
         lse_ref[0, 0] = m_ref[...] + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _pad_inputs(q, k, v, segment_ids, bq, bk):
+def _pad_inputs(q, k, v, segment_ids, bq, bk, kv_segment_ids=None):
     """Pad S to a common block multiple: pl.ds/dynamic_slice CLAMP
     out-of-bounds starts, which would silently read the wrong K rows on a
     ragged tail block. Padded keys are masked via k_pos >= seq_len; padded
     query rows are sliced away by the callers."""
     s = q.shape[1]
+    if kv_segment_ids is None:
+        kv_segment_ids = segment_ids
     s_pad = math.lcm(bq, bk) * pl.cdiv(s, math.lcm(bq, bk))
     if s_pad != s:
         pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
@@ -187,7 +197,8 @@ def _pad_inputs(q, k, v, segment_ids, bq, bk):
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
         segment_ids = jnp.pad(segment_ids, [(0, 0), (0, s_pad - s)])
-    return q, k, v, segment_ids, s_pad
+        kv_segment_ids = jnp.pad(kv_segment_ids, [(0, 0), (0, s_pad - s)])
+    return q, k, v, segment_ids, kv_segment_ids, s_pad
 
 
 def _flash_forward(
@@ -201,6 +212,8 @@ def _flash_forward(
     interpret: bool,
     use_segments: bool = True,
     exp_dtype: str = "float32",
+    causal: bool = True,
+    kv_segment_ids: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (out (B, S, H, D), lse (B, H, S_pad, 1) f32)."""
     b, s, h, d = q.shape
@@ -210,7 +223,8 @@ def _flash_forward(
 
     bq = min(block_q, s)
     bk = min(block_k, s)
-    q, k, v, segment_ids, s_pad = _pad_inputs(q, k, v, segment_ids, bq, bk)
+    q, k, v, segment_ids, kv_segment_ids, s_pad = _pad_inputs(
+        q, k, v, segment_ids, bq, bk, kv_segment_ids)
 
     # (B, H, S, D) — heads on the grid, sequence contiguous for tiling
     qt = q.transpose(0, 2, 1, 3)
@@ -220,13 +234,15 @@ def _flash_forward(
     # dims (8, 128)-aligned or equal to the array dims — a (1, bq) block of a
     # (B, S) array satisfies neither
     seg3 = segment_ids[:, None, :]
+    kseg3 = kv_segment_ids[:, None, :]
 
     nq = pl.cdiv(s_pad, bq)
     nk = pl.cdiv(s_pad, bk)
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, seq_len=s, scale=scale,
-                          use_segments=use_segments, exp_dtype=exp_dtype),
+                          use_segments=use_segments, exp_dtype=exp_dtype,
+                          causal=causal),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -252,7 +268,7 @@ def _flash_forward(
             "parallel", "parallel", "parallel", "arbitrary"
         ),
         interpret=interpret,
-    )(qt, kt, vt, seg3, seg3)
+    )(qt, kt, vt, seg3, kseg3)
 
     return out.transpose(0, 2, 1, 3)[:, :s], lse
 
@@ -278,6 +294,7 @@ def _bwd_dq_kernel(
     scale: float,
     use_segments: bool,
     exp_dtype: str = "float32",
+    causal: bool = True,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -289,9 +306,13 @@ def _bwd_dq_kernel(
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    needed = ik * bk <= (iq + 1) * bq - 1
-    # all (q, k) pairs valid (see forward kernel): skip the mask passes
-    interior = ((ik + 1) * bk - 1 <= iq * bq) & ((ik + 1) * bk <= seq_len)
+    if causal:
+        needed = ik * bk <= (iq + 1) * bq - 1
+        # all (q, k) pairs valid (see forward kernel): skip the mask passes
+        interior = ((ik + 1) * bk - 1 <= iq * bq) & ((ik + 1) * bk <= seq_len)
+    else:
+        needed = ik * bk < seq_len
+        interior = (ik + 1) * bk <= seq_len
 
     def _update(mask):
         # storage-dtype (bf16) matmul inputs + f32 accumulation — see the
@@ -323,7 +344,9 @@ def _bwd_dq_kernel(
     @pl.when(needed & ~interior)
     def _compute_masked():
         q_pos, k_pos = _block_positions(iq, ik, bq, bk)
-        mask = (q_pos >= k_pos) & (k_pos < seq_len)
+        mask = k_pos < seq_len
+        if causal:
+            mask &= q_pos >= k_pos
         if use_segments:
             mask &= _segment_mask(qseg_ref, kseg_ref)
         _update(mask)
@@ -356,6 +379,7 @@ def _bwd_dkv_kernel(
     scale: float,
     use_segments: bool,
     exp_dtype: str = "float32",
+    causal: bool = True,
 ):
     ik, j = pl.program_id(2), pl.program_id(3)
     n_inner = pl.num_programs(3)   # = group * n_q_blocks
@@ -369,10 +393,15 @@ def _bwd_dkv_kernel(
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    # this q block contributes iff its last query can see the block's first key
-    needed = (iq + 1) * bq - 1 >= ik * bk
-    # all pairs causally valid AND no padded q rows: mask passes skippable
-    interior = ((ik + 1) * bk - 1 <= iq * bq) & ((iq + 1) * bq <= seq_len)
+    if causal:
+        # this q block contributes iff its last query can see the block's
+        # first key
+        needed = (iq + 1) * bq - 1 >= ik * bk
+        # all pairs causally valid AND no padded q rows: mask passes skippable
+        interior = ((ik + 1) * bk - 1 <= iq * bq) & ((iq + 1) * bq <= seq_len)
+    else:
+        needed = iq * bq < seq_len
+        interior = (iq + 1) * bq <= seq_len
 
     def _update(mask):
         # storage-dtype (bf16) matmul inputs + f32 accumulation — see the
@@ -411,7 +440,9 @@ def _bwd_dkv_kernel(
     @pl.when(needed & ~interior)
     def _compute_masked():
         q_pos, k_pos = _block_positions(iq, ik, bq, bk)
-        mask = (q_pos >= k_pos) & (q_pos < seq_len)
+        mask = q_pos < seq_len
+        if causal:
+            mask &= q_pos >= k_pos
         if use_segments:
             mask &= _segment_mask(qseg_ref, kseg_ref)
         _update(mask)
@@ -429,7 +460,8 @@ def _bwd_dkv_kernel(
 def _flash_backward(
     q, k, v, segment_ids, out, lse, g,
     *, block_q: int, block_k: int, interpret: bool, use_segments: bool = True,
-    exp_dtype: str = "float32",
+    exp_dtype: str = "float32", causal: bool = True, dlse=None,
+    kv_segment_ids=None,
 ):
     b, s, h, d = q.shape
     hkv = k.shape[2]
@@ -438,7 +470,8 @@ def _flash_backward(
 
     bq = min(block_q, s)
     bk = min(block_k, s)
-    q_p, k_p, v_p, seg_p, s_pad = _pad_inputs(q, k, v, segment_ids, bq, bk)
+    q_p, k_p, v_p, seg_p, kseg_p, s_pad = _pad_inputs(
+        q, k, v, segment_ids, bq, bk, kv_segment_ids)
     g_p = jnp.pad(g, [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]) if s_pad != s else g
     out_p = (
         jnp.pad(out, [(0, 0), (0, s_pad - s), (0, 0), (0, 0)])
@@ -455,15 +488,22 @@ def _flash_backward(
     delta = jnp.sum(
         dot.astype(jnp.float32) * outt.astype(jnp.float32), axis=-1, keepdims=True
     )  # (B, H, S_pad, 1)
+    if dlse is not None:
+        # lse cotangent: ∂lse_i/∂s_ij = p_ij, so ds_ij gains dlse_i·p_ij —
+        # which is exactly ds = p·(dp − (delta − dlse)). Folding it into
+        # delta means the backward kernels need no change at all.
+        delta = delta - dlse
 
     seg3 = seg_p[:, None, :]  # (B, 1, S_pad) — see _flash_forward
+    kseg3 = kseg_p[:, None, :]
 
     nq = pl.cdiv(s_pad, bq)
     nk = pl.cdiv(s_pad, bk)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, seq_len=s, scale=scale,
-                          use_segments=use_segments, exp_dtype=exp_dtype),
+                          use_segments=use_segments, exp_dtype=exp_dtype,
+                          causal=causal),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -482,7 +522,7 @@ def _flash_backward(
             "parallel", "parallel", "parallel", "arbitrary"
         ),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta, seg3, seg3)
+    )(qt, kt, vt, dot, lse, delta, seg3, kseg3)
 
     # dK/dV: grid over KV heads; each instance owns one key block and the
     # inner dimension sweeps (group member, q block), so the GQA group sum
@@ -490,7 +530,7 @@ def _flash_backward(
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, n_q_blocks=nq, seq_len=s, scale=scale,
-            use_segments=use_segments, exp_dtype=exp_dtype,
+            use_segments=use_segments, exp_dtype=exp_dtype, causal=causal,
         ),
         grid=(b, hkv, nk, group * nq),
         in_specs=[
@@ -531,7 +571,7 @@ def _flash_backward(
             "parallel", "parallel", "parallel", "arbitrary"
         ),
         interpret=interpret,
-    )(kt, vt, qt, dot, lse, delta, seg3, seg3)
+    )(kt, vt, qt, dot, lse, delta, kseg3, seg3)
 
     dq = dq.transpose(0, 2, 1, 3)[:, :s]
     dk = dk.transpose(0, 2, 1, 3)[:, :s].astype(k.dtype)
@@ -585,6 +625,97 @@ def _flash_bwd(block_q, block_k, interpret, use_segments, exp_dtype,
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# --- (out, lse) variant — the ring-attention inner kernel -------------------
+#
+# Ring attention merges per-step partial attention results across hops via
+# their per-row logsumexp, so the kernel must EXPOSE lse as a differentiable
+# output. Its cotangent folds into the backward's delta (see
+# _flash_backward), keeping one backward implementation for both variants.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_attention_lse(q, k, v, segment_ids, kv_segment_ids, block_q,
+                         block_k, interpret, use_segments, exp_dtype, causal):
+    out, lse = _flash_forward(
+        q, k, v, segment_ids, block_q=block_q, block_k=block_k,
+        interpret=interpret, use_segments=use_segments, exp_dtype=exp_dtype,
+        causal=causal, kv_segment_ids=kv_segment_ids,
+    )
+    return out, lse[:, :, : q.shape[1]]
+
+
+def _flash_lse_fwd(q, k, v, segment_ids, kv_segment_ids, block_q, block_k,
+                   interpret, use_segments, exp_dtype, causal):
+    out, lse = _flash_forward(
+        q, k, v, segment_ids, block_q=block_q, block_k=block_k,
+        interpret=interpret, use_segments=use_segments, exp_dtype=exp_dtype,
+        causal=causal, kv_segment_ids=kv_segment_ids,
+    )
+    res_out = checkpoint_name(out, "flash_out")
+    res_lse = checkpoint_name(lse, "flash_lse")
+    return (out, lse[:, :, : q.shape[1]]), (
+        q, k, v, segment_ids, kv_segment_ids, res_out, res_lse,
+    )
+
+
+def _flash_lse_bwd(block_q, block_k, interpret, use_segments, exp_dtype,
+                   causal, residuals, g):
+    g_out, g_lse = g
+    q, k, v, segment_ids, kv_segment_ids, out, lse = residuals
+    s_pad = lse.shape[2]
+    dlse = g_lse.astype(jnp.float32)
+    if dlse.shape[2] != s_pad:
+        dlse = jnp.pad(
+            dlse, [(0, 0), (0, 0), (0, s_pad - dlse.shape[2]), (0, 0)]
+        )
+    dq, dk, dv = _flash_backward(
+        q, k, v, segment_ids, out, lse, g_out,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        use_segments=use_segments, exp_dtype=exp_dtype, causal=causal,
+        dlse=dlse, kv_segment_ids=kv_segment_ids,
+    )
+    return dq, dk, dv, None, None
+
+
+_flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    segment_ids: jax.Array | None = None,
+    kv_segment_ids: jax.Array | None = None,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+    exp_dtype: str = "float32",
+) -> tuple[jax.Array, jax.Array]:
+    """Flash attention returning ``(out, lse)`` with ``lse`` (B, H, S, 1) f32.
+
+    ``causal=False`` computes full (bidirectional) attention — the ring
+    off-diagonal steps, where every resident key is in the query's global
+    past. ``kv_segment_ids`` (default: same as ``segment_ids``) supports the
+    ring case where the resident K/V shard carries segments from another
+    sequence shard. Both outputs are differentiable.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, _, _ = q.shape
+    use_segments = segment_ids is not None or kv_segment_ids is not None
+    if segment_ids is None:
+        segment_ids = jnp.zeros((b, s), jnp.int32)
+    if kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    return _flash_attention_lse(
+        q, k, v, segment_ids.astype(jnp.int32),
+        kv_segment_ids.astype(jnp.int32), block_q, block_k, interpret,
+        use_segments, exp_dtype, causal,
+    )
 
 
 def flash_attention(
