@@ -1,0 +1,69 @@
+"""Unit tests for bench.py's probe-cache and accounting helpers.
+
+The bench is the driver's only window into performance; its fallback logic
+(one bounded probe, failure-only caching) was rebuilt in round 3 after the
+round-2 probe burned 12+ minutes of driver time — pin the behavior.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location("bench_mod", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "PROBE_CACHE", str(tmp_path / "probe.json"))
+    return mod
+
+
+def test_probe_failure_cache_roundtrip(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch, tmp_path)
+    assert bench._cached_probe_failure() is False  # no file yet
+    bench._store_probe_failure()
+    assert bench._cached_probe_failure() is True
+
+
+def test_probe_failure_cache_expires(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch, tmp_path)
+    bench._store_probe_failure()
+    rec = json.loads((tmp_path / "probe.json").read_text())
+    rec["ts"] -= bench.PROBE_CACHE_TTL_S + 1
+    (tmp_path / "probe.json").write_text(json.dumps(rec))
+    assert bench._cached_probe_failure() is False  # stale verdict ignored
+
+
+def test_success_is_never_cached(monkeypatch, tmp_path):
+    """Only FAILURE verdicts cache: a cached success would skip the bounded
+    probe and let in-process init hang on a tunnel that died since."""
+    bench = _load_bench(monkeypatch, tmp_path)
+    (tmp_path / "probe.json").write_text(
+        json.dumps({"ok": True, "ts": 10**12})
+    )
+    assert bench._cached_probe_failure() is False
+
+
+def test_corrupt_cache_treated_as_no_verdict(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch, tmp_path)
+    (tmp_path / "probe.json").write_text("{not json")
+    assert bench._cached_probe_failure() is False
+
+
+def test_peak_tflops_mapping(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch, tmp_path)
+    assert bench._peak_tflops("TPU v5e") == 197.0
+    assert bench._peak_tflops("TPU v5p") == 459.0
+    assert bench._peak_tflops("TPU v5 lite") == 197.0
+    assert bench._peak_tflops("unknown accelerator") is None
+
+
+def test_jsonable_scrubs_nonfinite(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch, tmp_path)
+    out = bench._jsonable([1.0, float("nan"), float("inf")])
+    assert out[0] == 1.0 and out[1] == "nan" and out[2] == "inf"
+    json.dumps(out)  # RFC-JSON safe
