@@ -9,6 +9,9 @@
                    at the benchmark shapes by never materialising (S, S).
   * ``"ring"``   — ring attention over the ``sp`` mesh axis for long context
                    (``parallel.ring``); requires shard_map.
+  * ``"ulysses"`` — all-to-all head-sharded sequence parallelism
+                   (``parallel.ulysses``); ``sp`` must divide ``n_kv_heads``.
+                   Local kernel via ``FTC_ULYSSES_INNER`` (xla | pallas).
 
 All paths compute softmax in float32 regardless of input dtype (bf16 inputs,
 f32 accumulation — the MXU-friendly recipe).
@@ -154,4 +157,17 @@ def causal_attention(
             # no sp axis active: plain attention is both correct and faster
             return xla_causal_attention(q, k, v, segment_ids=segment_ids)
         return ring_attention_sharded(q, k, v, segment_ids=segment_ids, mesh=mesh)
+    if impl == "ulysses":
+        import os
+
+        from ..parallel.ring import get_ring_mesh
+        from ..parallel.ulysses import ulysses_attention_sharded
+
+        mesh = get_ring_mesh()
+        if mesh is None or mesh.shape.get("sp", 1) == 1:
+            return xla_causal_attention(q, k, v, segment_ids=segment_ids)
+        inner = os.environ.get("FTC_ULYSSES_INNER", "xla").strip().lower()
+        return ulysses_attention_sharded(
+            q, k, v, segment_ids=segment_ids, mesh=mesh, impl=inner
+        )
     raise ValueError(f"unknown attention impl: {impl!r}")

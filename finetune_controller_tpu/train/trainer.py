@@ -174,9 +174,13 @@ class Trainer:
     ):
         self.cfg = train_cfg
         self.mesh = mesh if mesh is not None else MeshSpec(fsdp=1).build(jax.devices()[:1])
-        if self.mesh.shape.get("sp", 1) > 1 and model_cfg.attention_impl != "ring":
+        if (
+            self.mesh.shape.get("sp", 1) > 1
+            and model_cfg.attention_impl not in ("ring", "ulysses")
+        ):
             # an active sp axis means the sequence is sharded: attention must
-            # go through the ring path or XLA would all-gather S every layer
+            # go through an SP-aware path (ring or ulysses) or XLA would
+            # all-gather S every layer
             logger.info("sp=%d mesh axis active: attention_impl -> ring",
                         self.mesh.shape["sp"])
             model_cfg = model_cfg.replace(attention_impl="ring")
