@@ -14,10 +14,11 @@ Trade-offs (why both strategies exist):
   full sequence length — best MXU shape, no per-hop merge math) at the cost
   of two all-to-alls of the activations; ring never moves Q/out but moves
   K+V (n-1) times and fragments attention into n blocks.
-* Ulysses caps at ``sp <= n_kv_heads`` (each device needs whole KV heads;
-  GQA group alignment requires ``sp | n_kv_heads``); ring has no head
-  constraint — so very long context on many chips composes them (Ulysses
-  inside a node, ring across).
+* Ulysses caps at ``sp | n_kv_heads`` (each device needs whole KV heads —
+  GQA group alignment); ring has no head constraint. A 2-level hierarchy
+  (Ulysses within a host, ring across hosts) is the natural composition for
+  very long context on many chips; this module implements the single-level
+  strategy, selected per job via ``attention_impl``.
 
 GQA alignment proof: all_to_all splits H into n contiguous chunks; chunk i
 holds q heads [i·H/n, (i+1)·H/n) and KV chunk i holds kv heads
