@@ -117,6 +117,26 @@ class Qwen2_7B_LoRA(BaseFineTuneJob):
     training_arguments: LoRASFTArguments
 
 
+class Mistral7B_LongContext_LoRA(BaseFineTuneJob):
+    """Long-context SFT: the sequence dimension sharded over an ``sp`` ring
+    (``parallel/ring.py``); 32k tokens land as 8k per chip with sp=4 on a
+    v5e-8. The 32k preset raises the RoPE base to 1e6 (the Mistral v0.2+
+    recipe) so positions past 8k stay in the trained frequency range.
+    Ulysses head-sharding (``attention_impl="ulysses"``) is the alternative
+    when sp divides the model's KV heads — see docs/performance.md."""
+
+    model_name = "mistral-7b-longctx-lora"
+    description = "Mistral-7B 32k-context LoRA SFT (ring attention over sp)"
+    task = TrainingTask.CAUSAL_LM
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "mistral-7b-32k"
+    default_device = "v5e-8"
+    promotion_path = "models/mistral-7b"
+    mesh_policy = {"sp": 4, "fsdp": -1}
+
+    training_arguments: LoRASFTArguments
+
+
 class Mistral7B_QLoRA(BaseFineTuneJob):
     """BASELINE config #3 — int4-quantized base weights, LoRA deltas."""
 
@@ -214,6 +234,7 @@ BUILTIN_JOB_SPECS: list[type[BaseFineTuneJob]] = [
     Llama3_8B_LoRA,
     Gemma7B_LoRA,
     Qwen2_7B_LoRA,
+    Mistral7B_LongContext_LoRA,
     Mistral7B_QLoRA,
     Mixtral8x7B_MoE_LoRA,
     Llava15LoRA,

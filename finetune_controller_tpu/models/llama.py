@@ -175,6 +175,14 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=32768, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192, attention_impl="auto", remat_policy="mlp",
     ),
+    # long-context variant: raised RoPE base (the Mistral v0.2+ recipe) so
+    # positions past 8k stay in the trained frequency range; exports carry
+    # the 32k max_position_embeddings
+    "mistral-7b-32k": LlamaConfig(
+        vocab_size=32768, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=32768, rope_theta=1_000_000.0,
+        attention_impl="auto", remat_policy="mlp",
+    ),
     "mixtral-8x7b": LlamaConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192, n_experts=8, moe_top_k=2,

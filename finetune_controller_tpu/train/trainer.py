@@ -184,6 +184,17 @@ class Trainer:
             logger.info("sp=%d mesh axis active: attention_impl -> ring",
                         self.mesh.shape["sp"])
             model_cfg = model_cfg.replace(attention_impl="ring")
+        if train_cfg.seq_len > getattr(model_cfg, "max_seq_len", train_cfg.seq_len):
+            # RoPE extrapolates silently but badly past the trained range,
+            # and HF exports carry max_position_embeddings = max_seq_len —
+            # downstream inference would truncate what was trained here
+            logger.warning(
+                "seq_len %d exceeds the model's max_seq_len %d: RoPE "
+                "positions run beyond the preset's trained range and the "
+                "exported max_position_embeddings stays %d — use a "
+                "long-context preset (e.g. mistral-7b-32k)",
+                train_cfg.seq_len, model_cfg.max_seq_len, model_cfg.max_seq_len,
+            )
         self.model_cfg = model_cfg
         self.rules = rules
         # Model family is selected by config type (the duck-type surface the
