@@ -249,6 +249,19 @@ def step_headline(log_path: Path) -> None:
     log_result(log_path, {"step": "headline_tinyllama_seq2048", **rec})
 
 
+def step_headline_tuned(log_path: Path, winner_env: dict[str, str]) -> None:
+    """Headline config re-measured under the kernel A/B winner — if bf16 exp
+    or a different block size wins at long sequence, check whether the
+    seq-2048 headline moves too (it may not: attention is ~15% of that
+    step)."""
+    if not winner_env:
+        print("no kernel A/B winner recorded; skipping tuned headline",
+              flush=True)
+        return
+    rec = run_bench(dict(winner_env))
+    log_result(log_path, {"step": "headline_tinyllama_seq2048_tuned", **rec})
+
+
 def step_longctx(log_path: Path, winner_env: dict[str, str]) -> None:
     rec = run_bench({"BENCH_SEQ": "8192", "BENCH_BATCH": "2", **winner_env})
     log_result(log_path, {"step": "longctx_tinyllama_seq8192", **rec})
@@ -300,12 +313,14 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default=str(REPO / "tpu_session.jsonl"))
     ap.add_argument("--only", default="",
-                    help="parity|headline|kernel_ab|longctx|families|gen7b")
+                    help="parity|headline|kernel_ab|headline_tuned|longctx|"
+                         "families|gen7b")
     args = ap.parse_args()
     log_path = Path(args.log)
 
     steps = args.only.split(",") if args.only else [
-        "parity", "headline", "kernel_ab", "longctx", "families", "gen7b"
+        "parity", "headline", "kernel_ab", "headline_tuned", "longctx",
+        "families", "gen7b"
     ]
     for step in steps:
         print(f"=== step: {step} ===", flush=True)
@@ -315,6 +330,8 @@ def main() -> int:
             step_headline(log_path)
         elif step == "kernel_ab":
             step_kernel_ab(log_path)
+        elif step == "headline_tuned":
+            step_headline_tuned(log_path, winner_from_log(log_path))
         elif step == "longctx":
             # winner comes from the log, so a --only longctx resume after a
             # tunnel drop still applies the recorded kernel_ab verdict
