@@ -273,3 +273,17 @@ def test_generate_learns_increment_task():
         temperature=0.8, top_k=5, eos_id=19, rng=jax.random.PRNGKey(1),
     )
     assert out2.shape == (1, 12)
+
+
+def test_seq_len_beyond_preset_max_warns(caplog):
+    """Training past the preset's max_seq_len silently degrades RoPE and
+    truncates the exported max_position_embeddings — warn loudly."""
+    import logging
+
+    from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=2))
+    with caplog.at_level(logging.WARNING):
+        Trainer(cfg, TrainConfig(mode="lora", batch_size=2, seq_len=256,
+                                 total_steps=1))
+    assert any("max_seq_len" in r.message for r in caplog.records)

@@ -358,3 +358,21 @@ def test_ulysses_dispatch_through_model_config(devices8):
     )
     np.testing.assert_allclose(
         np.asarray(logits_u), np.asarray(logits_ref), atol=2e-4)
+
+
+def test_ring_unknown_inner_rejected(devices8):
+    mesh = MeshSpec(dp=2, fsdp=1, sp=4).build(devices8)
+    q, k, v = _qkv(b=2, s=64)
+    with pytest.raises(ValueError, match="unknown ring inner"):
+        ring_attention_sharded(q, k, v, mesh=mesh, inner="vulkan")
+
+
+def test_ulysses_unknown_local_kernel_rejected(devices8):
+    from finetune_controller_tpu.parallel.ulysses import (
+        ulysses_attention_sharded,
+    )
+
+    mesh = MeshSpec(dp=2, fsdp=1, sp=2).build(devices8[:4])
+    q, k, v = _qkv(b=2, s=64)
+    with pytest.raises(ValueError, match="unknown ulysses local kernel"):
+        ulysses_attention_sharded(q, k, v, mesh=mesh, impl="ring")
