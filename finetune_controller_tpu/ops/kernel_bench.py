@@ -45,6 +45,21 @@ def _time_chained(fn, q, k, v, chain, iters: int, warmup: int = 2) -> float:
     return (time.perf_counter() - t0) / iters
 
 
+def _make_qkv(batch, seq, heads, kv_heads, head_dim, dtype):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (
+        jax.random.normal(kq, (batch, seq, heads, head_dim), dtype),
+        jax.random.normal(kk, (batch, seq, kv_heads, head_dim), dtype),
+        jax.random.normal(kv, (batch, seq, kv_heads, head_dim), dtype),
+    )
+
+
+def _chain_grad(grads, q_prev):
+    """Fold dQ back into the next call's q, keeping magnitudes bounded so
+    the chain can run indefinitely without overflowing."""
+    return q_prev + grads[0].astype(q_prev.dtype) * 1e-3
+
+
 def bench_attention(
     batch: int = 8,
     seq: int = 2048,
@@ -58,22 +73,15 @@ def bench_attention(
     from .attention import xla_causal_attention
     from .pallas.flash_attention import flash_attention
 
-    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
-    q = jax.random.normal(kq, (batch, seq, heads, head_dim), dtype)
-    k = jax.random.normal(kk, (batch, seq, kv_heads, head_dim), dtype)
-    v = jax.random.normal(kv, (batch, seq, kv_heads, head_dim), dtype)
+    q, k, v = _make_qkv(batch, seq, heads, kv_heads, head_dim, dtype)
 
     def loss(attn, q, k, v):
         return (attn(q, k, v).astype(jnp.float32) ** 2).mean()
 
-    # chain maps call output -> next q, keeping magnitudes bounded so the
-    # chain can run indefinitely without overflowing
     def chain_fwd(out, q_prev):
         return out
 
-    def chain_grad(grads, q_prev):
-        dq = grads[0]
-        return (q_prev + dq.astype(q_prev.dtype) * 1e-3)
+    chain_grad = _chain_grad
 
     results: dict[str, float] = {}
     for name, attn in (("xla", xla_causal_attention), ("pallas", flash_attention)):
@@ -81,6 +89,43 @@ def bench_attention(
         grad = jax.jit(jax.grad(functools.partial(loss, attn), argnums=(0, 1, 2)))
         results[f"{name}_fwd_s"] = _time_chained(fwd, q, k, v, chain_fwd, iters)
         results[f"{name}_grad_s"] = _time_chained(grad, q, k, v, chain_grad, iters)
+    return results
+
+
+def bench_flash_variants(
+    batch: int = 2,
+    seq: int = 8192,
+    heads: int = 32,
+    kv_heads: int = 4,
+    head_dim: int = 64,
+    dtype=jnp.bfloat16,
+    iters: int = 8,
+    exp_dtypes: tuple[str, ...] = ("float32", "bfloat16"),
+    blocks: tuple[int, ...] = (512, 1024),
+) -> dict[str, float]:
+    """Grad-path seconds per (exp_dtype, block) flash-kernel variant.
+
+    The long-context tuning sweep (``docs/performance.md`` knob table):
+    at head-dim 64 the kernels are VPU-bound on the S² exp, so the exp
+    dtype and block size are the two dials worth measuring. Keys are
+    ``"{exp_dtype}-b{block}"``; ``scripts/tpu_session.py`` records this on
+    real hardware and applies the winner via the ``FTC_FLASH_*`` env knobs.
+    """
+    from .pallas.flash_attention import flash_attention
+
+    q, k, v = _make_qkv(batch, seq, heads, kv_heads, head_dim, dtype)
+
+    results: dict[str, float] = {}
+    for edt in exp_dtypes:
+        for blk in blocks:
+            def loss(q, k, v, edt=edt, blk=blk):
+                o = flash_attention(
+                    q, k, v, block_q=blk, block_k=blk, exp_dtype=edt)
+                return (o.astype(jnp.float32) ** 2).mean()
+
+            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            results[f"{edt}-b{blk}"] = _time_chained(
+                grad, q, k, v, _chain_grad, iters)
     return results
 
 
@@ -104,6 +149,12 @@ def main() -> None:
     import argparse
     import json
 
+    from ..platform import assert_platform_env
+
+    # honor JAX_PLATFORMS even where a site plugin overrides it at startup
+    # (the axon-tunnel gotcha — .claude/skills/verify/SKILL.md)
+    assert_platform_env()
+
     p = argparse.ArgumentParser(prog="ftc-kernel-bench")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, nargs="*", default=[512, 1024, 2048, 4096])
@@ -111,7 +162,27 @@ def main() -> None:
     p.add_argument("--kv-heads", type=int, default=4)
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--flash-variants", action="store_true",
+                   help="sweep the flash kernel's exp-dtype x block-size "
+                        "grid instead of the impl comparison")
     args = p.parse_args()
+
+    if args.flash_variants:
+        for seq in args.seq:
+            r = bench_flash_variants(
+                batch=args.batch, seq=seq, heads=args.heads,
+                kv_heads=args.kv_heads, head_dim=args.head_dim,
+                iters=args.iters,
+            )
+            r_ms = {k: round(v * 1e3, 3) for k, v in r.items()}
+            print(json.dumps({
+                "shape": f"b{args.batch} s{seq} h{args.heads}/"
+                         f"{args.kv_heads} d{args.head_dim}",
+                "unit": "ms/call (grad)",
+                **r_ms,
+                "winner": min(r_ms, key=r_ms.get),
+            }))
+        return
 
     for seq in args.seq:
         r = bench_attention(

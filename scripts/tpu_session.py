@@ -151,32 +151,16 @@ def step_parity(log_path: Path) -> None:
 # ---------------------------------------------------------------------------
 
 KERNEL_AB_SNIPPET = r"""
-import functools, time, json
-import jax, numpy as np
-import jax.numpy as jnp
-from finetune_controller_tpu.ops.pallas.flash_attention import flash_attention
+import json
+import jax
+from finetune_controller_tpu.ops.kernel_bench import bench_flash_variants
 
 assert jax.devices()[0].platform == "tpu"
-rng = np.random.default_rng(0)
-b, s, h, hkv, d = 2, 8192, 32, 4, 64   # TinyLlama long-context shape
-q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
-k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
-v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.bfloat16)
-results = {}
-for edt in ("float32", "bfloat16"):
-    for blk in (512, 1024):
-        def loss(q, k, v):
-            o = flash_attention(q, k, v, block_q=blk, block_k=blk,
-                                interpret=False, exp_dtype=edt)
-            return jnp.sum(o.astype(jnp.float32) ** 2)
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        r = g(q, k, v); jax.block_until_ready(r)   # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(8):
-            r = g(q, k, v)
-        jax.block_until_ready(r)
-        results[f"{edt}-b{blk}"] = round((time.perf_counter() - t0) / 8 * 1e3, 2)
-print(json.dumps(results))
+# TinyLlama long-context shape (b2 h32/4 d64 seq8192), chained timing —
+# reproducible by hand: python -m finetune_controller_tpu.ops.kernel_bench
+#   --flash-variants --batch 2 --seq 8192
+results = bench_flash_variants()
+print(json.dumps({k: round(v * 1e3, 2) for k, v in results.items()}))
 """
 
 
