@@ -149,23 +149,21 @@ def causal_attention(
         return flash_attention(
             q, k, v, segment_ids=segment_ids, **flash_tuning_kwargs()
         )
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         from ..parallel.ring import get_ring_mesh, ring_attention_sharded
 
         mesh = get_ring_mesh()
         if mesh is None or mesh.shape.get("sp", 1) == 1:
             # no sp axis active: plain attention is both correct and faster
             return xla_causal_attention(q, k, v, segment_ids=segment_ids)
-        return ring_attention_sharded(q, k, v, segment_ids=segment_ids, mesh=mesh)
-    if impl == "ulysses":
+        if impl == "ring":
+            return ring_attention_sharded(
+                q, k, v, segment_ids=segment_ids, mesh=mesh
+            )
         import os
 
-        from ..parallel.ring import get_ring_mesh
         from ..parallel.ulysses import ulysses_attention_sharded
 
-        mesh = get_ring_mesh()
-        if mesh is None or mesh.shape.get("sp", 1) == 1:
-            return xla_causal_attention(q, k, v, segment_ids=segment_ids)
         inner = os.environ.get("FTC_ULYSSES_INNER", "xla").strip().lower()
         return ulysses_attention_sharded(
             q, k, v, segment_ids=segment_ids, mesh=mesh, impl=inner

@@ -81,14 +81,12 @@ def bench_attention(
     def chain_fwd(out, q_prev):
         return out
 
-    chain_grad = _chain_grad
-
     results: dict[str, float] = {}
     for name, attn in (("xla", xla_causal_attention), ("pallas", flash_attention)):
         fwd = jax.jit(functools.partial(attn))
         grad = jax.jit(jax.grad(functools.partial(loss, attn), argnums=(0, 1, 2)))
         results[f"{name}_fwd_s"] = _time_chained(fwd, q, k, v, chain_fwd, iters)
-        results[f"{name}_grad_s"] = _time_chained(grad, q, k, v, chain_grad, iters)
+        results[f"{name}_grad_s"] = _time_chained(grad, q, k, v, _chain_grad, iters)
     return results
 
 

@@ -584,55 +584,14 @@ def _flash_backward(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_attention(q, k, v, segment_ids, block_q, block_k, interpret,
-                     use_segments, exp_dtype):
-    out, _ = _flash_forward(
-        q, k, v, segment_ids,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        use_segments=use_segments, exp_dtype=exp_dtype,
-    )
-    return out
-
-
-def _flash_fwd(q, k, v, segment_ids, block_q, block_k, interpret,
-               use_segments, exp_dtype):
-    out, lse = _flash_forward(
-        q, k, v, segment_ids, block_q=block_q, block_k=block_k,
-        interpret=interpret, use_segments=use_segments, exp_dtype=exp_dtype,
-    )
-    # Named so a remat policy (models/llama.py remat_policy_fn, e.g.
-    # "mlp_flash") can SAVE these residuals: under plain per-layer remat the
-    # backward re-runs this whole forward kernel just to rebuild out/lse —
-    # ~125 ms/step of the TinyLlama bench profile. checkpoint_name inside a
-    # custom_vjp fwd is honored by save_only_these_names (verified by jaxpr:
-    # the named values move to the primal pass and the remat region consumes
-    # them as constants).
-    res_out = checkpoint_name(out, "flash_out")
-    res_lse = checkpoint_name(lse, "flash_lse")
-    return out, (q, k, v, segment_ids, res_out, res_lse)
-
-
-def _flash_bwd(block_q, block_k, interpret, use_segments, exp_dtype,
-               residuals, g):
-    q, k, v, segment_ids, out, lse = residuals
-    dq, dk, dv = _flash_backward(
-        q, k, v, segment_ids, out, lse, g,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        use_segments=use_segments, exp_dtype=exp_dtype,
-    )
-    return dq, dk, dv, None
-
-
-_flash_attention.defvjp(_flash_fwd, _flash_bwd)
-
-
-# --- (out, lse) variant — the ring-attention inner kernel -------------------
+# --- the single custom_vjp: (out, lse) ---------------------------------------
 #
-# Ring attention merges per-step partial attention results across hops via
-# their per-row logsumexp, so the kernel must EXPOSE lse as a differentiable
-# output. Its cotangent folds into the backward's delta (see
-# _flash_backward), keeping one backward implementation for both variants.
+# One vjp serves both public surfaces: the plain out-only path (a dropped
+# lse output gets a zero cotangent, and dlse=0 leaves the backward's delta
+# untouched — identical gradients) and the ring-attention inner, which
+# merges per-step partials across hops via their per-row logsumexp and
+# needs lse differentiable. The lse cotangent folds into the backward's
+# delta (see _flash_backward), keeping one backward implementation.
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
@@ -653,6 +612,13 @@ def _flash_lse_fwd(q, k, v, segment_ids, kv_segment_ids, block_q, block_k,
         interpret=interpret, use_segments=use_segments, exp_dtype=exp_dtype,
         causal=causal, kv_segment_ids=kv_segment_ids,
     )
+    # Named so a remat policy (models/llama.py remat_policy_fn, e.g.
+    # "mlp_flash") can SAVE these residuals: under plain per-layer remat the
+    # backward re-runs this whole forward kernel just to rebuild out/lse —
+    # ~125 ms/step of the TinyLlama bench profile. checkpoint_name inside a
+    # custom_vjp fwd is honored by save_only_these_names (verified by jaxpr:
+    # the named values move to the primal pass and the remat region consumes
+    # them as constants).
     res_out = checkpoint_name(out, "flash_out")
     res_lse = checkpoint_name(lse, "flash_lse")
     return (out, lse[:, :, : q.shape[1]]), (
@@ -734,15 +700,8 @@ def flash_attention(
     Default blocks are 512×512 — measured on v5e (ops/kernel_bench.py block
     sweep): grid-step overhead dominates at 128 (45.6 ms grad at the bench
     shape) while 512 hits the sweet spot (16.9 ms); 1024 is flat."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    b, s, _, _ = q.shape
-    # no segments -> the kernels statically skip every segment-mask pass
-    # (they are VPU-bound; see the interior-block note in _fwd_kernel)
-    use_segments = segment_ids is not None
-    if segment_ids is None:
-        segment_ids = jnp.zeros((b, s), jnp.int32)
-    return _flash_attention(
-        q, k, v, segment_ids.astype(jnp.int32), block_q, block_k, interpret,
-        use_segments, exp_dtype,
+    out, _ = flash_attention_with_lse(
+        q, k, v, segment_ids=segment_ids, block_q=block_q, block_k=block_k,
+        interpret=interpret, exp_dtype=exp_dtype,
     )
+    return out
