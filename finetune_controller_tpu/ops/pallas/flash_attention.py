@@ -44,6 +44,37 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
+# Default block size, measured on v5e (tpu_session.jsonl, 2026-07-31): the
+# seq-8192 grad-path A/B ran 64.5 ms at block 512 vs 49.1 ms at block 1024
+# with f32 exp held fixed (−24% — fewer grid steps, same VMEM residency).
+# The full TinyLlama seq-2048 train step gained +8% end-to-end from block
+# 1024 and bf16 exp TOGETHER (no block-only train-step measurement exists).
+# Capped to the sequence length at call time, so short-sequence callers are
+# unaffected.
+DEFAULT_BLOCK = 1024
+
+
+def _resolve_tuning(
+    q, block_q: int | None, block_k: int | None, exp_dtype: str | None
+) -> tuple[int, int, str]:
+    """Fill unset tuning knobs with the measured TPU defaults.
+
+    ``exp_dtype=None`` follows the input dtype: bf16 Q/K/V get the bf16 exp
+    path — p is about to be rounded to bf16 for the MXU anyway
+    (``p.astype(v.dtype)``), so computing exp in bf16 after the f32
+    max-subtract adds <0.4% relative error to an already-bf16-rounded
+    quantity and measured −10% on the seq-8192 grad path (tpu_session.jsonl
+    kernel A/B: bf16-b1024 44.3 ms vs f32-b1024 49.1 ms). Full-precision
+    inputs keep the f32 exp — the numerics oracle is untouched.
+    """
+    if block_q is None:
+        block_q = DEFAULT_BLOCK
+    if block_k is None:
+        block_k = DEFAULT_BLOCK
+    if exp_dtype is None:
+        exp_dtype = "bfloat16" if q.dtype == jnp.bfloat16 else "float32"
+    return block_q, block_k, exp_dtype
+
 
 def _dimension_semantics(*sem):
     return pltpu.CompilerParams(dimension_semantics=sem)
@@ -656,10 +687,10 @@ def flash_attention_with_lse(
     segment_ids: jax.Array | None = None,
     kv_segment_ids: jax.Array | None = None,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
-    exp_dtype: str = "float32",
+    exp_dtype: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Flash attention returning ``(out, lse)`` with ``lse`` (B, H, S, 1) f32.
 
@@ -668,9 +699,13 @@ def flash_attention_with_lse(
     past. ``kv_segment_ids`` (default: same as ``segment_ids``) supports the
     ring case where the resident K/V shard carries segments from another
     sequence shard. Both outputs are differentiable.
+
+    Unset ``block_q``/``block_k``/``exp_dtype`` resolve to the measured TPU
+    defaults (see :func:`_resolve_tuning`).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    block_q, block_k, exp_dtype = _resolve_tuning(q, block_q, block_k, exp_dtype)
     b, s, _, _ = q.shape
     use_segments = segment_ids is not None or kv_segment_ids is not None
     if segment_ids is None:
@@ -690,16 +725,20 @@ def flash_attention(
     v: jax.Array,
     *,
     segment_ids: jax.Array | None = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
-    exp_dtype: str = "float32",
+    exp_dtype: str | None = None,
 ) -> jax.Array:
     """Causal GQA flash attention. Shapes as ``ops.attention.causal_attention``.
 
-    Default blocks are 512×512 — measured on v5e (ops/kernel_bench.py block
-    sweep): grid-step overhead dominates at 128 (45.6 ms grad at the bench
-    shape) while 512 hits the sweet spot (16.9 ms); 1024 is flat."""
+    Unset tuning knobs resolve to the measured v5e winners (1024-token
+    blocks; exp dtype follows the input dtype — ``_resolve_tuning``). The
+    earlier 512 default came from a kernel-only sweep where 512→1024 was
+    flat at seq 2048; the 2026-07-31 session measured block 1024 −24% on
+    the seq-8192 grad path (f32 exp held fixed) and the combined winner
+    (block 1024 + bf16 exp) +8% on the full seq-2048 train step, so these
+    are the defaults (blocks are capped to S at call time)."""
     out, _ = flash_attention_with_lse(
         q, k, v, segment_ids=segment_ids, block_q=block_q, block_k=block_k,
         interpret=interpret, exp_dtype=exp_dtype,

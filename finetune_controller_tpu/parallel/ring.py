@@ -168,13 +168,10 @@ def _ring_attention_local_flash(
     i = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
 
-    tuning = flash_tuning_kwargs()  # FTC_FLASH_BLOCK_Q/K, FTC_FLASH_EXP_DTYPE
-    flash = partial(
-        flash_attention_with_lse,
-        block_q=min(tuning.pop("block_q", 512), s_local),
-        block_k=min(tuning.pop("block_k", 512), s_local),
-        **tuning,
-    )
+    # FTC_FLASH_BLOCK_Q/K, FTC_FLASH_EXP_DTYPE; unset knobs resolve to the
+    # measured defaults inside the kernel (_resolve_tuning), which also caps
+    # blocks to the per-hop length
+    flash = partial(flash_attention_with_lse, **flash_tuning_kwargs())
     # segmentless corpora must not pay the per-interior-block segment-mask
     # VPU pass — the kernel compiles it out when given no segment ids
     qseg = segment_ids if have_segments else None

@@ -80,6 +80,35 @@ def test_flash_attention_uneven_blocks():
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+def test_flash_tuning_defaults_resolution():
+    """Unset knobs resolve to the measured TPU winners (block 1024; exp
+    dtype following the input dtype — tpu_session.jsonl kernel A/B)."""
+    from finetune_controller_tpu.ops.pallas.flash_attention import (
+        DEFAULT_BLOCK,
+        _resolve_tuning,
+    )
+
+    q_bf16 = jnp.zeros((1, 8, 1, 4), jnp.bfloat16)
+    q_f32 = jnp.zeros((1, 8, 1, 4), jnp.float32)
+    assert DEFAULT_BLOCK == 1024
+    assert _resolve_tuning(q_bf16, None, None, None) == (
+        DEFAULT_BLOCK, DEFAULT_BLOCK, "bfloat16")
+    assert _resolve_tuning(q_f32, None, None, None) == (
+        DEFAULT_BLOCK, DEFAULT_BLOCK, "float32")
+    # explicit values always win over the defaults
+    assert _resolve_tuning(q_bf16, 256, 128, "float32") == (256, 128, "float32")
+
+
+def test_flash_attention_bf16_default_exp_matches_xla():
+    """bf16 inputs take the bf16-exp path by default; parity vs the f32-exp
+    XLA oracle stays within bf16 rounding noise."""
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    ref = xla_causal_attention(q, k, v)
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    np.testing.assert_allclose(
+        out.astype(np.float32), ref.astype(np.float32), atol=3e-2)
+
+
 def test_dispatcher_pallas_path():
     q, k, v = _qkv(s=32)
     out = causal_attention(q, k, v, impl="pallas")
