@@ -194,12 +194,17 @@ def main() -> None:
     #   qlora          — config #3 (int4 frozen base; a 7B fits one v5e chip)
     #   mm             — config #5 (LLaVA multimodal SFT; int4 text tower +
     #                    bf16 ViT — that combination fits one chip)
+    #   moe            — config #4 proxy (Mixtral-architecture 8-expert top-2
+    #                    at single-chip scale, bf16 frozen base; MFU uses
+    #                    active_param_count so idle experts earn no credit)
     mode = os.environ.get("BENCH_MODE", "lora").strip().lower()
     qlora = mode == "qlora"
     mm = mode == "mm"
+    moe = mode == "moe"
     if tiny:
         preset = os.environ.get(
-            "BENCH_PRESET", "tiny-mm-test" if mm else "tiny-test"
+            "BENCH_PRESET",
+            "tiny-mm-test" if mm else ("tiny-moe-test" if moe else "tiny-test"),
         )
         batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
         seq = int(os.environ.get("BENCH_SEQ", "128"))
@@ -211,6 +216,12 @@ def main() -> None:
         # seq = TEXT tokens; the decoder additionally attends the 576-patch
         # image prefix, which the FLOP accounting below includes
         seq = int(os.environ.get("BENCH_SEQ", "1472"))
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        lora = LoRAConfig(rank=16)
+    elif moe:
+        preset = os.environ.get("BENCH_PRESET", "mixtral-proxy")
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq = int(os.environ.get("BENCH_SEQ", "2048"))
         steps = int(os.environ.get("BENCH_STEPS", "10"))
         lora = LoRAConfig(rank=16)
     else:
@@ -328,7 +339,9 @@ def main() -> None:
         )
         flops_per_token = flops_per_step / tokens_per_step
     else:
-        flops_per_token = 6.0 * model_cfg.param_count()
+        # active_param_count == param_count on dense configs; on MoE it
+        # counts the router + top-k experts a token actually runs through
+        flops_per_token = 6.0 * model_cfg.active_param_count()
     # --- plausibility guard, platform-independent: no single chip of any ---
     # known kind sustains more than the best published peak; a figure above
     # that is a measurement bug (e.g. an async runtime making steps look
@@ -364,7 +377,7 @@ def main() -> None:
     else:
         target = CPU_FALLBACK_TARGET_TOKENS_PER_SEC
 
-    kind = "qlora" if qlora else ("mm_lora" if mm else "lora")
+    kind = "qlora" if qlora else ("mm_lora" if mm else ("moe_lora" if moe else "lora"))
     print(json.dumps({
         "metric": f"{kind}_sft_tokens_per_sec_per_chip"
                   f"[{preset},bs{batch},seq{seq}]",

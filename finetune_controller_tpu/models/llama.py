@@ -140,17 +140,35 @@ class LlamaConfig:
     def replace(self, **kw) -> "LlamaConfig":
         return dataclasses.replace(self, **kw)
 
-    def param_count(self) -> int:
-        d, v, f, L = self.d_model, self.vocab_size, self.d_ff, self.n_layers
+    def _count_with_mlp(self, mlp: int) -> int:
+        d, v, L = self.d_model, self.vocab_size, self.n_layers
         hd = self.head_dim
         qo = 2 * d * self.n_heads * hd
         kv = 2 * d * self.n_kv_heads * hd
+        per_layer = qo + kv + mlp + 2 * d
+        return v * d + L * per_layer + d + (0 if self.tie_embeddings else d * v)
+
+    def param_count(self) -> int:
+        """Total stored parameters (MoE: ALL experts)."""
+        d, f = self.d_model, self.d_ff
         if self.n_experts:
             mlp = self.n_experts * 3 * d * f + d * self.n_experts
         else:
             mlp = 3 * d * f
-        per_layer = qo + kv + mlp + 2 * d
-        return v * d + L * per_layer + d + (0 if self.tie_embeddings else d * v)
+        return self._count_with_mlp(mlp)
+
+    def active_param_count(self) -> int:
+        """Parameters one token's forward actually touches — for MoE, the
+        router plus ``moe_top_k`` of ``n_experts`` experts; equal to
+        :meth:`param_count` on dense configs.  MFU/FLOP accounting must use
+        this (6·N_active per token): counting idle experts would credit the
+        chip with matmuls it never ran."""
+        d, f = self.d_model, self.d_ff
+        if self.n_experts:
+            mlp = self.moe_top_k * 3 * d * f + d * self.n_experts
+        else:
+            mlp = 3 * d * f
+        return self._count_with_mlp(mlp)
 
 
 # Architecture presets for the BASELINE.md configs (shapes per the public
@@ -187,6 +205,17 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192, n_experts=8, moe_top_k=2,
         attention_impl="auto",
+    ),
+    # single-chip proxy for BASELINE #4: Mixtral-8x7b needs the v5p-64 slice
+    # (47B params), so — like the Llama-3-8B QLoRA proxy for BASELINE #2 —
+    # the measurable stand-in keeps the exact architecture (8 experts, top-2
+    # GShard dispatch/combine, Mixtral head_dim 128) at a scale whose bf16
+    # frozen base (~3.6B total, ~1.1B active/token) fits one v5e chip next
+    # to LoRA state and remat'd activations
+    "mixtral-proxy": LlamaConfig(
+        vocab_size=32000, d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+        d_ff=5632, max_seq_len=8192, n_experts=8, moe_top_k=2,
+        attention_impl="auto", remat_policy="mlp",
     ),
     # Gemma family: GeGLU MLP, (1+w) RMSNorm, sqrt(d) embed scaling, tied
     # head, head_dim 256 decoupled from d_model/n_heads (model-card shapes)

@@ -7,10 +7,17 @@ BASELINE config #4 (Mixtral 8x7B on v5p-64). The reference has no EP at all
   ``ep`` mesh axis (``parallel/sharding.py`` rules), so expert compute is a
   single batched matmul on the MXU and XLA inserts the all-to-alls when
   tokens cross expert shards;
-- GShard-style dense dispatch/combine: top-k routing with a static capacity
-  per expert — no dynamic shapes, no host round-trips, everything under one
-  ``jit``. Tokens over capacity are dropped (their combine weight is zero),
-  the standard TPU trade for static shapes;
+- top-k routing with a static capacity per expert — no dynamic shapes, no
+  host round-trips, everything under one ``jit``. Tokens over capacity are
+  dropped (their combine weight is zero), the standard TPU trade for static
+  shapes;
+- **permutation dispatch, not one-hot matmuls**: slot assignment (the
+  GShard cumsum trick) yields a unique (expert, slot) per routed pair, so
+  dispatch/combine are a small int scatter plus row gathers — the classic
+  (T, E, C) one-hot einsums cost (E·C)·T·d MACs, ~T/(3·d_ff) of the expert
+  matmuls themselves (measured: mixtral-proxy bs8 MFU 0.26 with one-hot
+  dispatch vs the matmul-free path; equivalence is pinned by
+  ``tests/test_model.py::test_moe_permutation_dispatch_matches_dense``);
 - router in float32 (softmax numerics), experts in the model compute dtype;
 - Switch-Transformer load-balancing aux loss, sown into the ``moe_aux``
   collection; the trainer folds it into the objective.
@@ -67,19 +74,35 @@ class MoEMLP(nn.Module):
         slot_major = onehot.transpose(1, 0, 2).reshape(k * t, e)    # slot 0 first
         position = jnp.cumsum(slot_major, axis=0) - slot_major      # rank within expert
         position = position.reshape(k, t, e).transpose(1, 0, 2)     # (T, k, E)
-        in_cap = (position < capacity).astype(jnp.float32) * onehot
         pos_idx = (position * onehot).sum(-1).astype(jnp.int32)     # (T, k)
 
-        # dispatch (T, E, C): one-hot of (expert, slot) per routed token
-        cap_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)  # (T, k, C)
-        dispatch = jnp.einsum("tke,tkc->tec", in_cap, cap_onehot)
-        combine = jnp.einsum("tke,tkc,tk->tec", in_cap, cap_onehot, top_w)
+        # ---- scatter/gather dispatch (no (T, E, C) one-hot matmuls) --------
+        # The classic GShard dense dispatch materialises (T, E, C) one-hot
+        # tensors and runs "tec,td->ecd" / "tec,ecd->td" einsums whose cost
+        # is (E·C)·T·d MACs — at T=8192 with C=T·cf·k/E that is ~T/(3·d_ff)
+        # of the expert matmuls themselves (~50% overhead at the
+        # mixtral-proxy bench shapes, and growing linearly with T; measured
+        # MFU collapsed 0.38 → 0.26 from bs4 → bs8). Because every routed
+        # (token, k) pair owns a UNIQUE (expert, slot), dispatch is really a
+        # permutation: scatter the 1-D token ids (cheap), then gather rows.
+        valid = pos_idx < capacity                                  # (T, k) bool
+        n_slots = e * capacity
+        # invalid pairs target index n_slots: OOB for the scatter (dropped)
+        # and exactly the appended zero row for the combine gather
+        slot = jnp.where(valid, top_idx * capacity + pos_idx, n_slots)
+        t_ids = jnp.broadcast_to(jnp.arange(t)[:, None], (t, k))
+        # empty slots keep sentinel T -> gather the appended zero row, so
+        # unfilled capacity computes on zeros exactly as the dense dispatch
+        token_of_slot = jnp.full((n_slots,), t, jnp.int32).at[
+            slot.reshape(-1)
+        ].set(t_ids.reshape(-1), mode="drop")
 
         # ---- expert compute (batched over the ep axis) ----------------------
         compute_dtype = self.dtype
-        expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch.astype(compute_dtype), xt.astype(compute_dtype)
+        xt_pad = jnp.concatenate(
+            [xt.astype(compute_dtype), jnp.zeros((1, d), compute_dtype)]
         )
+        expert_in = xt_pad[token_of_slot].reshape(e, capacity, d)
         w_gate = self.param(
             "experts_gate", nn.initializers.lecun_normal(),
             (e, d, self.d_ff), self.param_dtype,
@@ -97,9 +120,15 @@ class MoEMLP(nn.Module):
         h = nn.silu(gate) * up
         expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(compute_dtype))
 
-        out = jnp.einsum(
-            "tec,ecd->td", combine.astype(compute_dtype), expert_out
-        ).reshape(b, s, d)
+        # combine: per routed pair, gather its slot's output row (invalid
+        # pairs hit the zero row — identical to the dense combine, where
+        # their weight mass was masked) and weight by the renormed router
+        out_flat = jnp.concatenate(
+            [expert_out.reshape(n_slots, d), jnp.zeros((1, d), compute_dtype)]
+        )
+        gathered = out_flat[slot]                                   # (T, k, d)
+        out = (top_w.astype(compute_dtype)[..., None] * gathered).sum(1)
+        out = out.reshape(b, s, d)
 
         # ---- load-balancing aux loss (Switch eq. 4) -------------------------
         frac_routed = onehot.sum(1).mean(0)          # f_e: fraction per expert
