@@ -127,12 +127,14 @@ def bench_flash_variants(
     return results
 
 
-#: measured crossover (v5e, 2026-07 runs of this module at the bench shape
-#: b8 h32/4 d64, block 512): at seq 2048 the Pallas grad path is ~2.2x faster
-#: than XLA (16.9 ms vs 36.7 ms) and the S² HBM gap only widens with length;
-#: at short sequence XLA's fusions win and kernel fixed overheads dominate.
-#: The gate stays at the shortest length with direct evidence.
-PALLAS_MIN_SEQ = 2048
+#: measured crossover (v5e, 2026-07-31 run of this module at the bench shape
+#: b8 h32/4 d64, with the r3 kernel defaults — block 1024, bf16 exp):
+#: seq 512 XLA wins the grad path (8.7 ms vs 11.4); seq 1024 Pallas wins
+#: (11.1 ms vs 15.1) and the S² HBM gap only widens with length (seq 2048:
+#: 21.8 ms vs 37.2). The faster r3 defaults moved the crossover down from
+#: the 2026-07 block-512 measurement (then 2048). The gate stays at the
+#: shortest length with direct evidence of a Pallas win.
+PALLAS_MIN_SEQ = 1024
 
 
 def preferred_impl(seq_len: int, backend: str | None = None) -> str:
