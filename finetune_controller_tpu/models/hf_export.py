@@ -196,6 +196,14 @@ def export_merged_checkpoint(
         "mlp_bias": False,
         "torch_dtype": "float32",
     }
+    if cfg.rope_scaling_factor:
+        hf_config["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": cfg.rope_scaling_factor,
+            "low_freq_factor": cfg.rope_scaling_low_freq_factor,
+            "high_freq_factor": cfg.rope_scaling_high_freq_factor,
+            "original_max_position_embeddings": cfg.rope_scaling_original_max_len,
+        }
     (out_dir / "config.json").write_text(json.dumps(hf_config, indent=2))
     logger.info("wrote merged HF checkpoint (%d tensors) -> %s", len(tensors), out_dir)
     return out_dir

@@ -16,6 +16,7 @@ compute plane that replaces it.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any
 
 import flax.linen as nn
@@ -84,6 +85,16 @@ class LlamaConfig:
     n_kv_heads: int = 4
     d_ff: int = 5632
     rope_theta: float = 10000.0
+    #: llama3-style RoPE frequency scaling (the Llama-3.1/3.2 long-context
+    #: recipe; transformers ``rope_scaling: {"rope_type": "llama3"}``):
+    #: 0.0 disables. Long-wavelength components are slowed by ``factor``,
+    #: short wavelengths kept, with a smooth ramp between the two cutoff
+    #: wavelengths derived from the original training context. Parity with
+    #: transformers is pinned in tests/test_hf_import.py.
+    rope_scaling_factor: float = 0.0
+    rope_scaling_low_freq_factor: float = 1.0
+    rope_scaling_high_freq_factor: float = 4.0
+    rope_scaling_original_max_len: int = 8192
     max_seq_len: int = 2048
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
@@ -189,6 +200,20 @@ PRESETS: dict[str, LlamaConfig] = {
         d_ff=14336, rope_theta=500000.0, max_seq_len=8192, attention_impl="auto",
         remat_policy="mlp",
     ),
+    # Llama-3.2 small family: tied embeddings + llama3 RoPE scaling
+    # (factor 32 against the 8k original context -> 128k max positions)
+    "llama3.2-1b": LlamaConfig(
+        vocab_size=128256, d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        d_ff=8192, rope_theta=500000.0, max_seq_len=131072,
+        tie_embeddings=True, rope_scaling_factor=32.0,
+        attention_impl="auto", remat_policy="mlp",
+    ),
+    "llama3.2-3b": LlamaConfig(
+        vocab_size=128256, d_model=3072, n_layers=28, n_heads=24, n_kv_heads=8,
+        d_ff=8192, rope_theta=500000.0, max_seq_len=131072,
+        tie_embeddings=True, rope_scaling_factor=32.0,
+        attention_impl="auto", remat_policy="mlp",
+    ),
     "mistral-7b": LlamaConfig(
         vocab_size=32768, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192, attention_impl="auto", remat_policy="mlp",
@@ -253,11 +278,54 @@ PRESETS: dict[str, LlamaConfig] = {
 }
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding. x: (B, S, H, D), positions: (B, S)."""
+def rope_inv_freqs(cfg: "LlamaConfig") -> jax.Array:
+    """Per-pair inverse frequencies, with optional llama3-style scaling.
+
+    The scaling partitions frequency space by wavelength against the
+    original training context: wavelengths longer than
+    ``orig/low_freq_factor`` are slowed by ``factor`` (they must cover the
+    extended context), shorter than ``orig/high_freq_factor`` are kept
+    (local positional detail), and the band between interpolates smoothly —
+    matching transformers' ``_compute_llama3_parameters``.
+    """
+    half = cfg.head_dim // 2
+    freqs = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half)
+    )
+    factor = cfg.rope_scaling_factor
+    if not factor:
+        return freqs
+    orig = cfg.rope_scaling_original_max_len
+    low_f, high_f = cfg.rope_scaling_low_freq_factor, cfg.rope_scaling_high_freq_factor
+    low_wl, high_wl = orig / low_f, orig / high_f
+    wavelen = 2.0 * math.pi / freqs
+    smooth = (orig / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return jnp.where(
+        wavelen > low_wl, freqs / factor,
+        jnp.where(wavelen < high_wl, freqs, smoothed),
+    )
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float | None = None,
+    *, inv_freqs: jax.Array | None = None,
+) -> jax.Array:
+    """Rotary embedding. x: (B, S, H, D), positions: (B, S).
+
+    Pass exactly one of ``theta`` (plain schedule) or ``inv_freqs``
+    (precomputed, e.g. :func:`rope_inv_freqs` with llama3 scaling) — a
+    silently-ignored ``theta`` next to explicit frequencies would hide
+    schedule bugs.
+    """
+    if (theta is None) == (inv_freqs is None):
+        raise ValueError("pass exactly one of theta or inv_freqs")
     d = x.shape[-1]
     half = d // 2
-    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if inv_freqs is None:
+        freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    else:
+        freqs = inv_freqs
     angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, half)
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -318,8 +386,11 @@ class Attention(nn.Module):
         q = _proj(cfg, "q_proj", cfg.n_heads * hd)(x, deterministic)
         k = _proj(cfg, "k_proj", cfg.n_kv_heads * hd)(x, deterministic)
         v = _proj(cfg, "v_proj", cfg.n_kv_heads * hd)(x, deterministic)
-        q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions, cfg.rope_theta)
-        k = apply_rope(k.reshape(b, s, cfg.n_kv_heads, hd), positions, cfg.rope_theta)
+        inv_freqs = rope_inv_freqs(cfg)
+        q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions,
+                       inv_freqs=inv_freqs)
+        k = apply_rope(k.reshape(b, s, cfg.n_kv_heads, hd), positions,
+                       inv_freqs=inv_freqs)
         v = v.reshape(b, s, cfg.n_kv_heads, hd)
         if decode:
             return self._decode_attention(q, k, v, deterministic)

@@ -9,15 +9,48 @@ re-assert it through the config API after importing jax.
 
 from __future__ import annotations
 
+import logging
 import os
 
 
 def assert_platform_env() -> None:
-    """Make the ``JAX_PLATFORMS`` env var authoritative, if set."""
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
+    """Make the ``JAX_PLATFORMS`` env var authoritative, if set.
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    One carve-out: a site tunnel plugin (e.g. the axon remote-TPU plugin)
+    may expose the TPU backend under its *own* platform name while the
+    devices it serves still present ``platform == "tpu"``. Forcing the
+    literal ``"tpu"`` platform list on such a box selects the local libtpu
+    — which has no device — and backend init fails. So after honouring
+    ``JAX_PLATFORMS=tpu`` we probe device init once and, if the literal
+    name cannot initialise, restore the plugin's own resolution (which is
+    what the operator meant by "tpu" on that machine anyway).
+    """
+    requested = os.environ.get("JAX_PLATFORMS")
+    if not requested:
+        return
+    import jax
+
+    prev = jax.config.jax_platforms
+    jax.config.update("jax_platforms", requested)
+    if requested.strip().lower() == "tpu":
+        try:
+            jax.devices()
+        except RuntimeError as err:
+            jax.config.update("jax_platforms", prev)
+            # The fallback must still deliver a TPU: JAX_PLATFORMS=tpu run
+            # silently landing on CPU would produce CPU numbers labelled as
+            # TPU measurements. Let a second init failure propagate loudly.
+            if not any(d.platform == "tpu" for d in jax.devices()):
+                raise RuntimeError(
+                    "JAX_PLATFORMS=tpu: the literal 'tpu' platform failed to "
+                    f"initialise ({err}) and the site plugin's own resolution "
+                    f"({prev!r}) has no TPU device either"
+                ) from err
+            logging.getLogger(__name__).warning(
+                "JAX_PLATFORMS=tpu: literal 'tpu' backend failed to "
+                "initialise; using the site plugin's resolution %r, which "
+                "serves a TPU device", prev,
+            )
 
 
 def env_flag(name: str, default: bool = False) -> bool:

@@ -221,3 +221,42 @@ def test_gemma_merged_export_refuses(tmp_path):
     )
     with pytest.raises(NotImplementedError, match="adapter"):
         export_merged_checkpoint(cfg, variables, tmp_path / "nope")
+
+
+def test_rope_scaled_merged_export_roundtrip(tmp_path):
+    """A llama3-rope-scaled config exports its rope_scaling block, and the
+    reloaded transformers model reproduces our scaled forward — proving the
+    exported config.json reconstructs the same frequency schedule."""
+    torch = pytest.importorskip("torch")
+    import json as _json
+
+    from transformers import LlamaForCausalLM as HFModel
+
+    cfg = TINY.replace(
+        tie_embeddings=True, rope_scaling_factor=8.0,
+        rope_scaling_original_max_len=16, max_seq_len=128,
+    )
+    ours = LlamaForCausalLM(cfg)
+    variables = ours.init(
+        {"params": jax.random.PRNGKey(2)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    lora = _random_lora(variables)
+
+    merged_dir = export_merged_checkpoint(
+        cfg, {"params": variables["params"], "lora": lora}, tmp_path / "m32"
+    )
+    written = _json.loads((merged_dir / "config.json").read_text())
+    assert written["rope_scaling"] == {
+        "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0, "original_max_position_embeddings": 16,
+    }
+
+    reloaded = HFModel.from_pretrained(str(merged_dir)).eval()
+    tokens = np.random.default_rng(3).integers(0, cfg.vocab_size, (2, 48))
+    out = ours.apply(
+        {"params": variables["params"], "lora": lora},
+        jnp.asarray(tokens, jnp.int32),
+    )
+    with torch.no_grad():
+        ref = reloaded(torch.tensor(tokens)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-4, rtol=1e-3)

@@ -215,3 +215,52 @@ def test_qwen2_import_matches_transformers(tmp_path):
         ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
     out = ours.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
     np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
+
+
+def test_llama32_rope_scaling_matches_transformers(tmp_path):
+    """llama3-style RoPE scaling (Llama-3.1/3.2): our rope_inv_freqs and the
+    scaled forward must match transformers' _compute_llama3_parameters path
+    numerically. original_max_len is set BELOW the test seq len so the
+    scaled long-wavelength band is actually exercised."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM as HFModel
+
+    cfg = TINY.replace(
+        tie_embeddings=True,
+        rope_scaling_factor=8.0,
+        rope_scaling_original_max_len=16,
+        max_seq_len=128,
+    )
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        intermediate_size=cfg.d_ff, rms_norm_eps=cfg.rms_eps,
+        rope_theta=cfg.rope_theta, max_position_embeddings=cfg.max_seq_len,
+        tie_word_embeddings=True, attention_bias=False, mlp_bias=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 16,
+        },
+    )
+    hf_model = HFModel(hf_cfg).eval()
+    ckpt = tmp_path / "hf-32"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+
+    # frequency-level parity first (isolates the formula from the rest)
+    from finetune_controller_tpu.models.llama import rope_inv_freqs
+
+    ours_freqs = np.asarray(rope_inv_freqs(cfg))
+    theirs = hf_model.model.rotary_emb.inv_freq.numpy()
+    np.testing.assert_allclose(ours_freqs, theirs, rtol=1e-6)
+
+    params = load_llama_params(ckpt, cfg, dtype=jnp.float32)
+    ours = LlamaForCausalLM(cfg)
+    # positions past original_max_len, so scaling wrongness would show
+    tokens = np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 48))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    out = ours.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=1e-3)

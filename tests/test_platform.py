@@ -1,0 +1,105 @@
+"""Platform-resolution helper tests.
+
+The helper has to thread a needle: honour JAX_PLATFORMS for the CPU-mesh
+test/CI paths, but not let the literal "tpu" platform list break boxes where
+a site tunnel plugin serves the TPU under its own platform name (the axon
+gotcha — .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from finetune_controller_tpu import platform as plat
+
+
+def test_assert_platform_env_honours_cpu(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(jax.config, "update", lambda k, v: calls.append((k, v)))
+    plat.assert_platform_env()
+    assert calls == [("jax_platforms", "cpu")]
+
+
+def test_assert_platform_env_noop_when_unset(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(jax.config, "update", lambda k, v: calls.append((k, v)))
+    plat.assert_platform_env()
+    assert calls == []
+
+
+class _FakeTpuDevice:
+    platform = "tpu"
+
+
+class _FakeCpuDevice:
+    platform = "cpu"
+
+
+def test_assert_platform_env_tpu_falls_back_when_literal_init_fails(monkeypatch):
+    """On a tunnel box, forcing platforms="tpu" selects the deviceless local
+    libtpu; the helper must probe init, restore the plugin's resolution, and
+    confirm the restored resolution actually serves a TPU."""
+    import jax
+
+    calls = []
+    prev = jax.config.jax_platforms
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setattr(jax.config, "update", lambda k, v: calls.append((k, v)))
+
+    outcomes = iter(["boom", "tunnel-tpu"])
+
+    def devices():
+        if next(outcomes) == "boom":
+            raise RuntimeError("Unable to initialize backend 'tpu'")
+        return [_FakeTpuDevice()]
+
+    monkeypatch.setattr(jax, "devices", devices)
+    plat.assert_platform_env()
+    assert calls == [("jax_platforms", "tpu"), ("jax_platforms", prev)]
+
+
+def test_assert_platform_env_tpu_refuses_silent_cpu_fallback(monkeypatch):
+    """If the restored resolution has no TPU either, the helper must fail
+    loudly — a JAX_PLATFORMS=tpu run silently landing on CPU would produce
+    CPU numbers labelled as TPU measurements."""
+    import jax
+
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setattr(jax.config, "update", lambda k, v: None)
+
+    outcomes = iter(["boom", "cpu-only"])
+
+    def devices():
+        if next(outcomes) == "boom":
+            raise RuntimeError("Unable to initialize backend 'tpu'")
+        return [_FakeCpuDevice()]
+
+    monkeypatch.setattr(jax, "devices", devices)
+    with pytest.raises(RuntimeError, match="no TPU device"):
+        plat.assert_platform_env()
+
+
+def test_assert_platform_env_tpu_kept_when_init_succeeds(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setattr(jax.config, "update", lambda k, v: calls.append((k, v)))
+    monkeypatch.setattr(jax, "devices", lambda: ["fake-tpu"])
+    plat.assert_platform_env()
+    assert calls == [("jax_platforms", "tpu")]
+
+
+@pytest.mark.parametrize(
+    "raw,expect",
+    [("1", True), ("true", True), ("0", False), ("off", False), ("", False)],
+)
+def test_env_flag(monkeypatch, raw, expect):
+    monkeypatch.setenv("FTC_SOME_FLAG", raw)
+    assert plat.env_flag("FTC_SOME_FLAG") is expect
