@@ -242,6 +242,15 @@ PRESETS: dict[str, LlamaConfig] = {
         d_ff=5632, max_seq_len=8192, n_experts=8, moe_top_k=2,
         attention_impl="auto", remat_policy="mlp",
     ),
+    # the larger proxy int4 expert quantization unlocks (experts are ~95% of
+    # a Mixtral-family model's weights): ~10B total / ~3.3B active params,
+    # int4 experts ≈ 5G — fits one v5e chip where bf16 would need ~20G.
+    # Run with quantize_base=True (BENCH_MODE=qlora BENCH_PRESET=mixtral-proxy-10b)
+    "mixtral-proxy-10b": LlamaConfig(
+        vocab_size=32000, d_model=3072, n_layers=16, n_heads=24, n_kv_heads=8,
+        d_ff=8192, max_seq_len=8192, n_experts=8, moe_top_k=2,
+        attention_impl="auto", remat_policy="full",
+    ),
     # Gemma family: GeGLU MLP, (1+w) RMSNorm, sqrt(d) embed scaling, tied
     # head, head_dim 256 decoupled from d_model/n_heads (model-card shapes)
     "gemma-2b": LlamaConfig(
@@ -478,6 +487,8 @@ class Block(nn.Module):
                 capacity_factor=cfg.capacity_factor,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
+                quantize_base=cfg.quantize_base,
+                quant_block=cfg.quant_block,
                 name="moe",
             )(h, deterministic)
         else:

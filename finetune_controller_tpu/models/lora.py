@@ -59,21 +59,12 @@ class LoRADense(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         in_features = x.shape[-1]
         if self.quantize_base:
-            from .quant import dequantize_int4, quantize_int4
+            from .quant import quantized_param
 
-            # quantize ONE weight draw for both params — flax folds the param
-            # name into the rng, so separate init fns would quantize two
-            # different matrices and store mismatched values/scales
-            packed0 = scales0 = None
-            if self.is_initializing():
-                w0 = self.kernel_init(
-                    self.make_rng("params"), (in_features, self.features),
-                    jnp.float32,
-                )
-                packed0, scales0 = quantize_int4(w0, self.quant_block)
-            packed = self.param("kernel_packed", lambda _rng: packed0)
-            scales = self.param("kernel_scales", lambda _rng: scales0)
-            kernel = dequantize_int4(packed, scales, dtype=self.dtype)
+            kernel = quantized_param(
+                self, "kernel", (in_features, self.features),
+                self.kernel_init, self.quant_block, self.dtype,
+            )
             y = x @ kernel
         else:
             kernel = self.param(

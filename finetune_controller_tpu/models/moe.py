@@ -43,6 +43,12 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    #: store the stacked expert kernels as blockwise int4 (models/quant.py,
+    #: vmapped over the expert axis) — the QLoRA trade at MoE scale: experts
+    #: are ~95% of a Mixtral-family model's weights, so quantizing them is
+    #: what fits a 10B-class 8-expert model on one v5e chip
+    quantize_base: bool = False
+    quant_block: int = 64
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
@@ -103,22 +109,29 @@ class MoEMLP(nn.Module):
             [xt.astype(compute_dtype), jnp.zeros((1, d), compute_dtype)]
         )
         expert_in = xt_pad[token_of_slot].reshape(e, capacity, d)
-        w_gate = self.param(
-            "experts_gate", nn.initializers.lecun_normal(),
-            (e, d, self.d_ff), self.param_dtype,
-        )
-        w_up = self.param(
-            "experts_up", nn.initializers.lecun_normal(),
-            (e, d, self.d_ff), self.param_dtype,
-        )
-        w_down = self.param(
-            "experts_down", nn.initializers.lecun_normal(),
-            (e, self.d_ff, d), self.param_dtype,
-        )
-        gate = jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(compute_dtype))
-        up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(compute_dtype))
+        def expert_kernels(name: str, shape: tuple[int, int, int]) -> jax.Array:
+            """Stacked (E, in, out) expert kernels in the compute dtype —
+            plain params, or int4 packed+scales quantized per expert
+            (``quant.quantized_param``, shared with LoRADense)."""
+            if not self.quantize_base:
+                w = self.param(
+                    name, nn.initializers.lecun_normal(), shape, self.param_dtype
+                )
+                return w.astype(compute_dtype)
+            from .quant import quantized_param
+
+            return quantized_param(
+                self, name, shape, nn.initializers.lecun_normal(),
+                self.quant_block, compute_dtype,
+            )
+
+        w_gate = expert_kernels("experts_gate", (e, d, self.d_ff))
+        w_up = expert_kernels("experts_up", (e, d, self.d_ff))
+        w_down = expert_kernels("experts_down", (e, self.d_ff, d))
+        gate = jnp.einsum("ecd,edf->ecf", expert_in, w_gate)
+        up = jnp.einsum("ecd,edf->ecf", expert_in, w_up)
         h = nn.silu(gate) * up
-        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(compute_dtype))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)
 
         # combine: per routed pair, gather its slot's output row (invalid
         # pairs hit the zero row — identical to the dense combine, where

@@ -65,3 +65,32 @@ def dequantize_int4(
     return w.reshape(in_f, out_f).astype(dtype)
 
 
+
+
+def quantized_param(module, name: str, shape: tuple, kernel_init,
+                    quant_block: int, dtype) -> jax.Array:
+    """The quantize-one-draw-at-init param pattern, shared by ``LoRADense``
+    (dense ``kernel``) and ``MoEMLP`` (stacked ``experts_*``): quantize ONE
+    weight draw for both stored params — flax folds the param name into the
+    rng, so separate init fns would quantize two different matrices and
+    store mismatched values/scales. Leading axes (the expert axis) are
+    vmapped. Returns the dequantized kernel in ``dtype``.
+    """
+    per_matrix = len(shape) == 2
+
+    packed0 = scales0 = None
+    if module.is_initializing():
+        w0 = kernel_init(module.make_rng("params"), shape, jnp.float32)
+        if per_matrix:
+            packed0, scales0 = quantize_int4(w0, quant_block)
+        else:
+            packed0, scales0 = jax.vmap(
+                lambda w: quantize_int4(w, quant_block)
+            )(w0)
+    packed = module.param(f"{name}_packed", lambda _rng: packed0)
+    scales = module.param(f"{name}_scales", lambda _rng: scales0)
+    if per_matrix:
+        return dequantize_int4(packed, scales, dtype=dtype)
+    return jax.vmap(lambda p, s: dequantize_int4(p, s, dtype=dtype))(
+        packed, scales
+    )

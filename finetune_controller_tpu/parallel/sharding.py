@@ -83,7 +83,12 @@ LLAMA_RULES = PartitionRules(
         (r"(gate_proj|up_proj)/kernel", P(Ax.FSDP, Ax.TENSOR)),
         (r"down_proj/kernel", P(Ax.TENSOR, Ax.FSDP)),
         # MoE experts (models/moe.py): stacked (n_experts, in, out), experts
-        # over EP so expert matmuls are local and token exchange is all-to-all
+        # over EP so expert matmuls are local and token exchange is all-to-all.
+        # Int4 scales first (same tiny-block-dim reasoning as the dense
+        # kernel_scales carve-outs above): (E, in/block, out) keeps the block
+        # dim whole and shards only experts + the feature dim
+        (r"experts_(gate|up)_scales", P(Ax.EXPERT, None, Ax.TENSOR)),
+        (r"experts_down_scales", P(Ax.EXPERT, None, Ax.FSDP)),
         (r"experts_(gate|up)", P(Ax.EXPERT, Ax.FSDP, Ax.TENSOR)),
         (r"experts_down", P(Ax.EXPERT, Ax.TENSOR, Ax.FSDP)),
         (r"router_kernel", P(Ax.FSDP, None)),

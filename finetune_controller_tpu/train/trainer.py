@@ -125,18 +125,25 @@ def _adapt_loaded_params(loaded: Any, target: Any, *, quant_block: int) -> Any:
         return arr.astype(target.dtype)
     out: dict[str, Any] = {}
     loaded = dict(loaded)
-    if "kernel_packed" in target and "kernel" in loaded:
+    # quantize every kernel the target stores int4: dense projections are
+    # "kernel" -> "kernel_packed"/"kernel_scales"; stacked MoE experts are
+    # "experts_gate" -> "experts_gate_packed"/... (models/moe.py). Leading
+    # axes (scan layers, the expert axis) are vmapped generically.
+    for pk in [k for k in target if k.endswith("_packed")]:
+        stem = pk[: -len("_packed")]
+        if stem not in loaded:
+            continue  # surfaces as a missing-key error below
         from ..models.quant import quantize_int4
 
-        kernel = np.asarray(loaded.pop("kernel"), np.float32)
-        packed_t = target["kernel_packed"]
+        kernel = np.asarray(loaded.pop(stem), np.float32)
+        packed_t = target[pk]
         want = tuple(packed_t.shape[:-2]) + (
             packed_t.shape[-2] * 2, packed_t.shape[-1],
         )
         if tuple(kernel.shape) != want:
             raise ValueError(
-                f"pretrained kernel shape {tuple(kernel.shape)} != model "
-                f"{want} (pre-quantization) — config/checkpoint mismatch"
+                f"pretrained tensor {stem!r} shape {tuple(kernel.shape)} != "
+                f"model {want} (pre-quantization) — config/checkpoint mismatch"
             )
         quant = partial(quantize_int4, block_size=quant_block)
         # quantize on the CPU backend when available so a model bigger than
@@ -149,12 +156,13 @@ def _adapt_loaded_params(loaded: Any, target: Any, *, quant_block: int) -> Any:
 
             ctx = contextlib.nullcontext()
         with ctx:
-            if kernel.ndim == 3:  # layer-stacked
-                packed, scales = jax.vmap(quant)(kernel)
-            else:
-                packed, scales = quant(kernel)
-        out["kernel_packed"] = np.asarray(packed)
-        out["kernel_scales"] = np.asarray(scales)
+            lead = kernel.shape[:-2]
+            flat = kernel.reshape((-1,) + kernel.shape[-2:])
+            packed, scales = jax.vmap(quant)(flat)
+        out[pk] = np.asarray(packed).reshape(lead + packed.shape[1:])
+        out[f"{stem}_scales"] = np.asarray(scales).reshape(
+            lead + scales.shape[1:]
+        )
     for key, tv in target.items():
         if key in out:
             continue
