@@ -77,16 +77,28 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     # ---- tokenize ---------------------------------------------------------
-    # tokenizer resolution order: explicit flag, then the tokenizer the JOB
-    # trained with (dataset.tokenizer_file in resolved_config.json) — byte
-    # fallback only when the job itself trained on the byte fallback, so the
-    # prompt always lands in the vocabulary the model actually saw
-    tok_file = args.tokenizer or spec.get("dataset", {}).get("tokenizer_file")
+    # tokenizer resolution: an explicit --tokenizer always loads (and, in
+    # token-id mode, turns decode on); otherwise --prompt mode uses the
+    # tokenizer the JOB trained with (dataset.tokenizer_file in
+    # resolved_config.json) so the prompt lands in the vocabulary the model
+    # actually saw, with the byte fallback only when the job itself trained
+    # on the byte fallback. Plain token-id mode never touches the spec's
+    # tokenizer (it may be a pod-local path): ids in, ids out.
+    tok_file = args.tokenizer
+    if tok_file is None and args.prompt is not None:
+        tok_file = spec.get("dataset", {}).get("tokenizer_file")
     tokenizer = None
     if tok_file:
         from tokenizers import Tokenizer
 
-        tokenizer = Tokenizer.from_file(tok_file)
+        try:
+            tokenizer = Tokenizer.from_file(tok_file)
+        except Exception as e:
+            raise SystemExit(
+                f"could not load tokenizer {tok_file!r} ({e}) — pass "
+                "--tokenizer with a local path, or --prompt-tokens to skip "
+                "tokenization"
+            )
     if args.prompt_tokens is not None:
         ids = _parse_token_list(args.prompt_tokens)
     elif tokenizer is not None:
@@ -122,13 +134,15 @@ def main(argv: list[str] | None = None) -> int:
     except Exception as e:
         print(f"note: job mesh unavailable here ({e}); using default mesh",
               file=sys.stderr)
-    trainer = (
-        Trainer(cfg, build_train_config(spec), mesh=mesh)
-        if mesh is not None else Trainer(cfg, build_train_config(spec))
-    )
+    tcfg = build_train_config(spec)
+    trainer = Trainer(cfg, tcfg, mesh=mesh)  # mesh=None -> trainer default
     state = trainer.init_state()
     weights_dir = spec.get("model", {}).get("weights_dir")
-    if weights_dir:
+    if weights_dir and tcfg.mode != "full":
+        # in full fine-tune the checkpoint holds every weight (and this CLI
+        # requires a checkpoint) — reloading the safetensors base just to
+        # overwrite it would waste minutes at 7B; same guard as the
+        # trainer's own resume recipe
         state = trainer.load_pretrained(state, weights_dir)
     ckpt = CheckpointManager(os.path.join(args.artifacts, "checkpoints"))
     restored = ckpt.restore_latest(like=trainer.state_to_host(state))

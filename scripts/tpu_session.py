@@ -273,6 +273,24 @@ def step_new_families(log_path: Path) -> None:
                 break
 
 
+def step_moe(log_path: Path) -> None:
+    """MoE proxies (added after the 2026-07-31 tunnel drop): reproduce the
+    committed permutation-dispatch numbers (mixtral-proxy bs4 MFU 0.5374,
+    bs8 0.4912; proxy-10b int4 bs8 0.3268 — BASELINE rows 4/10) and run the
+    one probe the outage interrupted, bs8 with bf16 logits."""
+    for step, env in (
+        ("moe_proxy_bs4", {"BENCH_MODE": "moe"}),
+        ("moe_proxy_bs8_bf16logits",
+         {"BENCH_MODE": "moe", "BENCH_BATCH": "8",
+          "BENCH_LOGITS_DTYPE": "bfloat16"}),
+        ("moe_proxy10b_bs8",
+         {"BENCH_MODE": "qlora", "BENCH_PRESET": "mixtral-proxy-10b",
+          "BENCH_BATCH": "8", "BENCH_LOGITS_DTYPE": "bfloat16"}),
+    ):
+        rec = run_bench(dict(env))
+        log_result(log_path, {"step": step, **rec})
+
+
 def winner_from_log(log_path: Path) -> dict[str, str]:
     """Latest kernel_ab verdict recorded in the session log, as env vars."""
     best: dict[str, str] = {}
@@ -298,13 +316,13 @@ def main() -> int:
     ap.add_argument("--log", default=str(REPO / "tpu_session.jsonl"))
     ap.add_argument("--only", default="",
                     help="parity|headline|kernel_ab|headline_tuned|longctx|"
-                         "families|gen7b")
+                         "families|moe|gen7b")
     args = ap.parse_args()
     log_path = Path(args.log)
 
     steps = args.only.split(",") if args.only else [
         "parity", "headline", "kernel_ab", "headline_tuned", "longctx",
-        "families", "gen7b"
+        "families", "moe", "gen7b"
     ]
     for step in steps:
         print(f"=== step: {step} ===", flush=True)
@@ -325,6 +343,8 @@ def main() -> int:
             step_longctx(log_path, winner_env)
         elif step == "families":
             step_new_families(log_path)
+        elif step == "moe":
+            step_moe(log_path)
         elif step == "gen7b":
             step_gen7b(log_path)
         else:
