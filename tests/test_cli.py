@@ -98,25 +98,28 @@ def test_eval_without_heldout_split_fails_loudly(tmp_path):
         cli.run_job(spec)
 
 
+def _run_generate(argv):
+    """Invoke generate_cli.main, returning its one-line JSON output."""
+    import io
+    from contextlib import redirect_stdout
+
+    from finetune_controller_tpu.models import generate_cli
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert generate_cli.main(argv) == 0
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
 def test_generate_cli_from_artifacts(tmp_path):
     """Post-finetune generation CLI: train a tiny job, then generate from
     its artifacts dir — the resume recipe (seeded init + latest checkpoint)
     plus both token-id and byte-prompt modes, greedy determinism across
     invocations."""
-    from finetune_controller_tpu.models import generate_cli
-
     spec = _spec(tmp_path, checkpoint_every=2)
     cli.run_job(spec)
     art = str(tmp_path / "artifacts")
-
-    def run(argv):
-        import io
-        from contextlib import redirect_stdout
-
-        buf = io.StringIO()
-        with redirect_stdout(buf):
-            assert generate_cli.main(argv) == 0
-        return json.loads(buf.getvalue().strip().splitlines()[-1])
+    run = _run_generate
 
     out = run(["--artifacts", art, "--prompt-tokens", "5,6,7,8",
                "--max-new-tokens", "6"])
@@ -151,8 +154,6 @@ def test_generate_cli_uses_job_tokenizer(tmp_path):
     from tokenizers.models import WordLevel
     from tokenizers.pre_tokenizers import Whitespace
 
-    from finetune_controller_tpu.models import generate_cli
-
     vocab = {f"w{i}": i for i in range(16)}
     vocab["hello"] = 16
     vocab["[UNK]"] = 17
@@ -165,18 +166,41 @@ def test_generate_cli_uses_job_tokenizer(tmp_path):
     spec["dataset"]["tokenizer_file"] = str(tok_file)
     cli.run_job(spec)
 
-    import io
-    from contextlib import redirect_stdout
-
-    buf = io.StringIO()
-    with redirect_stdout(buf):
-        assert generate_cli.main(
-            ["--artifacts", str(tmp_path / "artifacts"), "--prompt", "hello",
-             "--max-new-tokens", "3"]
-        ) == 0
-    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    out = _run_generate(
+        ["--artifacts", str(tmp_path / "artifacts"), "--prompt", "hello",
+         "--max-new-tokens", "3"]
+    )
     # "hello" is ONE WordLevel token (id 16), not 5 byte tokens
     assert out["prompt_tokens"] == 1
     # output decodes through the same tokenizer (all ids < vocab 256 decode
     # to either known words or empty; text must be a str, not null)
     assert isinstance(out["text"], str)
+
+
+def test_generate_cli_mesh_fallback_and_full_mode(tmp_path, capsys):
+    """Two resume-recipe edges: a job mesh this host can't form falls back
+    to the default single-device mesh (with a note, not a crash), and
+    mode='full' jobs skip the pretrained-base reload (the checkpoint holds
+    every weight)."""
+    spec = _spec(tmp_path, checkpoint_every=2, mode="full", learning_rate=1e-3)
+    del spec["model"]["lora"]
+    cli.run_job(spec)
+
+    # rewrite the recorded spec: a mesh the conftest's 8 devices cannot form
+    # (-> fallback note, not a crash) and a weights_dir that would crash if
+    # the full-mode skip didn't apply
+    art_spec = json.loads(
+        (tmp_path / "artifacts" / "resolved_config.json").read_text()
+    )
+    art_spec["mesh"] = {"dp": 64}
+    art_spec["model"]["weights_dir"] = str(tmp_path / "does-not-exist")
+    (tmp_path / "artifacts" / "resolved_config.json").write_text(
+        json.dumps(art_spec)
+    )
+
+    out = _run_generate(
+        ["--artifacts", str(tmp_path / "artifacts"),
+         "--prompt-tokens", "5,6,7", "--max-new-tokens", "2"]
+    )
+    assert len(out["new_tokens"]) == 2
+    assert "job mesh unavailable" in capsys.readouterr().err
