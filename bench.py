@@ -86,6 +86,81 @@ def fail(reason: str, **diag) -> None:
 PROBE_CACHE = f"/tmp/ftc_tpu_probe_verdict_{os.getuid()}.json"  # per-user
 PROBE_CACHE_TTL_S = 900.0  # one driver/bench session, not forever
 
+# Committed raw-measurement log (scripts/tpu_session.py appends here too).
+SESSION_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tpu_session.jsonl")
+
+
+def _latest_session_tpu_record(kind_prefix: str) -> dict | None:
+    """Latest committed real-TPU bench record from tpu_session.jsonl.
+
+    Used when the live probe fails (tunnel outage): the round artifact then
+    carries the most recent chip-measured headline alongside the honest CPU
+    fallback instead of looking like a perf regression.  Prefers the newest
+    record whose metric matches the requested bench kind (``lora_``,
+    ``qlora_`` …); falls back to the newest TPU record of any kind.
+    """
+    def is_default_config(rec: dict) -> bool:
+        # the session script's headline steps, or an ad-hoc run with no
+        # shape/preset overrides — i.e. the config a plain `python bench.py`
+        # (what the driver runs) would measure, as opposed to supplementary
+        # rows like long-context seq-8192
+        if "headline" in str(rec.get("step", "")):
+            return True
+        env = rec.get("env") or {}
+        return not any(k in env for k in
+                       ("BENCH_PRESET", "BENCH_SEQ", "BENCH_BATCH"))
+
+    best_any = best_kind = best_default = None
+    try:
+        with open(SESSION_LOG) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (rec.get("error") or rec.get("fallback")
+                        or not rec.get("metric")
+                        or "tpu" not in str(rec.get("device_kind", "")).lower()):
+                    continue
+                best_any = rec  # file is append-ordered: last wins
+                if str(rec["metric"]).startswith(kind_prefix):
+                    best_kind = rec
+                    if is_default_config(rec):
+                        best_default = rec
+    except OSError:
+        return None
+    rec = best_default or best_kind or best_any
+    if rec is None:
+        return None
+    keep = ("ts", "step", "metric", "value", "unit", "vs_baseline", "mfu",
+            "step_time_avg_s", "n_chips", "device_kind", "env")
+    return {k: rec[k] for k in keep if k in rec}
+
+
+def _session_log_append(record: dict) -> None:
+    """Append a real-TPU measurement to the committed session log.
+
+    Every chip-measured bench number must exist as a raw record, however the
+    bench was invoked (driver, scripts/tpu_session.py, or an ad-hoc
+    ``BENCH_MODE=... python bench.py``) — numbers living only in BASELINE.md
+    prose have no provenance.  Disable with BENCH_SESSION_LOG=0 (the session
+    script does: it writes its own step-named records).
+    """
+    from finetune_controller_tpu.platform import env_flag
+
+    if not env_flag("BENCH_SESSION_LOG", default=True):
+        return
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(("BENCH_", "FTC_")) and k != "BENCH_SESSION_LOG"}
+    rec = {"ts": round(time.time(), 1), "step": "adhoc_bench", "env": env,
+           **record}
+    try:
+        with open(SESSION_LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        print(f"session-log append failed: {e}", file=sys.stderr)
+
 
 def _cached_probe_failure() -> bool:
     """Only FAILURE verdicts are cached: a cached success would let the
@@ -155,6 +230,13 @@ def _init_backend_with_fallback() -> None:
     env["BENCH_TINY"] = "1"
     env["BENCH_NO_CPU_FALLBACK"] = "1"
     env["BENCH_IS_FALLBACK"] = "1"
+    # the fallback leg always runs the tiny lora config, but the session-cache
+    # comparator should match the bench the user ASKED for — carry the
+    # requested kind across the re-exec before BENCH_MODE is popped
+    mode = os.environ.get("BENCH_MODE", "lora").strip().lower()
+    env["BENCH_FALLBACK_KIND"] = {
+        "qlora": "qlora", "mm": "mm_lora", "moe": "moe_lora"
+    }.get(mode, "lora")
     # TPU-sized knobs must not leak into the tiny CPU leg
     for knob in (
         "BENCH_PRESET", "BENCH_SEQ", "BENCH_BATCH", "BENCH_STEPS",
@@ -340,7 +422,13 @@ def main() -> None:
         flops_per_token = flops_per_step / tokens_per_step
     else:
         # active_param_count == param_count on dense configs; on MoE it
-        # counts the router + top-k experts a token actually runs through
+        # counts the router + top-k experts a token actually runs through.
+        # NOTE: capacity-factor padding means the expert einsums execute over
+        # e*capacity slots (≈ capacity_factor × the credited k·T rows), so
+        # executed FLOPs exceed this figure by ~capacity_factor on the expert
+        # share — MoE MFU here is a deliberate LOWER BOUND (useful-work MFU:
+        # padding slots earn no credit). Keep that in mind when tuning
+        # against these numbers.
         flops_per_token = 6.0 * model_cfg.active_param_count()
     # --- plausibility guard, platform-independent: no single chip of any ---
     # known kind sustains more than the best published peak; a figure above
@@ -378,7 +466,7 @@ def main() -> None:
         target = CPU_FALLBACK_TARGET_TOKENS_PER_SEC
 
     kind = "qlora" if qlora else ("mm_lora" if mm else ("moe_lora" if moe else "lora"))
-    print(json.dumps({
+    result = {
         "metric": f"{kind}_sft_tokens_per_sec_per_chip"
                   f"[{preset},bs{batch},seq{seq}]",
         "value": round(tok_per_sec_chip, 1),
@@ -393,7 +481,19 @@ def main() -> None:
         "device_kind": devices[0].device_kind,
         "warmup_loss_mean": round(float(np.mean(warmup_losses)), 4),
         "timed_loss_mean": round(float(np.mean(timed_losses)), 4),
-    }))
+    }
+    if on_tpu:
+        _session_log_append(result)
+    elif env_flag("BENCH_IS_FALLBACK"):
+        # Tunnel outage: surface the latest committed chip measurement so the
+        # round artifact still carries a TPU number next to the honest
+        # clearly-labelled CPU figure.
+        requested_kind = os.environ.get("BENCH_FALLBACK_KIND", kind)
+        cached = _latest_session_tpu_record(f"{requested_kind}_")
+        if cached is not None:
+            result["source"] = "cpu-fallback+session-cache"
+            result["tpu_session_cache"] = cached
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -43,6 +43,9 @@ def run_bench(env_overrides: dict[str, str], timeout: float = 1500.0) -> dict:
     env = dict(os.environ)
     env.update(env_overrides)
     env.setdefault("BENCH_NO_CPU_FALLBACK", "1")  # this session IS the probe
+    # the session writes its own step-named record below — suppress bench.py's
+    # ad-hoc auto-append so each measurement lands exactly once
+    env["BENCH_SESSION_LOG"] = "0"
     try:
         out = subprocess.run(
             [sys.executable, str(REPO / "bench.py")],

@@ -67,3 +67,66 @@ def test_jsonable_scrubs_nonfinite(monkeypatch, tmp_path):
     out = bench._jsonable([1.0, float("nan"), float("inf")])
     assert out[0] == 1.0 and out[1] == "nan" and out[2] == "inf"
     json.dumps(out)  # RFC-JSON safe
+
+
+def test_latest_session_tpu_record_prefers_kind(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch, tmp_path)
+    log = tmp_path / "session.jsonl"
+    lines = [
+        {"ts": 1, "step": "a", "metric": "lora_sft_tokens_per_sec_per_chip[x]",
+         "value": 100.0, "device_kind": "TPU v5 lite", "fallback": False},
+        {"ts": 2, "step": "b", "metric": "qlora_sft_tokens_per_sec_per_chip[y]",
+         "value": 50.0, "device_kind": "TPU v5 lite", "fallback": False},
+        # must be skipped: error record, CPU record, fallback record
+        {"ts": 3, "step": "c", "error": "oom", "metric": "lora_x"},
+        {"ts": 4, "step": "d", "metric": "lora_z", "value": 9,
+         "device_kind": "cpu", "fallback": False},
+        {"ts": 5, "step": "e", "metric": "lora_z", "value": 9,
+         "device_kind": "TPU v5 lite", "fallback": True},
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    monkeypatch.setattr(bench, "SESSION_LOG", str(log))
+    rec = bench._latest_session_tpu_record("qlora_")
+    assert rec["step"] == "b" and rec["value"] == 50.0
+    rec = bench._latest_session_tpu_record("mm_lora_")
+    assert rec["step"] == "b"  # newest TPU record of any kind
+    monkeypatch.setattr(bench, "SESSION_LOG", str(tmp_path / "absent.jsonl"))
+    assert bench._latest_session_tpu_record("lora_") is None
+
+
+def test_session_log_append_captures_env(monkeypatch, tmp_path):
+    bench = _load_bench(monkeypatch, tmp_path)
+    log = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_LOG", str(log))
+    monkeypatch.setenv("BENCH_MODE", "qlora")
+    monkeypatch.delenv("BENCH_SESSION_LOG", raising=False)
+    bench._session_log_append({"metric": "m", "value": 1.0})
+    rec = json.loads(log.read_text())
+    assert rec["step"] == "adhoc_bench"
+    assert rec["env"]["BENCH_MODE"] == "qlora"
+    assert rec["metric"] == "m" and "ts" in rec
+    # disabled via BENCH_SESSION_LOG=0 (what tpu_session.py sets)
+    monkeypatch.setenv("BENCH_SESSION_LOG", "0")
+    bench._session_log_append({"metric": "m2", "value": 2.0})
+    assert len(log.read_text().splitlines()) == 1
+
+
+def test_latest_session_prefers_newest_default_config(monkeypatch, tmp_path):
+    """A newer default-config adhoc record must beat an older headline step;
+    a non-default supplementary row (seq override) must not."""
+    bench = _load_bench(monkeypatch, tmp_path)
+    log = tmp_path / "session.jsonl"
+
+    def rec(ts, step, env=None, value=1.0):
+        return {"ts": ts, "step": step, "metric": "lora_sft[x]",
+                "value": value, "device_kind": "TPU v5 lite",
+                "fallback": False, "env": env or {}}
+
+    log.write_text("".join(json.dumps(r) + "\n" for r in [
+        rec(1, "headline_tinyllama_seq2048_tuned", value=13068.0),
+        rec(2, "adhoc_bench", env={"FTC_FLASH_BLOCK_Q": "1024"}, value=14000.0),
+        rec(3, "adhoc_bench", env={"BENCH_SEQ": "8192"}, value=8000.0),
+    ]))
+    monkeypatch.setattr(bench, "SESSION_LOG", str(log))
+    picked = bench._latest_session_tpu_record("lora_")
+    assert picked["ts"] == 2 and picked["value"] == 14000.0
