@@ -56,13 +56,19 @@ class Settings(BaseModel):
     state_backend: str = "sqlite"
 
     # --- Object store (reference: S3 buckets, app/core/config.py:53-58) ---
-    #: "local" (filesystem root, hermetic CI) | "gcs" (cloud buckets)
+    #: "local" (filesystem root, hermetic CI) | "gcs" | "s3" (cloud buckets)
     object_store_backend: str = "local"
     object_store_root: str = "~/.finetune_controller_tpu/objects"
     #: GCS: endpoint override (fake server in tests) + real-bucket prefix so
     #: one project hosts the datasets/artifacts/deploy logical buckets
     gcs_endpoint: str = "https://storage.googleapis.com"
     gcs_bucket_prefix: str = ""
+    #: S3: endpoint/region (MinIO-style gateways and the test fake override
+    #: the endpoint); creds ride AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY —
+    #: the env contract the reference's k8s Secret fills (config.py:59-90)
+    s3_endpoint: str = "https://s3.amazonaws.com"
+    s3_region: str = "us-east-1"
+    s3_bucket_prefix: str = ""
     datasets_bucket: str = "datasets"
     artifacts_bucket: str = "artifacts"
     deploy_bucket: str = "deploy"

@@ -148,6 +148,55 @@ class ObjectStore:
         return len(objs)
 
 
+class HttpObjectStore(ObjectStore):
+    """Shared aiohttp plumbing for cloud backends (GCS/S3): lazy session with
+    one timeout policy, chunked download-to-file with atomic rename, ISO-8601
+    mtime parsing.  One copy so a fix lands in every cloud engine."""
+
+    chunk_size: int = 1 << 20
+
+    def __init__(self):
+        self._session = None
+
+    async def session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def get_file(self, uri: str, dest: Path | str) -> int:
+        dest_p = Path(dest)
+        dest_p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest_p.with_name(dest_p.name + ".tmp")
+        total = 0
+        try:
+            with tmp.open("wb") as f:
+                async for chunk in self.get_chunks(uri, self.chunk_size):
+                    total += len(chunk)
+                    await asyncio.to_thread(f.write, chunk)
+            tmp.replace(dest_p)
+        except BaseException:
+            tmp.unlink(missing_ok=True)
+            raise
+        return total
+
+    @staticmethod
+    def parse_iso_mtime(text: str) -> float:
+        try:
+            return __import__("datetime").datetime.fromisoformat(
+                text.replace("Z", "+00:00")
+            ).timestamp()
+        except ValueError:
+            return 0.0
+
+
 class LocalObjectStore(ObjectStore):
     """Filesystem-backed store rooted at ``root/<bucket>/<key>``."""
 
@@ -280,9 +329,10 @@ class LocalObjectStore(ObjectStore):
 
 
 def build_object_store(settings) -> ObjectStore:
-    """Object-store factory from settings: ``local`` (hermetic CI) or ``gcs``
-    (cloud buckets over aiohttp — ``controller.gcs``). The seam the reference
-    hardwires to aioboto3 (``S3Handler.py:12,25``)."""
+    """Object-store factory from settings: ``local`` (hermetic CI), ``gcs``
+    (``controller.gcs``), or ``s3`` (``controller.s3`` — SigV4 over aiohttp,
+    the layout-compatible migration path off the reference). The seam the
+    reference hardwires to aioboto3 (``S3Handler.py:12,25``)."""
     backend = getattr(settings, "object_store_backend", "local")
     if backend == "local":
         return LocalObjectStore(settings.object_store_path)
@@ -292,6 +342,14 @@ def build_object_store(settings) -> ObjectStore:
         return GCSObjectStore(
             endpoint=settings.gcs_endpoint,
             bucket_prefix=settings.gcs_bucket_prefix,
+        )
+    if backend == "s3":
+        from .s3 import S3ObjectStore
+
+        return S3ObjectStore(
+            endpoint=settings.s3_endpoint,
+            region=settings.s3_region,
+            bucket_prefix=settings.s3_bucket_prefix,
         )
     raise ValueError(f"unknown object_store_backend {backend!r}")
 

@@ -1,0 +1,448 @@
+"""S3 object store — SigV4 over aiohttp behind the ``ObjectStore`` seam.
+
+The reference is S3-native end to end: aioboto3 in the API process
+(``app/utils/S3Handler.py:12-25``) and ``amazon/aws-cli`` init/sidecar
+containers moving the heavy bytes (``PyTorchJobDeployer.py:74,142``).  This
+engine restores that parity for deployments migrating off the reference —
+same ``finetune_jobs/{user}/{job}/{dataset|artifacts}`` layout
+(``S3Handler.py:46-71``) — without an SDK: request signing is ~80 lines of
+stdlib SigV4 (RFC-style canonical request + HMAC chain), transport is the
+same aiohttp session pattern as the GCS engine, and the endpoint is
+injectable so the whole surface runs hermetically against an in-process fake
+that *re-verifies every signature* (``tests/test_s3.py``) — the reference's
+S3 path has zero tests.
+
+Auth: an injectable async credentials provider; the default reads
+``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY`` / ``AWS_SESSION_TOKEN``
+(the same env contract the reference's k8s Secret populates,
+``app/core/config.py:59-90``).
+
+Uploads: single signed PUT up to ``multipart_threshold``; S3 multipart
+(Create/UploadPart/Complete) above it, so multi-GB checkpoint shards don't
+buffer in memory.  Unknown-length streams spool through a temp file first —
+S3 requires a Content-Length per request (aws-chunked streaming signatures
+are deliberately out of scope).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import hashlib
+import hmac
+import os
+import tempfile
+import urllib.parse
+import xml.etree.ElementTree as ET
+from pathlib import Path
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from .objectstore import ObjectStore, build_uri, parse_uri
+
+EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+UNSIGNED = "UNSIGNED-PAYLOAD"
+
+#: (access_key, secret_key, session_token or None)
+CredsFn = Callable[[], Awaitable[tuple[str, str, str | None]]]
+
+
+async def env_credentials() -> tuple[str, str, str | None]:
+    try:
+        return (
+            os.environ["AWS_ACCESS_KEY_ID"],
+            os.environ["AWS_SECRET_ACCESS_KEY"],
+            os.environ.get("AWS_SESSION_TOKEN"),
+        )
+    except KeyError as e:
+        raise RuntimeError(
+            "S3 backend needs AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY in the "
+            "environment (or an injected credentials provider)"
+        ) from e
+
+
+def _uri_encode(s: str, *, encode_slash: bool) -> str:
+    """AWS canonical URI/query encoding: unreserved chars per RFC 3986 only."""
+    safe = "-._~" + ("" if encode_slash else "/")
+    return urllib.parse.quote(s, safe=safe)
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(
+    method: str,
+    host: str,
+    path: str,
+    query: list[tuple[str, str]],
+    *,
+    payload_hash: str,
+    access_key: str,
+    secret_key: str,
+    session_token: str | None = None,
+    region: str = "us-east-1",
+    service: str = "s3",
+    amz_date: str | None = None,
+    extra_headers: dict[str, str] | None = None,
+    include_content_sha: bool = True,
+) -> dict[str, str]:
+    """Compute the signed header set for one request (AWS SigV4).
+
+    Pure function of its inputs (``amz_date`` injectable) so tests can pin
+    the official AWS known-answer vectors and the in-process fake server can
+    re-derive and verify every signature.
+    """
+    now = amz_date or datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ"
+    )
+    datestamp = now[:8]
+    headers = {
+        "host": host,
+        "x-amz-date": now,
+        **{k.lower(): v for k, v in (extra_headers or {}).items()},
+    }
+    if include_content_sha:
+        # S3 requires the payload hash as a signed header; other services
+        # (e.g. the AWS docs' iam known-answer vector) omit it
+        headers["x-amz-content-sha256"] = payload_hash
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed_names = sorted(headers)
+    canonical_headers = "".join(
+        f"{k}:{' '.join(headers[k].split())}\n" for k in signed_names
+    )
+    canonical_query = "&".join(
+        f"{_uri_encode(k, encode_slash=True)}={_uri_encode(v, encode_slash=True)}"
+        for k, v in sorted(query)
+    )
+    canonical_request = "\n".join(
+        [
+            method,
+            _uri_encode(path, encode_slash=False) or "/",
+            canonical_query,
+            canonical_headers,
+            ";".join(signed_names),
+            payload_hash,
+        ]
+    )
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            now,
+            scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ]
+    )
+    key = _hmac(
+        _hmac(_hmac(_hmac(f"AWS4{secret_key}".encode(), datestamp), region), service),
+        "aws4_request",
+    )
+    signature = hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    headers["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed_names)}, Signature={signature}"
+    )
+    return headers
+
+
+def _xml_find_all(root: ET.Element, tag: str) -> list[ET.Element]:
+    """Namespace-agnostic child lookup (S3 responses carry a default ns)."""
+    return [el for el in root.iter() if el.tag.split("}")[-1] == tag]
+
+
+def _xml_text(el: ET.Element, tag: str, default: str = "") -> str:
+    for child in el:
+        if child.tag.split("}")[-1] == tag:
+            return child.text or default
+    return default
+
+
+class S3ObjectStore(ObjectStore):
+    """S3 REST-API object store (reference: ``S3Handler``, redesigned).
+
+    Path-style addressing (``{endpoint}/{bucket}/{key}``) so it works against
+    AWS, MinIO-style gateways, and the in-process test fake alike.
+    """
+
+    def __init__(
+        self,
+        *,
+        endpoint: str = "https://s3.amazonaws.com",
+        region: str = "us-east-1",
+        creds_fn: CredsFn | None = None,
+        bucket_prefix: str = "",
+        chunk_size: int = 1 << 20,
+        multipart_threshold: int = 64 << 20,
+        part_size: int = 32 << 20,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.region = region
+        self._creds_fn = creds_fn or env_credentials
+        #: optional real-bucket prefix, same convention as the GCS engine
+        self.bucket_prefix = bucket_prefix
+        self.chunk_size = chunk_size
+        self.multipart_threshold = multipart_threshold
+        self.part_size = part_size
+        self._session = None
+        self._host = urllib.parse.urlparse(self.endpoint).netloc
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _path(self, uri: str) -> str:
+        bucket, key = parse_uri(uri)
+        return f"/{self.bucket_prefix}{bucket}/{key}" if key else (
+            f"/{self.bucket_prefix}{bucket}"
+        )
+
+    async def session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _request(
+        self,
+        method: str,
+        path: str,
+        *,
+        query: list[tuple[str, str]] | None = None,
+        data: bytes | None = None,
+        payload_hash: str | None = None,
+        extra_headers: dict[str, str] | None = None,
+    ):
+        """Sign + send; returns the aiohttp response context manager."""
+        query = query or []
+        if payload_hash is None:
+            payload_hash = (
+                hashlib.sha256(data).hexdigest() if data else EMPTY_SHA256
+            )
+        access_key, secret_key, token = await self._creds_fn()
+        headers = sigv4_headers(
+            method,
+            self._host,
+            path,
+            query,
+            payload_hash=payload_hash,
+            access_key=access_key,
+            secret_key=secret_key,
+            session_token=token,
+            region=self.region,
+            extra_headers=extra_headers,
+        )
+        url = f"{self.endpoint}{_uri_encode(path, encode_slash=False)}"
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        session = await self.session()
+        return session.request(method, url, data=data, headers=headers)
+
+    # -- ObjectStore interface -----------------------------------------------
+
+    async def put_bytes(self, uri: str, data: bytes) -> None:
+        async with await self._request("PUT", self._path(uri), data=data) as resp:
+            if resp.status >= 300:
+                raise IOError(
+                    f"S3 put failed ({resp.status}): {await resp.text()}"
+                )
+
+    async def put_file(self, uri: str, path: Path | str) -> None:
+        p = Path(path)
+        size = p.stat().st_size
+        if size <= self.multipart_threshold:
+            await self.put_bytes(uri, await asyncio.to_thread(p.read_bytes))
+            return
+        await self._multipart_upload(uri, p, size)
+
+    async def _multipart_upload(self, uri: str, p: Path, size: int) -> None:
+        path = self._path(uri)
+        async with await self._request(
+            "POST", path, query=[("uploads", "")]
+        ) as resp:
+            if resp.status >= 300:
+                raise IOError(f"S3 create-multipart failed ({resp.status})")
+            body = await resp.read()
+        upload_id = _xml_text(ET.fromstring(body), "UploadId")
+        if not upload_id:
+            raise IOError("S3 create-multipart returned no UploadId")
+        etags: list[str] = []
+        try:
+            with p.open("rb") as f:
+                part = 1
+                while True:
+                    chunk = await asyncio.to_thread(f.read, self.part_size)
+                    if not chunk:
+                        break
+                    async with await self._request(
+                        "PUT",
+                        path,
+                        query=[("partNumber", str(part)), ("uploadId", upload_id)],
+                        data=chunk,
+                    ) as resp:
+                        if resp.status >= 300:
+                            raise IOError(
+                                f"S3 upload-part {part} failed ({resp.status})"
+                            )
+                        etags.append(resp.headers.get("ETag", ""))
+                    part += 1
+            complete = "".join(
+                f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{etag}</ETag></Part>"
+                for i, etag in enumerate(etags)
+            )
+            payload = (
+                f"<CompleteMultipartUpload>{complete}</CompleteMultipartUpload>"
+            ).encode()
+            async with await self._request(
+                "POST", path, query=[("uploadId", upload_id)], data=payload
+            ) as resp:
+                if resp.status >= 300:
+                    raise IOError(
+                        f"S3 complete-multipart failed ({resp.status})"
+                    )
+        except BaseException:
+            # best-effort abort so half-uploaded parts don't bill forever
+            try:
+                async with await self._request(
+                    "DELETE", path, query=[("uploadId", upload_id)]
+                ):
+                    pass
+            except Exception:
+                pass
+            raise
+
+    async def put_stream(self, uri: str, chunks: AsyncIterator[bytes]) -> int:
+        """S3 needs a Content-Length per request, so unknown-length streams
+        spool to a temp file, then take the single-PUT or multipart path."""
+        total = 0
+        with tempfile.NamedTemporaryFile(delete=False) as tmp:
+            try:
+                async for chunk in chunks:
+                    total += len(chunk)
+                    await asyncio.to_thread(tmp.write, chunk)
+                tmp.flush()
+                await self.put_file(uri, tmp.name)
+            finally:
+                os.unlink(tmp.name)
+        return total
+
+    async def get_bytes(self, uri: str) -> bytes:
+        async with await self._request("GET", self._path(uri)) as resp:
+            if resp.status == 404:
+                raise FileNotFoundError(uri)
+            if resp.status >= 300:
+                raise IOError(f"S3 get failed ({resp.status})")
+            return await resp.read()
+
+    async def get_chunks(
+        self, uri: str, chunk_size: int = 1 << 20
+    ) -> AsyncIterator[bytes]:
+        async with await self._request("GET", self._path(uri)) as resp:
+            if resp.status == 404:
+                raise FileNotFoundError(uri)
+            if resp.status >= 300:
+                raise IOError(f"S3 get failed ({resp.status})")
+            async for chunk in resp.content.iter_chunked(chunk_size):
+                yield chunk
+
+    async def get_file(self, uri: str, dest: Path | str) -> int:
+        dest_p = Path(dest)
+        dest_p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest_p.with_name(dest_p.name + ".tmp")
+        total = 0
+        with tmp.open("wb") as f:
+            async for chunk in self.get_chunks(uri, self.chunk_size):
+                total += len(chunk)
+                await asyncio.to_thread(f.write, chunk)
+        tmp.replace(dest_p)
+        return total
+
+    async def exists(self, uri: str) -> bool:
+        async with await self._request("HEAD", self._path(uri)) as resp:
+            return resp.status == 200
+
+    async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
+        bucket, key = parse_uri(prefix_uri)
+        path = f"/{self.bucket_prefix}{bucket}"
+        out: list[dict[str, Any]] = []
+        token: str | None = None
+        while True:
+            query = [("list-type", "2"), ("prefix", key)]
+            if token:
+                query.append(("continuation-token", token))
+            async with await self._request("GET", path, query=query) as resp:
+                if resp.status >= 300:
+                    raise IOError(f"S3 list failed ({resp.status})")
+                body = await resp.read()
+            root = ET.fromstring(body)
+            for item in _xml_find_all(root, "Contents"):
+                out.append(
+                    {
+                        "uri": build_uri(bucket, _xml_text(item, "Key")),
+                        "size": int(_xml_text(item, "Size", "0")),
+                        "mtime": self._parse_mtime(
+                            _xml_text(item, "LastModified")
+                        ),
+                    }
+                )
+            token = None
+            if _xml_text(root, "IsTruncated") == "true":
+                token = _xml_text(root, "NextContinuationToken") or None
+            if not token:
+                return out
+
+    @staticmethod
+    def _parse_mtime(text: str) -> float:
+        try:
+            return datetime.datetime.fromisoformat(
+                text.replace("Z", "+00:00")
+            ).timestamp()
+        except ValueError:
+            return 0.0
+
+    async def delete_prefix(self, prefix_uri: str) -> int:
+        objs = await self.list_prefix(prefix_uri)
+        n = 0
+        for o in objs:
+            async with await self._request("DELETE", self._path(o["uri"])) as resp:
+                if resp.status in (200, 204, 404):
+                    n += 1
+                else:
+                    raise IOError(
+                        f"S3 delete failed ({resp.status}) for {o['uri']}"
+                    )
+        return n
+
+    async def copy_prefix(self, src_uri: str, dst_uri: str) -> int:
+        """Server-side copy via ``x-amz-copy-source`` (reference:
+        ``S3Handler.py:375-439`` — head the key; on miss treat as prefix)."""
+        if await self.exists(src_uri):
+            objs = [{"uri": src_uri}]
+            exact = True
+        else:
+            objs = await self.list_prefix(src_uri)
+            exact = False
+        _, src_key = parse_uri(src_uri)
+        dst_bucket, dst_key = parse_uri(dst_uri)
+        n = 0
+        for o in objs:
+            _, key = parse_uri(o["uri"])
+            rel = "" if exact else key[len(src_key):].lstrip("/")
+            target_key = dst_key if exact or not rel else f"{dst_key}/{rel}"
+            source = _uri_encode(self._path(o["uri"]), encode_slash=False)
+            async with await self._request(
+                "PUT",
+                self._path(build_uri(dst_bucket, target_key)),
+                extra_headers={"x-amz-copy-source": source},
+            ) as resp:
+                if resp.status >= 300:
+                    raise IOError(
+                        f"S3 copy failed ({resp.status}) for {o['uri']}"
+                    )
+            n += 1
+        return n

@@ -131,9 +131,17 @@ def main(argv: list[str] | None = None) -> int:
         from ..train.cli import build_mesh
 
         mesh = build_mesh(spec)
-    except Exception as e:
-        print(f"note: job mesh unavailable here ({e}); using default mesh",
-              file=sys.stderr)
+    except ValueError as e:
+        # ValueError = this host cannot form the job's mesh (device-count
+        # mismatch) — the expected case when generating on a CPU box from a
+        # slice job. A typo'd mesh key (TypeError from MeshSpec(**...)) is a
+        # genuine spec error and propagates.
+        print(
+            f"note: job mesh {spec.get('mesh', {})} unavailable here ({e}); "
+            "using default single-device mesh — a model that only fits "
+            "sharded will OOM",
+            file=sys.stderr,
+        )
     tcfg = build_train_config(spec)
     trainer = Trainer(cfg, tcfg, mesh=mesh)  # mesh=None -> trainer default
     state = trainer.init_state()

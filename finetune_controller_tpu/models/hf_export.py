@@ -197,6 +197,14 @@ def export_merged_checkpoint(
         "torch_dtype": "float32",
     }
     if cfg.rope_scaling_factor:
+        if model_type != "llama":
+            # only the Llama-3.x presets carry rope_scaling_factor today; a
+            # qwen2-layout config with it set would get a config.json whose
+            # llama3 rope_scaling block transformers rejects for qwen2
+            raise NotImplementedError(
+                f"rope_scaling export is only supported for the llama "
+                f"layout, not {model_type!r}"
+            )
         hf_config["rope_scaling"] = {
             "rope_type": "llama3",
             "factor": cfg.rope_scaling_factor,

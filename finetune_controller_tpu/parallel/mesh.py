@@ -79,11 +79,19 @@ class MeshSpec:
             )
         return sizes
 
-    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
-        return build_mesh(self, devices)
+    def build(
+        self,
+        devices: Sequence[jax.Device] | None = None,
+        slice_of: Sequence[int] | None = None,
+    ) -> Mesh:
+        return build_mesh(self, devices, slice_of=slice_of)
 
 
-def order_devices_for_dcn(devices: Sequence, sizes: dict[str, int]) -> list:
+def order_devices_for_dcn(
+    devices: Sequence,
+    sizes: dict[str, int],
+    slice_of: Sequence[int] | None = None,
+) -> list:
     """Order devices so the mesh maps onto the ICI/DCN hierarchy.
 
     On a multi-slice TPU deployment each device carries a ``slice_index``;
@@ -98,14 +106,26 @@ def order_devices_for_dcn(devices: Sequence, sizes: dict[str, int]) -> list:
     (e.g. fsdp spanning two slices): still correct — XLA compiles DCN
     collectives — but bandwidth-bound.  Single-slice and CPU/test devices
     (no ``slice_index``) come back unchanged.
+
+    ``slice_of`` overrides the per-device slice assignment — used to model a
+    multi-slice topology on devices that carry no ``slice_index`` (virtual
+    CPU meshes in the dryrun/AOT legs), exercising the same ordering path a
+    real 2-slice deployment takes.
     """
-    # None slice_index (e.g. a CPU device mixed in) becomes its own -1
-    # "slice": it must neither raise a None-vs-int TypeError in the sort nor
-    # be excluded from the per-slice tiling arithmetic below.
-    slice_of = [
-        s if (s := getattr(d, "slice_index", None)) is not None else -1
-        for d in devices
-    ]
+    if slice_of is not None:
+        if len(slice_of) != len(devices):
+            raise ValueError(
+                f"slice_of has {len(slice_of)} entries for {len(devices)} devices"
+            )
+        slice_of = list(slice_of)
+    else:
+        # None slice_index (e.g. a CPU device mixed in) becomes its own -1
+        # "slice": it must neither raise a None-vs-int TypeError in the sort
+        # nor be excluded from the per-slice tiling arithmetic below.
+        slice_of = [
+            s if (s := getattr(d, "slice_index", None)) is not None else -1
+            for d in devices
+        ]
     distinct = set(slice_of)
     if len(distinct) <= 1:
         return list(devices)
@@ -129,7 +149,11 @@ def order_devices_for_dcn(devices: Sequence, sizes: dict[str, int]) -> list:
     return ordered
 
 
-def build_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> Mesh:
+def build_mesh(
+    spec: MeshSpec,
+    devices: Sequence[jax.Device] | None = None,
+    slice_of: Sequence[int] | None = None,
+) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     fixed = [spec.dp, spec.fsdp, spec.ep, spec.pp, spec.sp, spec.tp]
     if -1 not in fixed and math.prod(fixed) < len(devices):
@@ -138,10 +162,17 @@ def build_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> M
         # multi-device test host). Slice-group FIRST so the prefix fills
         # whole slices instead of straddling DCN on an interleaved
         # enumeration ({} sizes = sort only, warnings come later).
-        devices = order_devices_for_dcn(devices, {})[: math.prod(fixed)]
+        keep = math.prod(fixed)
+        order = order_devices_for_dcn(devices, {}, slice_of=slice_of)
+        if slice_of is not None:
+            index_of = {id(d): i for i, d in enumerate(devices)}
+            slice_of = [slice_of[index_of[id(d)]] for d in order[:keep]]
+        devices = order[:keep]
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in AxisNames.ORDER)
-    arr = np.asarray(order_devices_for_dcn(devices, sizes)).reshape(shape)
+    arr = np.asarray(
+        order_devices_for_dcn(devices, sizes, slice_of=slice_of)
+    ).reshape(shape)
     return Mesh(arr, AxisNames.ORDER)
 
 

@@ -37,6 +37,18 @@ def assert_platform_env() -> None:
             jax.devices()
         except RuntimeError as err:
             jax.config.update("jax_platforms", prev)
+            # xla_bridge caches failed backend inits; without a reset the
+            # second jax.devices() can re-raise the cached 'tpu' error even
+            # though the restored platform list would resolve fine
+            try:
+                from jax.extend.backend import clear_backends
+
+                clear_backends()
+            except Exception:  # pragma: no cover - version drift safety
+                logging.getLogger(__name__).warning(
+                    "could not clear cached jax backends before re-probe",
+                    exc_info=True,
+                )
             # The fallback must still deliver a TPU: JAX_PLATFORMS=tpu run
             # silently landing on CPU would produce CPU numbers labelled as
             # TPU measurements. Let a second init failure propagate loudly.

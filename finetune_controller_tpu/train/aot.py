@@ -35,7 +35,11 @@ from typing import Any
 #: chip HBM capacities (GiB, usable ~ spec minus runtime reserve)
 _HBM_GIB = {"v5e": 16.0, "v5p": 95.0}
 
-#: the two BASELINE configs that need >1 chip, at their REAL shapes
+#: the BASELINE configs that need >1 chip, at their REAL shapes.
+#: ``num_slices > 1`` marks a multi-slice (DCN) leg: devices are grouped into
+#: contiguous virtual slices (mirroring real multi-slice enumeration order),
+#: dp runs across slices, and the report classifies every compiled collective
+#: as intra-slice (ICI) or cross-slice (DCN).
 REALSCALE: dict[str, dict[str, Any]] = {
     "llama3-8b-fsdp16": dict(
         preset="llama3-8b", mesh=dict(fsdp=16), n_devices=16,
@@ -45,12 +49,65 @@ REALSCALE: dict[str, dict[str, Any]] = {
         preset="mixtral-8x7b", mesh=dict(fsdp=8, ep=8), n_devices=64,
         batch=64, seq=2048, chip="v5p",
     ),
+    # 2 × v5e-16 slices over DCN: dp across slices, FSDP inside each slice —
+    # the standard multi-slice recipe (SURVEY §2.3; reference seam:
+    # PyTorchJobDeployer.py:186-249 replica fan-out, which never saw a mesh)
+    "llama3-8b-dcn2x16": dict(
+        preset="llama3-8b", mesh=dict(dp=2, fsdp=16), n_devices=32,
+        batch=32, seq=2048, chip="v5e", num_slices=2,
+    ),
 }
 
 _COLLECTIVE_RE = re.compile(
     r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute"
     r"|ragged-all-to-all)\b"
 )
+
+# `%x = ... all-gather(...), ..., replica_groups={{0,1},{2,3}}, ...` or the
+# iota form `replica_groups=[2,16]<=[32]` / `[16,2]<=[2,16]T(1,0)`
+_GROUPED_OP_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|ragged-all-to-all)"
+    r"(?:-start)?\([^\n]*?replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}"
+    r"|\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)"
+)
+
+
+def _parse_groups(text: str) -> list[list[int]]:
+    """Materialise a replica_groups literal (explicit or iota form)."""
+    import numpy as np
+
+    if text.startswith("{{"):
+        return [
+            [int(x) for x in grp.split(",") if x.strip() != ""]
+            for grp in text[2:-2].split("},{")
+        ]
+    m = re.match(r"\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?", text)
+    out_shape = [int(x) for x in m.group(1).split(",")]
+    src_shape = [int(x) for x in m.group(2).split(",")]
+    arr = np.arange(math.prod(src_shape)).reshape(src_shape)
+    if m.group(3):
+        arr = arr.transpose([int(x) for x in m.group(3).split(",")])
+    return arr.reshape(out_shape).tolist()
+
+
+def classify_collectives(hlo: str, per_slice: int) -> dict[str, dict[str, int]]:
+    """Count compiled collectives by op kind × (intra|cross)-slice.
+
+    A collective whose every replica group stays inside one ``per_slice``
+    block of contiguous device ids rides ICI; a group spanning blocks rides
+    DCN.  This is the mechanically-checkable form of "fsdp inside the slice,
+    only the dp gradient reduction crosses DCN".
+    """
+    counts: dict[str, dict[str, int]] = {}
+    for m in _GROUPED_OP_RE.finditer(hlo):
+        op, groups_text = m.group(1), m.group(2)
+        groups = _parse_groups(groups_text)
+        intra = all(
+            len({dev // per_slice for dev in grp}) <= 1 for grp in groups
+        )
+        bucket = counts.setdefault(op, {"intra_slice": 0, "cross_slice": 0})
+        bucket["intra_slice" if intra else "cross_slice"] += 1
+    return counts
 
 
 def _sharded_bytes(shape, dtype, spec, mesh_shape: dict[str, int]) -> float:
@@ -88,7 +145,12 @@ def aot_report(name: str) -> dict[str, Any]:
             f"{len(devices)} — set xla_force_host_platform_device_count "
             "before JAX init"
         )
-    mesh = MeshSpec(**spec["mesh"]).build(devices)
+    num_slices = spec.get("num_slices", 1)
+    slice_of = None
+    if num_slices > 1:
+        per_slice = spec["n_devices"] // num_slices
+        slice_of = [i // per_slice for i in range(spec["n_devices"])]
+    mesh = MeshSpec(**spec["mesh"]).build(devices, slice_of=slice_of)
     model_cfg = PRESETS[spec["preset"]].replace(lora=LoRAConfig(rank=16))
     train_cfg = TrainConfig(
         mode="lora", batch_size=spec["batch"], seq_len=spec["seq"],
@@ -112,6 +174,9 @@ def aot_report(name: str) -> dict[str, Any]:
     compiled = step.lower(abstract_state, abstract_batch).compile()
     hlo = compiled.as_text()
     collectives = sorted(set(_COLLECTIVE_RE.findall(hlo)))
+    dcn_split = None
+    if num_slices > 1:
+        dcn_split = classify_collectives(hlo, spec["n_devices"] // num_slices)
 
     # param sharding evidence: flatten specs with paths
     mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -164,6 +229,8 @@ def aot_report(name: str) -> dict[str, Any]:
         "batch": b, "seq": s,
         "param_count": model_cfg.param_count(),
         "collectives": collectives,
+        "num_slices": num_slices,
+        "dcn_split": dcn_split,
         "fsdp_sharded_leaves": fsdp_sharded,
         "ep_sharded_leaves": ep_sharded,
         "unsharded_big_leaves": unsharded_big,

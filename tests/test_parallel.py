@@ -120,3 +120,60 @@ def test_order_devices_for_dcn_warns_on_cross_slice_inner_axis(caplog):
     with caplog.at_level(logging.WARNING):
         order_devices_for_dcn(devs, sizes)
     assert any("cross" in r.message for r in caplog.records)
+
+
+def test_order_devices_for_dcn_slice_of_override():
+    """Explicit slice_of models multi-slice on devices with no slice_index
+    (virtual CPU meshes) and takes the same regrouping path."""
+    from finetune_controller_tpu.parallel.mesh import (
+        AxisNames,
+        order_devices_for_dcn,
+    )
+
+    devs = list(range(8))  # no slice_index attribute at all
+    sizes = {AxisNames.DATA: 2, AxisNames.FSDP: 4}
+    # interleaved: even ids slice 0, odd ids slice 1
+    ordered = order_devices_for_dcn(devs, sizes, slice_of=[i % 2 for i in devs])
+    assert ordered == [0, 2, 4, 6, 1, 3, 5, 7]
+    import pytest
+
+    with pytest.raises(ValueError, match="slice_of has"):
+        order_devices_for_dcn(devs, sizes, slice_of=[0, 1])
+
+
+def test_build_mesh_slice_of_makes_dp_rows_slice_aligned():
+    import jax
+
+    from finetune_controller_tpu.parallel.mesh import MeshSpec
+
+    devs = jax.devices()[:8]
+    interleaved = [devs[i // 2 + (i % 2) * 4] for i in range(8)]
+    mesh = MeshSpec(dp=2, fsdp=4).build(
+        interleaved, slice_of=[i % 2 for i in range(8)]
+    )
+    rows = mesh.devices.reshape(2, -1)
+    assert {d.id for d in rows[0].ravel()} == {d.id for d in devs[:4]}
+    assert {d.id for d in rows[1].ravel()} == {d.id for d in devs[4:]}
+
+
+def test_classify_collectives_parses_both_replica_group_forms():
+    from finetune_controller_tpu.train.aot import (
+        _parse_groups,
+        classify_collectives,
+    )
+
+    assert _parse_groups("{{0,1},{2,3}}") == [[0, 1], [2, 3]]
+    assert _parse_groups("[2,4]<=[8]") == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # transposed iota: [4,2]<=[2,4]T(1,0) -> groups pair device i with i+4
+    assert _parse_groups("[4,2]<=[2,4]T(1,0)") == [
+        [0, 4], [1, 5], [2, 6], [3, 7]
+    ]
+    hlo = """
+  %ag = f32[8]{0} all-gather(%p), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}
+  %ar = f32[] all-reduce(%x), replica_groups=[4,2]<=[2,4]T(1,0), to_apply=%add
+  %ar2 = f32[] all-reduce-start(%y), replica_groups=[1,8]<=[8], to_apply=%add
+"""
+    split = classify_collectives(hlo, per_slice=4)
+    assert split["all-gather"] == {"intra_slice": 1, "cross_slice": 0}
+    # [4,2]T groups {i, i+4} cross the 4-device slice boundary; [1,8] too
+    assert split["all-reduce"] == {"intra_slice": 0, "cross_slice": 2}

@@ -1,0 +1,335 @@
+"""S3 object-store backend tests against an in-process fake S3 server.
+
+The reference's S3 path (``app/utils/S3Handler.py``) has zero tests —
+SURVEY.md §4.  Here the fake server *re-derives the SigV4 signature of every
+request* with the known secret and rejects mismatches with 403, so these
+contract tests pin the signer, not just the transport; a known-answer test
+additionally pins the signer against the official AWS documentation vector.
+"""
+
+import datetime
+import hashlib
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from conftest import run_async as run
+from finetune_controller_tpu.controller.objectstore import (
+    artifacts_prefix,
+    build_object_store,
+    parse_uri,
+)
+from finetune_controller_tpu.controller.s3 import (
+    EMPTY_SHA256,
+    S3ObjectStore,
+    sigv4_headers,
+)
+
+ACCESS, SECRET, REGION = "AKIDFAKE", "fake-secret-key", "us-test-1"
+
+
+def test_sigv4_known_answer_vector():
+    """Official AWS SigV4 example (docs 'Signature Version 4 signing
+    process', GET iam ListUsers, 2015-08-30): the full HMAC chain must
+    reproduce the documented signature."""
+    headers = sigv4_headers(
+        "GET",
+        "iam.amazonaws.com",
+        "/",
+        [("Action", "ListUsers"), ("Version", "2010-05-08")],
+        payload_hash=EMPTY_SHA256,
+        access_key="AKIDEXAMPLE",
+        secret_key="wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+        region="us-east-1",
+        service="iam",
+        amz_date="20150830T123600Z",
+        extra_headers={
+            "content-type": "application/x-www-form-urlencoded; charset=utf-8"
+        },
+        include_content_sha=False,
+    )
+    assert headers["authorization"].endswith(
+        "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b"
+        "5924a6f2b5d7"
+    )
+    assert "content-type;host;x-amz-date" in headers["authorization"]
+
+
+def make_fake_s3(page_size: int = 2):
+    """Minimal S3 REST API: signed PUT/GET/HEAD/DELETE, ListObjectsV2 with
+    continuation tokens, x-amz-copy-source, and multipart upload.  Every
+    request's SigV4 signature is re-derived and verified."""
+    blobs: dict[tuple[str, str], bytes] = {}
+    uploads: dict[str, list[bytes]] = {}
+    seen_auth: list[str] = []
+
+    def verify_signature(request: web.Request, body: bytes) -> str | None:
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            return "missing sigv4 authorization"
+        fields = dict(
+            part.strip().split("=", 1)
+            for part in auth[len("AWS4-HMAC-SHA256 "):].split(",")
+        )
+        signed_names = fields["SignedHeaders"].split(";")
+        payload_hash = request.headers.get("x-amz-content-sha256", "")
+        if payload_hash not in ("UNSIGNED-PAYLOAD",):
+            if hashlib.sha256(body).hexdigest() != payload_hash:
+                return "payload hash mismatch"
+        expect = sigv4_headers(
+            request.method,
+            request.headers["Host"],
+            request.path,
+            sorted((k, v) for k, v in request.query.items()),
+            payload_hash=payload_hash,
+            access_key=ACCESS,
+            secret_key=SECRET,
+            region=REGION,
+            amz_date=request.headers["x-amz-date"],
+            extra_headers={
+                k: request.headers[k]
+                for k in signed_names
+                if k not in ("host", "x-amz-date", "x-amz-content-sha256")
+            },
+        )
+        if expect["authorization"] != auth:
+            return f"signature mismatch: {expect['authorization']} != {auth}"
+        seen_auth.append(auth)
+        return None
+
+    async def handler(request: web.Request) -> web.Response:
+        body = await request.read()
+        err = verify_signature(request, body)
+        if err:
+            return web.Response(status=403, text=err)
+        parts = request.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+
+        if request.method == "POST" and "uploads" in request.query:
+            upload_id = f"up-{len(uploads)}"
+            uploads[upload_id] = []
+            return web.Response(
+                text=f"<InitiateMultipartUploadResult><UploadId>{upload_id}"
+                     "</UploadId></InitiateMultipartUploadResult>"
+            )
+        if request.method == "PUT" and "partNumber" in request.query:
+            parts_list = uploads[request.query["uploadId"]]
+            idx = int(request.query["partNumber"]) - 1
+            while len(parts_list) <= idx:
+                parts_list.append(b"")
+            parts_list[idx] = body
+            return web.Response(headers={"ETag": f'"etag-{idx}"'})
+        if request.method == "POST" and "uploadId" in request.query:
+            parts_list = uploads.pop(request.query["uploadId"])
+            blobs[(bucket, key)] = b"".join(parts_list)
+            return web.Response(
+                text="<CompleteMultipartUploadResult/>"
+            )
+        if request.method == "DELETE" and "uploadId" in request.query:
+            uploads.pop(request.query["uploadId"], None)
+            return web.Response(status=204)
+
+        if request.method == "PUT" and "x-amz-copy-source" in request.headers:
+            src = urllib.parse.unquote(
+                request.headers["x-amz-copy-source"]
+            ).lstrip("/")
+            src_bucket, _, src_key = src.partition("/")
+            data = blobs.get((src_bucket, src_key))
+            if data is None:
+                return web.Response(status=404)
+            blobs[(bucket, key)] = data
+            return web.Response(text="<CopyObjectResult/>")
+        if request.method == "PUT":
+            blobs[(bucket, key)] = body
+            return web.Response()
+        if request.method == "HEAD":
+            if (bucket, key) not in blobs:
+                return web.Response(status=404)
+            return web.Response(
+                headers={"Content-Length": str(len(blobs[(bucket, key)]))}
+            )
+        if request.method == "DELETE":
+            if (bucket, key) not in blobs:
+                return web.Response(status=404)
+            del blobs[(bucket, key)]
+            return web.Response(status=204)
+        if request.method == "GET" and not key and "list-type" in request.query:
+            prefix = request.query.get("prefix", "")
+            items = sorted(
+                k for (b, k) in blobs if b == bucket and k.startswith(prefix)
+            )
+            start = int(request.query.get("continuation-token") or 0)
+            page = items[start: start + page_size]
+            truncated = start + page_size < len(items)
+            now = datetime.datetime(2026, 1, 1).isoformat() + "Z"
+            contents = "".join(
+                f"<Contents><Key>{k}</Key><Size>{len(blobs[(bucket, k)])}"
+                f"</Size><LastModified>{now}</LastModified></Contents>"
+                for k in page
+            )
+            extra = (
+                f"<IsTruncated>true</IsTruncated><NextContinuationToken>"
+                f"{start + page_size}</NextContinuationToken>"
+                if truncated else "<IsTruncated>false</IsTruncated>"
+            )
+            return web.Response(
+                text=f"<ListBucketResult>{contents}{extra}</ListBucketResult>"
+            )
+        if request.method == "GET":
+            data = blobs.get((bucket, key))
+            if data is None:
+                return web.Response(status=404)
+            return web.Response(body=data)
+        return web.Response(status=400, text=f"unhandled {request.method}")
+
+    app = web.Application(client_max_size=1 << 30)
+    app.router.add_route("*", "/{tail:.*}", handler)
+    return app, blobs, seen_auth
+
+
+async def _store(page_size: int = 2, **kw):
+    app, blobs, seen_auth = make_fake_s3(page_size)
+    server = TestServer(app)
+    await server.start_server()
+
+    async def creds():
+        return ACCESS, SECRET, None
+
+    store = S3ObjectStore(
+        endpoint=str(server.make_url("")).rstrip("/"),
+        region=REGION,
+        creds_fn=creds,
+        **kw,
+    )
+    return store, server, blobs, seen_auth
+
+
+def test_s3_roundtrip_list_copy_delete():
+    async def go():
+        store, server, blobs, seen_auth = await _store()
+        # the reference's exact layout: s3://bucket/finetune_jobs/{user}/{job}/
+        prefix = artifacts_prefix("artifacts", "u", "job1")
+        await store.put_bytes(f"{prefix}/a.bin", b"A" * 10)
+        await store.put_bytes(f"{prefix}/sub/b.bin", b"B" * 20)
+        await store.put_bytes(f"{prefix}/c.csv", b"step,loss\n1,2.0\n")
+
+        assert await store.exists(f"{prefix}/a.bin")
+        assert not await store.exists(f"{prefix}/missing")
+        assert await store.get_bytes(f"{prefix}/sub/b.bin") == b"B" * 20
+        assert ("artifacts", "finetune_jobs/u/job1/artifacts/a.bin") in blobs
+
+        objs = await store.list_prefix(prefix)  # paginated (page_size=2)
+        assert len(objs) == 3
+        assert {parse_uri(o["uri"])[1].rsplit("/", 1)[-1] for o in objs} == {
+            "a.bin", "b.bin", "c.csv"
+        }
+        assert all(o["mtime"] > 0 for o in objs)
+
+        # server-side promotion copy (reference: S3Handler.py:375-439)
+        dst = "obj://deploy/models/x/job1"
+        n = await store.copy_prefix(prefix, dst)
+        assert n == 3
+        assert await store.get_bytes(f"{dst}/sub/b.bin") == b"B" * 20
+
+        assert await store.delete_prefix(prefix) == 3
+        assert await store.list_prefix(prefix) == []
+        assert len(seen_auth) > 10  # every request carried a verified sig
+        await store.close()
+        await server.close()
+
+    run(go())
+
+
+def test_s3_streaming_files_and_multipart(tmp_path):
+    async def go():
+        # small multipart threshold exercises the Create/Part/Complete path
+        store, server, blobs, _ = await _store(
+            multipart_threshold=1 << 20, part_size=1 << 20
+        )
+        big = bytes(range(256)) * 8192  # 2 MiB -> 2 parts
+        src = tmp_path / "big.bin"
+        src.write_bytes(big)
+        await store.put_file("obj://datasets/big.bin", src)
+        assert blobs[("datasets", "big.bin")] == big
+
+        chunks = []
+        async for chunk in store.get_chunks("obj://datasets/big.bin", 1 << 16):
+            chunks.append(chunk)
+        assert b"".join(chunks) == big and len(chunks) > 1
+
+        dest = tmp_path / "out.bin"
+        n = await store.get_file("obj://datasets/big.bin", dest)
+        assert n == len(big) and dest.read_bytes() == big
+
+        # async-iterator upload (the URL→store dataset streaming path)
+        async def gen():
+            for i in range(4):
+                yield bytes([i]) * 1000
+
+        total = await store.put_stream("obj://datasets/gen.bin", gen())
+        assert total == 4000 and len(blobs[("datasets", "gen.bin")]) == 4000
+
+        # shared helpers from the base class work against S3 too
+        await store.put_bytes(
+            "obj://artifacts/j/metrics.csv", b"step,loss\n1,2.5\n2,2.0\n"
+        )
+        res = await store.get_metrics_records("obj://artifacts/j")
+        records, _uri = res
+        assert records[1]["loss"] == 2.0
+
+        dest_zip = tmp_path / "a.zip"
+        await store.put_bytes("obj://artifacts/j/w.bin", b"w" * 100)
+        n = await store.zip_prefix_to_path("obj://artifacts/j", dest_zip)
+        assert n == 2
+        import zipfile
+
+        assert sorted(zipfile.ZipFile(dest_zip).namelist()) == [
+            "metrics.csv", "w.bin"
+        ]
+
+        await store.close()
+        await server.close()
+
+    run(go())
+
+
+def test_s3_tampered_secret_rejected():
+    """A client signing with the wrong secret must get 403 from the fake —
+    proving the fake actually verifies instead of rubber-stamping."""
+
+    async def go():
+        store, server, _, _ = await _store()
+
+        async def bad_creds():
+            return ACCESS, "wrong-secret", None
+
+        store._creds_fn = bad_creds
+        try:
+            await store.put_bytes("obj://datasets/x", b"data")
+            raise AssertionError("expected signature rejection")
+        except IOError as e:
+            assert "403" in str(e)
+        await store.close()
+        await server.close()
+
+    run(go())
+
+
+def test_build_object_store_s3_factory():
+    from finetune_controller_tpu.controller.config import Settings
+
+    store = build_object_store(
+        Settings(
+            object_store_backend="s3",
+            s3_endpoint="http://fake:1",
+            s3_region="eu-west-7",
+            s3_bucket_prefix="acme-",
+        )
+    )
+    assert isinstance(store, S3ObjectStore)
+    assert store.endpoint == "http://fake:1"
+    assert store.region == "eu-west-7"
+    assert store._path("obj://datasets/a/b") == "/acme-datasets/a/b"
