@@ -203,4 +203,5 @@ def test_generate_cli_mesh_fallback_and_full_mode(tmp_path, capsys):
          "--prompt-tokens", "5,6,7", "--max-new-tokens", "2"]
     )
     assert len(out["new_tokens"]) == 2
-    assert "job mesh unavailable" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "job mesh" in err and "unavailable here" in err
