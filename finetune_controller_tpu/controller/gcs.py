@@ -31,7 +31,7 @@ import urllib.parse
 from pathlib import Path
 from typing import Any, AsyncIterator, Awaitable, Callable
 
-from .objectstore import ObjectStore, build_uri, parse_uri
+from .objectstore import HttpObjectStore, build_uri, parse_uri
 
 logger = logging.getLogger(__name__)
 
@@ -121,8 +121,12 @@ class DefaultTokenProvider:
             return self._token
 
 
-class GCSObjectStore(ObjectStore):
-    """GCS JSON-API object store (reference: ``S3Handler``, redesigned)."""
+class GCSObjectStore(HttpObjectStore):
+    """GCS JSON-API object store (reference: ``S3Handler``, redesigned).
+
+    Session/retry/download-to-file/fan-out plumbing comes from
+    :class:`HttpObjectStore`; this class owns only the GCS wire protocol.
+    """
 
     def __init__(
         self,
@@ -132,13 +136,13 @@ class GCSObjectStore(ObjectStore):
         bucket_prefix: str = "",
         chunk_size: int = 1 << 20,
     ):
+        super().__init__()
         self.endpoint = endpoint.rstrip("/")
         self._token_fn = token_fn or DefaultTokenProvider()
         #: optional real-bucket prefix so one GCS project can host several
         #: logical buckets (``obj://datasets/...`` → ``{prefix}datasets``)
         self.bucket_prefix = bucket_prefix
         self.chunk_size = chunk_size
-        self._session = None
 
     # -- plumbing ------------------------------------------------------------
 
@@ -149,19 +153,6 @@ class GCSObjectStore(ObjectStore):
         token = await self._token_fn()
         return {"Authorization": f"Bearer {token}"}
 
-    async def session(self):
-        import aiohttp
-
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30)
-            )
-        return self._session
-
-    async def close(self) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
-
     def _object_url(self, uri: str, *, media: bool) -> str:
         bucket, key = parse_uri(uri)
         quoted = urllib.parse.quote(key, safe="")
@@ -170,32 +161,41 @@ class GCSObjectStore(ObjectStore):
         )
         return f"{url}?alt=media" if media else url
 
-    @staticmethod
-    def _mtime(item: dict[str, Any]) -> float:
-        updated = item.get("updated", "")
-        try:
-            import datetime
+    async def _call(
+        self, method: str, url: str, *, data: bytes | None = None,
+        params: dict[str, str] | None = None,
+    ) -> tuple[int, bytes]:
+        """One retried JSON-API request (token re-fetched per attempt so a
+        retry spanning a token expiry still authenticates)."""
 
-            return datetime.datetime.fromisoformat(
-                updated.replace("Z", "+00:00")
-            ).timestamp()
-        except ValueError:
-            return 0.0
+        async def build():
+            session = await self.session()
+            return session.request(
+                method, url, data=data, params=params,
+                headers=await self._headers(),
+            )
+
+        status, body, _ = await self.request_bytes(build)
+        return status, body
 
     # -- ObjectStore interface -----------------------------------------------
 
-    async def put_bytes(self, uri: str, data: bytes) -> None:
+    def _upload_url(self, uri: str) -> str:
         bucket, key = parse_uri(uri)
-        session = await self.session()
-        url = (
+        return (
             f"{self.endpoint}/upload/storage/v1/b/{self._gcs_bucket(bucket)}/o"
             f"?uploadType=media&name={urllib.parse.quote(key, safe='')}"
         )
-        async with session.post(url, data=data, headers=await self._headers()) as resp:
-            if resp.status >= 300:
-                raise IOError(f"GCS upload failed ({resp.status}): {await resp.text()}")
+
+    async def put_bytes(self, uri: str, data: bytes) -> None:
+        status, body = await self._call("POST", self._upload_url(uri), data=data)
+        if status >= 300:
+            raise IOError(f"GCS upload failed ({status}): {body[:200]!r}")
 
     async def put_stream(self, uri: str, chunks: AsyncIterator[bytes]) -> int:
+        """Single-attempt: an async-iterator body cannot be replayed, so a
+        transient failure surfaces to the caller (uploads with a replayable
+        source should go through :meth:`put_file`/:meth:`put_bytes`)."""
         total = 0
 
         async def counted() -> AsyncIterator[bytes]:
@@ -204,14 +204,9 @@ class GCSObjectStore(ObjectStore):
                 total += len(chunk)
                 yield chunk
 
-        bucket, key = parse_uri(uri)
         session = await self.session()
-        url = (
-            f"{self.endpoint}/upload/storage/v1/b/{self._gcs_bucket(bucket)}/o"
-            f"?uploadType=media&name={urllib.parse.quote(key, safe='')}"
-        )
         async with session.post(
-            url, data=counted(), headers=await self._headers()
+            self._upload_url(uri), data=counted(), headers=await self._headers()
         ) as resp:
             if resp.status >= 300:
                 raise IOError(f"GCS upload failed ({resp.status}): {await resp.text()}")
@@ -220,26 +215,34 @@ class GCSObjectStore(ObjectStore):
     async def put_file(self, uri: str, path: Path | str) -> None:
         p = Path(path)
 
-        async def chunks() -> AsyncIterator[bytes]:
-            with p.open("rb") as f:
-                while True:
-                    chunk = await asyncio.to_thread(f.read, self.chunk_size)
-                    if not chunk:
-                        return
-                    yield chunk
+        async def build():
+            async def chunks() -> AsyncIterator[bytes]:
+                with p.open("rb") as f:
+                    while True:
+                        chunk = await asyncio.to_thread(f.read, self.chunk_size)
+                        if not chunk:
+                            return
+                        yield chunk
 
-        await self.put_stream(uri, chunks())
+            session = await self.session()
+            return session.post(
+                self._upload_url(uri), data=chunks(),
+                headers=await self._headers(),
+            )
+
+        # the chunk generator is rebuilt per attempt, so this upload IS
+        # retryable, unlike a caller-supplied stream
+        status, body, _ = await self.request_bytes(build)
+        if status >= 300:
+            raise IOError(f"GCS upload failed ({status}): {body[:200]!r}")
 
     async def get_bytes(self, uri: str) -> bytes:
-        session = await self.session()
-        async with session.get(
-            self._object_url(uri, media=True), headers=await self._headers()
-        ) as resp:
-            if resp.status == 404:
-                raise FileNotFoundError(uri)
-            if resp.status >= 300:
-                raise IOError(f"GCS get failed ({resp.status})")
-            return await resp.read()
+        status, body = await self._call("GET", self._object_url(uri, media=True))
+        if status == 404:
+            raise FileNotFoundError(uri)
+        if status >= 300:
+            raise IOError(f"GCS get failed ({status})")
+        return body
 
     async def get_chunks(self, uri: str, chunk_size: int = 1 << 20) -> AsyncIterator[bytes]:
         session = await self.session()
@@ -253,28 +256,18 @@ class GCSObjectStore(ObjectStore):
             async for chunk in resp.content.iter_chunked(chunk_size):
                 yield chunk
 
-    async def get_file(self, uri: str, dest: Path | str) -> int:
-        dest_p = Path(dest)
-        dest_p.parent.mkdir(parents=True, exist_ok=True)
-        tmp = dest_p.with_name(dest_p.name + ".tmp")
-        total = 0
-        with tmp.open("wb") as f:
-            async for chunk in self.get_chunks(uri, self.chunk_size):
-                total += len(chunk)
-                await asyncio.to_thread(f.write, chunk)
-        tmp.replace(dest_p)
-        return total
-
     async def exists(self, uri: str) -> bool:
-        session = await self.session()
-        async with session.get(
-            self._object_url(uri, media=False), headers=await self._headers()
-        ) as resp:
-            return resp.status == 200
+        status, _ = await self._call("GET", self._object_url(uri, media=False))
+        if status == 200:
+            return True
+        if status == 404:
+            return False
+        # a transient error must not read as "absent": copy_prefix branches
+        # on this answer (exact-key vs prefix semantics)
+        raise IOError(f"GCS head failed ({status}) for {uri}")
 
     async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
         bucket, key = parse_uri(prefix_uri)
-        session = await self.session()
         base = f"{self.endpoint}/storage/v1/b/{self._gcs_bucket(bucket)}/o"
         out: list[dict[str, Any]] = []
         page: str | None = None
@@ -282,42 +275,38 @@ class GCSObjectStore(ObjectStore):
             params = {"prefix": key}
             if page:
                 params["pageToken"] = page
-            async with session.get(
-                base, params=params, headers=await self._headers()
-            ) as resp:
-                if resp.status >= 300:
-                    raise IOError(f"GCS list failed ({resp.status})")
-                body = await resp.json()
-            for item in body.get("items", []):
+            status, body = await self._call("GET", base, params=params)
+            if status >= 300:
+                raise IOError(f"GCS list failed ({status})")
+            doc = json.loads(body)
+            for item in doc.get("items", []):
                 out.append(
                     {
                         "uri": build_uri(bucket, item["name"]),
                         "size": int(item.get("size", 0)),
-                        "mtime": self._mtime(item),
+                        "mtime": self.parse_iso_mtime(item.get("updated", "")),
                     }
                 )
-            page = body.get("nextPageToken")
+            page = doc.get("nextPageToken")
             if not page:
                 return out
 
     async def delete_prefix(self, prefix_uri: str) -> int:
         objs = await self.list_prefix(prefix_uri)
-        session = await self.session()
-        n = 0
-        for o in objs:
-            async with session.delete(
-                self._object_url(o["uri"], media=False), headers=await self._headers()
-            ) as resp:
-                if resp.status in (200, 204, 404):
-                    n += 1
-                else:
-                    raise IOError(f"GCS delete failed ({resp.status}) for {o['uri']}")
-        return n
+
+        async def delete_one(o) -> int:
+            status, _ = await self._call(
+                "DELETE", self._object_url(o["uri"], media=False)
+            )
+            if status in (200, 204, 404):
+                return 1
+            raise IOError(f"GCS delete failed ({status}) for {o['uri']}")
+
+        return sum(await self.map_concurrently(delete_one, objs))
 
     async def copy_prefix(self, src_uri: str, dst_uri: str) -> int:
         """Server-side copy per object (reference: ``S3Handler.py:375-439`` —
-        head the key; on miss treat as prefix)."""
-        session = await self.session()
+        head the key; on miss treat as prefix), fanned out concurrently."""
         if await self.exists(src_uri):
             objs = [{"uri": src_uri}]
             exact = True
@@ -326,8 +315,8 @@ class GCSObjectStore(ObjectStore):
             exact = False
         _, src_key = parse_uri(src_uri)
         dst_bucket, dst_key = parse_uri(dst_uri)
-        n = 0
-        for o in objs:
+
+        async def copy_one(o) -> int:
             src_b, key = parse_uri(o["uri"])
             rel = "" if exact else key[len(src_key):].lstrip("/")
             target_key = dst_key if exact else f"{dst_key}/{rel}" if rel else dst_key
@@ -337,8 +326,9 @@ class GCSObjectStore(ObjectStore):
                 f"{self._gcs_bucket(dst_bucket)}/o/"
                 f"{urllib.parse.quote(target_key, safe='')}"
             )
-            async with session.post(url, headers=await self._headers()) as resp:
-                if resp.status >= 300:
-                    raise IOError(f"GCS copy failed ({resp.status}) for {o['uri']}")
-            n += 1
-        return n
+            status, _ = await self._call("POST", url)
+            if status >= 300:
+                raise IOError(f"GCS copy failed ({status}) for {o['uri']}")
+            return 1
+
+        return sum(await self.map_concurrently(copy_one, objs))

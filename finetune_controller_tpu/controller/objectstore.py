@@ -106,6 +106,10 @@ class ObjectStore:
             return None
         newest = max(csvs, key=lambda o: o["mtime"])
         raw = await self.get_bytes(newest["uri"])
+        if not raw.strip():
+            # the artifact sync can ship metrics.csv between creation and the
+            # first row landing — "no metrics yet", not an error
+            return None
         df = await asyncio.to_thread(pd.read_csv, io.BytesIO(raw))
         # Ragged rows (e.g. eval columns written on their own cadence) parse
         # as NaN — which is RFC-invalid in the JSON API and breaks the
@@ -149,11 +153,23 @@ class ObjectStore:
 
 
 class HttpObjectStore(ObjectStore):
-    """Shared aiohttp plumbing for cloud backends (GCS/S3): lazy session with
-    one timeout policy, chunked download-to-file with atomic rename, ISO-8601
-    mtime parsing.  One copy so a fix lands in every cloud engine."""
+    """Shared aiohttp plumbing for the cloud backends (GCS and S3 both
+    inherit this): lazy session with one timeout policy, retry/backoff on
+    transient failures, chunked download-to-file with atomic rename, ISO-8601
+    mtime parsing, bounded-concurrency fan-out.  One copy so a fix lands in
+    every cloud engine (the reference gets all of this from aioboto3 —
+    ``S3Handler.py:12,25``)."""
 
     chunk_size: int = 1 << 20
+    #: transient-failure policy: one transfer survives `retry_attempts - 1`
+    #: 5xx/429/connection hiccups (the in-repo kube client's pattern —
+    #: ``backends/k8s.py``); tests zero `retry_base_delay` for speed
+    retry_attempts: int = 4
+    retry_base_delay: float = 0.25
+    retry_statuses: frozenset = frozenset({429, 500, 502, 503, 504})
+    #: concurrent requests for prefix-wide operations (delete/copy fan-out —
+    #: the reference batches with asyncio.gather, ``S3Handler.py:330,422``)
+    prefix_concurrency: int = 16
 
     def __init__(self):
         self._session = None
@@ -171,21 +187,89 @@ class HttpObjectStore(ObjectStore):
         if self._session is not None and not self._session.closed:
             await self._session.close()
 
+    def _retry_delay(self, done_attempts: int) -> float:
+        return self.retry_base_delay * (2 ** done_attempts)
+
+    async def request_bytes(self, build) -> tuple[int, bytes, dict[str, str]]:
+        """Send one logical request with retries; returns
+        ``(status, body, headers)`` for the first conclusive outcome.
+
+        ``build()`` must return a FRESH aiohttp response context manager per
+        call — it is re-invoked on every attempt so signed engines re-stamp
+        dates/signatures.  Retries connection errors/timeouts and
+        ``retry_statuses`` with exponential backoff; the final attempt's
+        outcome (status or exception) is returned/raised as-is so call sites
+        keep their own error mapping.
+        """
+        import aiohttp
+
+        last = self.retry_attempts - 1
+        for attempt in range(self.retry_attempts):
+            if attempt:
+                await asyncio.sleep(self._retry_delay(attempt - 1))
+            try:
+                async with await build() as resp:
+                    body = await resp.read()
+                    if resp.status in self.retry_statuses and attempt < last:
+                        continue
+                    return resp.status, body, dict(resp.headers)
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                if attempt >= last:
+                    raise
+        raise AssertionError("unreachable")
+
     async def get_file(self, uri: str, dest: Path | str) -> int:
+        """Stream to a local file with atomic rename; transient mid-transfer
+        failures restart the WHOLE transfer (objects are immutable here, and
+        a restart is simpler and safer than byte-range resumption)."""
+        import aiohttp
+
         dest_p = Path(dest)
         dest_p.parent.mkdir(parents=True, exist_ok=True)
         tmp = dest_p.with_name(dest_p.name + ".tmp")
-        total = 0
+        last = self.retry_attempts - 1
         try:
-            with tmp.open("wb") as f:
-                async for chunk in self.get_chunks(uri, self.chunk_size):
-                    total += len(chunk)
-                    await asyncio.to_thread(f.write, chunk)
-            tmp.replace(dest_p)
+            for attempt in range(self.retry_attempts):
+                if attempt:
+                    await asyncio.sleep(self._retry_delay(attempt - 1))
+                total = 0
+                try:
+                    with tmp.open("wb") as f:
+                        async for chunk in self.get_chunks(uri, self.chunk_size):
+                            total += len(chunk)
+                            await asyncio.to_thread(f.write, chunk)
+                    tmp.replace(dest_p)
+                    return total
+                except FileNotFoundError:
+                    raise  # a 404 is conclusive, not transient
+                except (IOError, aiohttp.ClientError, asyncio.TimeoutError):
+                    if attempt >= last:
+                        raise
         except BaseException:
             tmp.unlink(missing_ok=True)
             raise
-        return total
+        raise AssertionError("unreachable")
+
+    async def map_concurrently(self, fn, items: list) -> list:
+        """Run ``fn(item)`` over items with bounded concurrency. Waits for
+        EVERY task before returning or raising (no orphaned requests keep
+        mutating the bucket after the caller has observed a failure), then
+        re-raises the first failure."""
+        if not items:
+            return []
+        sem = asyncio.Semaphore(self.prefix_concurrency)
+
+        async def guarded(item):
+            async with sem:
+                return await fn(item)
+
+        results = await asyncio.gather(
+            *(guarded(i) for i in items), return_exceptions=True
+        )
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        return list(results)
 
     @staticmethod
     def parse_iso_mtime(text: str) -> float:

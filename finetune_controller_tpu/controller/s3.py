@@ -27,6 +27,7 @@ are deliberately out of scope).
 from __future__ import annotations
 
 import asyncio
+import base64
 import datetime
 import hashlib
 import hmac
@@ -37,7 +38,7 @@ import xml.etree.ElementTree as ET
 from pathlib import Path
 from typing import Any, AsyncIterator, Awaitable, Callable
 
-from .objectstore import ObjectStore, build_uri, parse_uri
+from .objectstore import HttpObjectStore, build_uri, parse_uri
 
 EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 UNSIGNED = "UNSIGNED-PAYLOAD"
@@ -158,11 +159,14 @@ def _xml_text(el: ET.Element, tag: str, default: str = "") -> str:
     return default
 
 
-class S3ObjectStore(ObjectStore):
+class S3ObjectStore(HttpObjectStore):
     """S3 REST-API object store (reference: ``S3Handler``, redesigned).
 
     Path-style addressing (``{endpoint}/{bucket}/{key}``) so it works against
     AWS, MinIO-style gateways, and the in-process test fake alike.
+    Session/retry/download-to-file/fan-out plumbing comes from
+    :class:`HttpObjectStore`; this class owns only signing and the S3 wire
+    protocol.
     """
 
     def __init__(
@@ -176,6 +180,7 @@ class S3ObjectStore(ObjectStore):
         multipart_threshold: int = 64 << 20,
         part_size: int = 32 << 20,
     ):
+        super().__init__()
         self.endpoint = endpoint.rstrip("/")
         self.region = region
         self._creds_fn = creds_fn or env_credentials
@@ -184,7 +189,6 @@ class S3ObjectStore(ObjectStore):
         self.chunk_size = chunk_size
         self.multipart_threshold = multipart_threshold
         self.part_size = part_size
-        self._session = None
         self._host = urllib.parse.urlparse(self.endpoint).netloc
 
     # -- plumbing ------------------------------------------------------------
@@ -195,20 +199,7 @@ class S3ObjectStore(ObjectStore):
             f"/{self.bucket_prefix}{bucket}"
         )
 
-    async def session(self):
-        import aiohttp
-
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30)
-            )
-        return self._session
-
-    async def close(self) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
-
-    async def _request(
+    async def _open(
         self,
         method: str,
         path: str,
@@ -218,7 +209,8 @@ class S3ObjectStore(ObjectStore):
         payload_hash: str | None = None,
         extra_headers: dict[str, str] | None = None,
     ):
-        """Sign + send; returns the aiohttp response context manager."""
+        """Sign + send ONE attempt; returns the aiohttp response context
+        manager (signature is stamped fresh per call)."""
         query = query or []
         if payload_hash is None:
             payload_hash = (
@@ -239,18 +231,41 @@ class S3ObjectStore(ObjectStore):
         )
         url = f"{self.endpoint}{_uri_encode(path, encode_slash=False)}"
         if query:
-            url += "?" + urllib.parse.urlencode(query)
+            # the wire query must be byte-identical to the signed canonical
+            # query (same _uri_encode, same sort): AWS proper decodes '+' as
+            # space so urlencode would pass there, but MinIO-style gateways
+            # may canonicalize it literally → SignatureDoesNotMatch on keys
+            # containing spaces
+            url += "?" + "&".join(
+                f"{_uri_encode(k, encode_slash=True)}="
+                f"{_uri_encode(v, encode_slash=True)}"
+                for k, v in sorted(query)
+            )
         session = await self.session()
         return session.request(method, url, data=data, headers=headers)
+
+    async def _call(
+        self,
+        method: str,
+        path: str,
+        *,
+        query: list[tuple[str, str]] | None = None,
+        data: bytes | None = None,
+        payload_hash: str | None = None,
+        extra_headers: dict[str, str] | None = None,
+    ) -> tuple[int, bytes, dict[str, str]]:
+        """One retried request (re-signed per attempt — x-amz-date moves)."""
+        return await self.request_bytes(lambda: self._open(
+            method, path, query=query, data=data,
+            payload_hash=payload_hash, extra_headers=extra_headers,
+        ))
 
     # -- ObjectStore interface -----------------------------------------------
 
     async def put_bytes(self, uri: str, data: bytes) -> None:
-        async with await self._request("PUT", self._path(uri), data=data) as resp:
-            if resp.status >= 300:
-                raise IOError(
-                    f"S3 put failed ({resp.status}): {await resp.text()}"
-                )
+        status, body, _ = await self._call("PUT", self._path(uri), data=data)
+        if status >= 300:
+            raise IOError(f"S3 put failed ({status}): {body[:200]!r}")
 
     async def put_file(self, uri: str, path: Path | str) -> None:
         p = Path(path)
@@ -262,12 +277,9 @@ class S3ObjectStore(ObjectStore):
 
     async def _multipart_upload(self, uri: str, p: Path, size: int) -> None:
         path = self._path(uri)
-        async with await self._request(
-            "POST", path, query=[("uploads", "")]
-        ) as resp:
-            if resp.status >= 300:
-                raise IOError(f"S3 create-multipart failed ({resp.status})")
-            body = await resp.read()
+        status, body, _ = await self._call("POST", path, query=[("uploads", "")])
+        if status >= 300:
+            raise IOError(f"S3 create-multipart failed ({status})")
         upload_id = _xml_text(ET.fromstring(body), "UploadId")
         if not upload_id:
             raise IOError("S3 create-multipart returned no UploadId")
@@ -279,17 +291,15 @@ class S3ObjectStore(ObjectStore):
                     chunk = await asyncio.to_thread(f.read, self.part_size)
                     if not chunk:
                         break
-                    async with await self._request(
+                    status, _body, headers = await self._call(
                         "PUT",
                         path,
                         query=[("partNumber", str(part)), ("uploadId", upload_id)],
                         data=chunk,
-                    ) as resp:
-                        if resp.status >= 300:
-                            raise IOError(
-                                f"S3 upload-part {part} failed ({resp.status})"
-                            )
-                        etags.append(resp.headers.get("ETag", ""))
+                    )
+                    if status >= 300:
+                        raise IOError(f"S3 upload-part {part} failed ({status})")
+                    etags.append(headers.get("ETag", ""))
                     part += 1
             complete = "".join(
                 f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{etag}</ETag></Part>"
@@ -298,20 +308,15 @@ class S3ObjectStore(ObjectStore):
             payload = (
                 f"<CompleteMultipartUpload>{complete}</CompleteMultipartUpload>"
             ).encode()
-            async with await self._request(
+            status, _body, _ = await self._call(
                 "POST", path, query=[("uploadId", upload_id)], data=payload
-            ) as resp:
-                if resp.status >= 300:
-                    raise IOError(
-                        f"S3 complete-multipart failed ({resp.status})"
-                    )
+            )
+            if status >= 300:
+                raise IOError(f"S3 complete-multipart failed ({status})")
         except BaseException:
             # best-effort abort so half-uploaded parts don't bill forever
             try:
-                async with await self._request(
-                    "DELETE", path, query=[("uploadId", upload_id)]
-                ):
-                    pass
+                await self._call("DELETE", path, query=[("uploadId", upload_id)])
             except Exception:
                 pass
             raise
@@ -332,17 +337,19 @@ class S3ObjectStore(ObjectStore):
         return total
 
     async def get_bytes(self, uri: str) -> bytes:
-        async with await self._request("GET", self._path(uri)) as resp:
-            if resp.status == 404:
-                raise FileNotFoundError(uri)
-            if resp.status >= 300:
-                raise IOError(f"S3 get failed ({resp.status})")
-            return await resp.read()
+        status, body, _ = await self._call("GET", self._path(uri))
+        if status == 404:
+            raise FileNotFoundError(uri)
+        if status >= 300:
+            raise IOError(f"S3 get failed ({status})")
+        return body
 
     async def get_chunks(
         self, uri: str, chunk_size: int = 1 << 20
     ) -> AsyncIterator[bytes]:
-        async with await self._request("GET", self._path(uri)) as resp:
+        # single-attempt stream (mid-stream retry cannot resume safely);
+        # the inherited get_file retries the whole transfer around this
+        async with await self._open("GET", self._path(uri)) as resp:
             if resp.status == 404:
                 raise FileNotFoundError(uri)
             if resp.status >= 300:
@@ -350,21 +357,15 @@ class S3ObjectStore(ObjectStore):
             async for chunk in resp.content.iter_chunked(chunk_size):
                 yield chunk
 
-    async def get_file(self, uri: str, dest: Path | str) -> int:
-        dest_p = Path(dest)
-        dest_p.parent.mkdir(parents=True, exist_ok=True)
-        tmp = dest_p.with_name(dest_p.name + ".tmp")
-        total = 0
-        with tmp.open("wb") as f:
-            async for chunk in self.get_chunks(uri, self.chunk_size):
-                total += len(chunk)
-                await asyncio.to_thread(f.write, chunk)
-        tmp.replace(dest_p)
-        return total
-
     async def exists(self, uri: str) -> bool:
-        async with await self._request("HEAD", self._path(uri)) as resp:
-            return resp.status == 200
+        status, _, _ = await self._call("HEAD", self._path(uri))
+        if status == 200:
+            return True
+        if status == 404:
+            return False
+        # 403/5xx/301 (wrong-region redirect) must not read as "absent":
+        # copy_prefix branches on this answer (exact-key vs prefix semantics)
+        raise IOError(f"S3 head failed ({status}) for {uri}")
 
     async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
         bucket, key = parse_uri(prefix_uri)
@@ -375,17 +376,16 @@ class S3ObjectStore(ObjectStore):
             query = [("list-type", "2"), ("prefix", key)]
             if token:
                 query.append(("continuation-token", token))
-            async with await self._request("GET", path, query=query) as resp:
-                if resp.status >= 300:
-                    raise IOError(f"S3 list failed ({resp.status})")
-                body = await resp.read()
+            status, body, _ = await self._call("GET", path, query=query)
+            if status >= 300:
+                raise IOError(f"S3 list failed ({status})")
             root = ET.fromstring(body)
             for item in _xml_find_all(root, "Contents"):
                 out.append(
                     {
                         "uri": build_uri(bucket, _xml_text(item, "Key")),
                         "size": int(_xml_text(item, "Size", "0")),
-                        "mtime": self._parse_mtime(
+                        "mtime": self.parse_iso_mtime(
                             _xml_text(item, "LastModified")
                         ),
                     }
@@ -396,31 +396,50 @@ class S3ObjectStore(ObjectStore):
             if not token:
                 return out
 
-    @staticmethod
-    def _parse_mtime(text: str) -> float:
-        try:
-            return datetime.datetime.fromisoformat(
-                text.replace("Z", "+00:00")
-            ).timestamp()
-        except ValueError:
-            return 0.0
+    #: DeleteObjects accepts at most 1000 keys per request (AWS API limit)
+    _DELETE_BATCH = 1000
 
     async def delete_prefix(self, prefix_uri: str) -> int:
+        """Batch deletion via the ``DeleteObjects`` API — a checkpoint tree
+        with hundreds of shards goes down in ⌈n/1000⌉ requests instead of n
+        (the reference fans out per-key coroutines, ``S3Handler.py:216-235``;
+        the batch API beats even that)."""
+        from xml.sax.saxutils import escape
+
         objs = await self.list_prefix(prefix_uri)
+        bucket, _ = parse_uri(prefix_uri)
+        bucket_path = f"/{self.bucket_prefix}{bucket}"
         n = 0
-        for o in objs:
-            async with await self._request("DELETE", self._path(o["uri"])) as resp:
-                if resp.status in (200, 204, 404):
-                    n += 1
-                else:
-                    raise IOError(
-                        f"S3 delete failed ({resp.status}) for {o['uri']}"
-                    )
+        for start in range(0, len(objs), self._DELETE_BATCH):
+            batch = objs[start:start + self._DELETE_BATCH]
+            keys = [parse_uri(o["uri"])[1] for o in batch]
+            payload = (
+                "<Delete><Quiet>true</Quiet>"
+                + "".join(f"<Object><Key>{escape(k)}</Key></Object>" for k in keys)
+                + "</Delete>"
+            ).encode()
+            md5 = base64.b64encode(hashlib.md5(payload).digest()).decode()
+            status, body, _ = await self._call(
+                "POST", bucket_path, query=[("delete", "")], data=payload,
+                extra_headers={"content-md5": md5},
+            )
+            if status >= 300:
+                raise IOError(f"S3 batch delete failed ({status})")
+            errors = _xml_find_all(ET.fromstring(body), "Error")
+            if errors:
+                first = errors[0]
+                raise IOError(
+                    "S3 batch delete reported "
+                    f"{len(errors)} errors, first: "
+                    f"{_xml_text(first, 'Key')}: {_xml_text(first, 'Message')}"
+                )
+            n += len(keys)
         return n
 
     async def copy_prefix(self, src_uri: str, dst_uri: str) -> int:
         """Server-side copy via ``x-amz-copy-source`` (reference:
-        ``S3Handler.py:375-439`` — head the key; on miss treat as prefix)."""
+        ``S3Handler.py:375-439`` — head the key; on miss treat as prefix),
+        fanned out concurrently (reference gathers too, ``S3Handler.py:422``)."""
         if await self.exists(src_uri):
             objs = [{"uri": src_uri}]
             exact = True
@@ -429,20 +448,19 @@ class S3ObjectStore(ObjectStore):
             exact = False
         _, src_key = parse_uri(src_uri)
         dst_bucket, dst_key = parse_uri(dst_uri)
-        n = 0
-        for o in objs:
+
+        async def copy_one(o) -> int:
             _, key = parse_uri(o["uri"])
             rel = "" if exact else key[len(src_key):].lstrip("/")
             target_key = dst_key if exact or not rel else f"{dst_key}/{rel}"
             source = _uri_encode(self._path(o["uri"]), encode_slash=False)
-            async with await self._request(
+            status, _body, _ = await self._call(
                 "PUT",
                 self._path(build_uri(dst_bucket, target_key)),
                 extra_headers={"x-amz-copy-source": source},
-            ) as resp:
-                if resp.status >= 300:
-                    raise IOError(
-                        f"S3 copy failed ({resp.status}) for {o['uri']}"
-                    )
-            n += 1
-        return n
+            )
+            if status >= 300:
+                raise IOError(f"S3 copy failed ({status}) for {o['uri']}")
+            return 1
+
+        return sum(await self.map_concurrently(copy_one, objs))

@@ -184,6 +184,63 @@ def test_gcs_streaming_and_files(tmp_path):
     run(go())
 
 
+def test_gcs_retry_and_exists_errors(tmp_path):
+    """Round-5 hardening: the shared HttpObjectStore retry/backoff applies to
+    the GCS engine, and exists() raises (not False) on server errors."""
+
+    async def go():
+        app, blobs = make_fake_gcs()
+        fail = {"n": 0}
+
+        @web.middleware
+        async def flaky(request, handler):
+            if fail["n"] > 0:
+                fail["n"] -= 1
+                return web.Response(status=503, text="transient")
+            return await handler(request)
+
+        app.middlewares.append(flaky)
+        server = TestServer(app)
+        await server.start_server()
+
+        async def token():
+            return "fake-token"
+
+        store = GCSObjectStore(
+            endpoint=str(server.make_url("")).rstrip("/"), token_fn=token
+        )
+        store.retry_base_delay = 0.0
+
+        fail["n"] = 2
+        await store.put_bytes("obj://datasets/r.bin", b"r" * 64)
+        assert blobs[("datasets", "r.bin")] == b"r" * 64
+
+        # put_file rebuilds its chunk generator per attempt -> retryable
+        src = tmp_path / "f.bin"
+        src.write_bytes(b"f" * 128)
+        fail["n"] = 1
+        await store.put_file("obj://datasets/f.bin", src)
+        assert blobs[("datasets", "f.bin")] == b"f" * 128
+
+        fail["n"] = 1
+        dest = tmp_path / "out.bin"
+        n = await store.get_file("obj://datasets/r.bin", dest)
+        assert n == 64 and dest.read_bytes() == b"r" * 64
+        assert not dest.with_name("out.bin.tmp").exists()
+
+        fail["n"] = 10**6
+        try:
+            await store.exists("obj://datasets/r.bin")
+            raise AssertionError("expected IOError from exists() on 5xx")
+        except IOError as e:
+            assert "503" in str(e)
+
+        await store.close()
+        await server.close()
+
+    run(go())
+
+
 def test_build_object_store_factory(tmp_path):
     from finetune_controller_tpu.controller.config import Settings
 

@@ -132,6 +132,19 @@ def make_fake_s3(page_size: int = 2):
             uploads.pop(request.query["uploadId"], None)
             return web.Response(status=204)
 
+        if request.method == "POST" and "delete" in request.query:
+            # DeleteObjects batch API: XML body of keys, delete each
+            root = ET.fromstring(body)
+            deleted = []
+            for obj in root.iter():
+                if obj.tag.split("}")[-1] == "Key":
+                    blobs.pop((bucket, obj.text or ""), None)
+                    deleted.append(obj.text or "")
+            return web.Response(
+                text="<DeleteResult>"
+                     + "".join(f"<Deleted><Key>{k}</Key></Deleted>" for k in deleted)
+                     + "</DeleteResult>"
+            )
         if request.method == "PUT" and "x-amz-copy-source" in request.headers:
             src = urllib.parse.unquote(
                 request.headers["x-amz-copy-source"]
@@ -289,6 +302,79 @@ def test_s3_streaming_files_and_multipart(tmp_path):
         assert sorted(zipfile.ZipFile(dest_zip).namelist()) == [
             "metrics.csv", "w.bin"
         ]
+
+        await store.close()
+        await server.close()
+
+    run(go())
+
+
+def test_s3_retry_batch_delete_and_exists_errors(tmp_path):
+    """Round-5 hardening: transient 5xx retries with backoff, DeleteObjects
+    batching, exists() raising (not False) on server errors, and
+    signature-consistent wire encoding for keys containing spaces."""
+
+    async def go():
+        app, blobs, _seen = make_fake_s3(page_size=100)
+        fail = {"n": 0}
+        requests_log: list[tuple[str, bool]] = []
+
+        @web.middleware
+        async def flaky(request, handler):
+            requests_log.append((request.method, "delete" in request.query))
+            if fail["n"] > 0:
+                fail["n"] -= 1
+                return web.Response(status=503, text="transient")
+            return await handler(request)
+
+        app.middlewares.append(flaky)
+        server = TestServer(app)
+        await server.start_server()
+
+        async def creds():
+            return ACCESS, SECRET, None
+
+        store = S3ObjectStore(
+            endpoint=str(server.make_url("")).rstrip("/"),
+            region=REGION, creds_fn=creds,
+        )
+        store.retry_base_delay = 0.0  # no real sleeping in tests
+
+        # two 503s, then success — the put survives
+        fail["n"] = 2
+        await store.put_bytes("obj://datasets/r.bin", b"r" * 64)
+        assert blobs[("datasets", "r.bin")] == b"r" * 64
+
+        # whole-transfer retry on download-to-file
+        fail["n"] = 1
+        dest = tmp_path / "r.bin"
+        n = await store.get_file("obj://datasets/r.bin", dest)
+        assert n == 64 and dest.read_bytes() == b"r" * 64
+        assert not dest.with_name("r.bin.tmp").exists()
+
+        # persistent server error: exists must raise, not read as "absent"
+        fail["n"] = 10**6
+        try:
+            await store.exists("obj://datasets/r.bin")
+            raise AssertionError("expected IOError from exists() on 5xx")
+        except IOError as e:
+            assert "503" in str(e)
+        fail["n"] = 0
+
+        # keys with spaces: wire query encoding must match the signature
+        # (MinIO-style gateways canonicalize '+' literally)
+        prefix = "obj://datasets/sp aced"
+        await store.put_bytes(f"{prefix}/a b.bin", b"x")
+        await store.put_bytes(f"{prefix}/c.bin", b"y")
+        objs = await store.list_prefix(prefix)
+        assert len(objs) == 2
+
+        # DeleteObjects batching: 2 keys -> ONE POST ?delete request
+        requests_log.clear()
+        assert await store.delete_prefix(prefix) == 2
+        deletes = [r for r in requests_log if r[1]]
+        assert deletes == [("POST", True)]
+        assert await store.list_prefix(prefix) == []
 
         await store.close()
         await server.close()
