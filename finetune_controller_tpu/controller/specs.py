@@ -112,6 +112,12 @@ class BaseFineTuneJob(BaseModel):
     #: HF checkpoint directory with the pretrained base weights (staged into
     #: the pod like a dataset); empty = random init (smoke/test specs)
     pretrained_weights_dir: ClassVar[str] = ""
+    #: model-config overrides baked into the spec (``LlamaConfig`` field →
+    #: value) — how a family spec pins its measured kernel winners
+    #: (``flash_block_q``/``flash_block_k``/``flash_exp_dtype``/
+    #: ``ring_inner``/``ulysses_inner``) so API-submitted jobs carry them;
+    #: FTC_* env vars remain per-pod operator overrides
+    model_overrides: ClassVar[dict] = {}
 
     # ---- instance-level (validated user input) ----
     training_arguments: TrainingArguments
@@ -133,6 +139,7 @@ class BaseFineTuneJob(BaseModel):
         "promotion_path": str,
         "mesh_policy": dict,
         "pretrained_weights_dir": str,
+        "model_overrides": dict,
     }
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
@@ -188,9 +195,12 @@ class BaseFineTuneJob(BaseModel):
         model: dict[str, Any] = {"preset": self.model_preset}
         if self.pretrained_weights_dir:
             model["weights_dir"] = self.pretrained_weights_dir
+        overrides = dict(self.model_overrides)
         if self.framework == TrainingFramework.JAX_QLORA:
             # int4 base weights (models/quant.py); adapters still train in LoRA
-            model["overrides"] = {"quantize_base": True}
+            overrides["quantize_base"] = True
+        if overrides:
+            model["overrides"] = overrides
         if "lora_rank" in args:
             model["lora"] = {"rank": args.pop("lora_rank")}
         spec: dict[str, Any] = {

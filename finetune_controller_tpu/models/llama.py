@@ -100,6 +100,15 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
+    # --- kernel-tuning knobs (round-5: typed-spec surface for the measured
+    # winners so API-submitted jobs carry them; the FTC_FLASH_* /
+    # FTC_RING_INNER / FTC_ULYSSES_INNER env vars remain operator overrides —
+    # ``ops/attention.py`` merges env over these). 0/"" = kernel default.
+    flash_block_q: int = 0
+    flash_block_k: int = 0
+    flash_exp_dtype: str = ""      # "float32" | "bfloat16"
+    ring_inner: str = ""           # "xla" | "flash"
+    ulysses_inner: str = ""        # "xla" | "pallas"
     remat: bool = True
     #: which activations the per-layer remat keeps (see ``remat_policy_fn``):
     #: "full" | "attn" | "mlp" | "wide" | "matmuls" | "none" ("none" disables
@@ -150,6 +159,22 @@ class LlamaConfig:
 
     def replace(self, **kw) -> "LlamaConfig":
         return dataclasses.replace(self, **kw)
+
+    def kernel_tuning(self) -> dict:
+        """Non-default kernel knobs as the dict ``ops.attention`` consumes
+        (a trace-time constant — values are static ints/strings)."""
+        t: dict = {}
+        if self.flash_block_q:
+            t["block_q"] = self.flash_block_q
+        if self.flash_block_k:
+            t["block_k"] = self.flash_block_k
+        if self.flash_exp_dtype:
+            t["exp_dtype"] = self.flash_exp_dtype
+        if self.ring_inner:
+            t["ring_inner"] = self.ring_inner
+        if self.ulysses_inner:
+            t["ulysses_inner"] = self.ulysses_inner
+        return t
 
     def _count_with_mlp(self, mlp: int) -> int:
         d, v, L = self.d_model, self.vocab_size, self.n_layers
@@ -406,7 +431,10 @@ class Attention(nn.Module):
         q = checkpoint_name(q, "attn_qkv")
         k = checkpoint_name(k, "attn_qkv")
         v = checkpoint_name(v, "attn_qkv")
-        out = causal_attention(q, k, v, impl=cfg.attention_impl, segment_ids=segment_ids)
+        out = causal_attention(
+            q, k, v, impl=cfg.attention_impl, segment_ids=segment_ids,
+            tuning=cfg.kernel_tuning(),
+        )
         out = checkpoint_name(out, "attn_ctx")
         out = _proj(cfg, "o_proj", cfg.d_model)(out.reshape(b, s, -1), deterministic)
         return checkpoint_name(out, "attn_o")

@@ -88,37 +88,53 @@ def single_token_attention(
     return out.reshape(b, s, h, d)
 
 
-def flash_tuning_kwargs() -> dict:
-    """Validated flash-kernel overrides from the env — shared by every flash
-    call site (the plain dispatch and the ring inner), so a tuning sweep
+def _check_block(name: str, raw) -> int:
+    try:
+        val = int(raw)
+    except (TypeError, ValueError):
+        raise ValueError(f"{name}={raw!r}: not an integer") from None
+    if val < 128 or val % 128:
+        raise ValueError(f"{name}={val}: must be a positive multiple of 128")
+    return val
+
+
+def _check_exp_dtype(name: str, raw: str) -> str:
+    if raw not in ("float32", "bfloat16"):
+        raise ValueError(f"{name}={raw!r}: expected float32 or bfloat16")
+    return raw
+
+
+def flash_tuning_kwargs(tuning: dict | None = None) -> dict:
+    """Validated flash-kernel overrides — shared by every flash call site
+    (the plain dispatch and the ring inner), so a tuning sweep
     (``scripts/tpu_session.py``) moves all of them together.
 
-    Knobs (``docs/performance.md``): ``FTC_FLASH_BLOCK_Q``/``K`` (positive
-    multiples of 128) and ``FTC_FLASH_EXP_DTYPE`` (``float32``/``bfloat16``).
+    Two sources, env over spec: the job's typed config
+    (``LlamaConfig.kernel_tuning()`` — how API-submitted jobs carry the
+    measured winners) seeds the values, and the ``FTC_FLASH_BLOCK_Q``/``K``
+    (positive multiples of 128) / ``FTC_FLASH_EXP_DTYPE``
+    (``float32``/``bfloat16``) env vars remain the operator override
+    (``docs/performance.md``).
     """
     import os
 
     kwargs: dict = {}
+    tuning = tuning or {}
+    for kw in ("block_q", "block_k"):
+        if tuning.get(kw):
+            kwargs[kw] = _check_block(f"kernel_tuning.{kw}", tuning[kw])
+    if tuning.get("exp_dtype"):
+        kwargs["exp_dtype"] = _check_exp_dtype(
+            "kernel_tuning.exp_dtype", tuning["exp_dtype"]
+        )
     for env_name, kw in (("FTC_FLASH_BLOCK_Q", "block_q"),
                          ("FTC_FLASH_BLOCK_K", "block_k")):
         raw = os.environ.get(env_name)
         if raw:
-            try:
-                val = int(raw)
-            except ValueError:
-                raise ValueError(f"{env_name}={raw!r}: not an integer") from None
-            if val < 128 or val % 128:
-                raise ValueError(
-                    f"{env_name}={val}: must be a positive multiple of 128"
-                )
-            kwargs[kw] = val
+            kwargs[kw] = _check_block(env_name, raw)
     raw = os.environ.get("FTC_FLASH_EXP_DTYPE")
     if raw:
-        if raw not in ("float32", "bfloat16"):
-            raise ValueError(
-                f"FTC_FLASH_EXP_DTYPE={raw!r}: expected float32 or bfloat16"
-            )
-        kwargs["exp_dtype"] = raw
+        kwargs["exp_dtype"] = _check_exp_dtype("FTC_FLASH_EXP_DTYPE", raw)
     return kwargs
 
 
@@ -129,7 +145,13 @@ def causal_attention(
     *,
     impl: str = "xla",
     segment_ids: jax.Array | None = None,
+    tuning: dict | None = None,
 ) -> jax.Array:
+    """``tuning`` is the job's typed kernel config
+    (``LlamaConfig.kernel_tuning()``); env vars override it per knob."""
+    import os
+
+    tuning = tuning or {}
     if impl == "auto":
         # measured dispatch gate (ops/kernel_bench.py): Pallas flash on TPU
         # at long sequence, XLA otherwise
@@ -147,7 +169,7 @@ def causal_attention(
                 "(not built in this installation); use impl='xla'"
             ) from e
         return flash_attention(
-            q, k, v, segment_ids=segment_ids, **flash_tuning_kwargs()
+            q, k, v, segment_ids=segment_ids, **flash_tuning_kwargs(tuning)
         )
     if impl in ("ring", "ulysses"):
         from ..parallel.ring import get_ring_mesh, ring_attention_sharded
@@ -158,14 +180,17 @@ def causal_attention(
             return xla_causal_attention(q, k, v, segment_ids=segment_ids)
         if impl == "ring":
             return ring_attention_sharded(
-                q, k, v, segment_ids=segment_ids, mesh=mesh
+                q, k, v, segment_ids=segment_ids, mesh=mesh, tuning=tuning
             )
-        import os
-
         from ..parallel.ulysses import ulysses_attention_sharded
 
-        inner = os.environ.get("FTC_ULYSSES_INNER", "xla").strip().lower()
+        inner = (
+            os.environ.get("FTC_ULYSSES_INNER", "").strip().lower()
+            or tuning.get("ulysses_inner")
+            or "xla"
+        )
         return ulysses_attention_sharded(
-            q, k, v, segment_ids=segment_ids, mesh=mesh, impl=inner
+            q, k, v, segment_ids=segment_ids, mesh=mesh, impl=inner,
+            tuning=tuning,
         )
     raise ValueError(f"unknown attention impl: {impl!r}")

@@ -143,6 +143,7 @@ def _ring_attention_local_flash(
     *,
     axis_name: str,
     have_segments: bool = True,
+    tuning: dict | None = None,
 ) -> jax.Array:
     """Ring attention with the PALLAS flash kernel as the per-step inner.
 
@@ -168,10 +169,10 @@ def _ring_attention_local_flash(
     i = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
 
-    # FTC_FLASH_BLOCK_Q/K, FTC_FLASH_EXP_DTYPE; unset knobs resolve to the
-    # measured defaults inside the kernel (_resolve_tuning), which also caps
-    # blocks to the per-hop length
-    flash = partial(flash_attention_with_lse, **flash_tuning_kwargs())
+    # spec kernel_tuning seeded, FTC_FLASH_* env overriding; unset knobs
+    # resolve to the measured defaults inside the kernel (_resolve_tuning),
+    # which also caps blocks to the per-hop length
+    flash = partial(flash_attention_with_lse, **flash_tuning_kwargs(tuning))
     # segmentless corpora must not pay the per-interior-block segment-mask
     # VPU pass — the kernel compiles it out when given no segment ids
     qseg = segment_ids if have_segments else None
@@ -242,6 +243,7 @@ def ring_attention_sharded(
     mesh: Mesh | None = None,
     axis_name: str = AxisNames.SEQ,
     inner: str | None = None,
+    tuning: dict | None = None,
 ) -> jax.Array:
     """Causal GQA attention with S sharded over ``axis_name``.
 
@@ -268,11 +270,17 @@ def ring_attention_sharded(
     if segment_ids is None:
         segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
     if inner is None:
-        inner = os.environ.get("FTC_RING_INNER", "xla").strip().lower()
+        # env over spec over default — same precedence as the flash knobs
+        inner = (
+            os.environ.get("FTC_RING_INNER", "").strip().lower()
+            or (tuning or {}).get("ring_inner")
+            or "xla"
+        )
     if inner not in ("xla", "flash"):
         raise ValueError(f"unknown ring inner {inner!r}: expected xla or flash")
     local = (
-        partial(_ring_attention_local_flash, have_segments=have_segments)
+        partial(_ring_attention_local_flash, have_segments=have_segments,
+                tuning=tuning)
         if inner == "flash"
         else _ring_attention_local
     )

@@ -50,6 +50,7 @@ def _ulysses_local(
     axis_name: str,
     have_segments: bool,
     impl: str,
+    tuning: dict | None = None,
 ) -> jax.Array:
     from ..ops.attention import causal_attention
 
@@ -67,7 +68,9 @@ def _ulysses_local(
         if have_segments else None
     )
 
-    out_h = causal_attention(q_h, k_h, v_h, impl=impl, segment_ids=seg)
+    out_h = causal_attention(
+        q_h, k_h, v_h, impl=impl, segment_ids=seg, tuning=tuning
+    )
 
     # head-shard -> seq-shard: the inverse all-to-all
     return jax.lax.all_to_all(
@@ -84,6 +87,7 @@ def ulysses_attention_sharded(
     mesh: Mesh | None = None,
     axis_name: str = AxisNames.SEQ,
     impl: str = "xla",
+    tuning: dict | None = None,
 ) -> jax.Array:
     """Causal GQA attention, S sharded over ``axis_name`` via head all-to-all.
 
@@ -124,7 +128,7 @@ def ulysses_attention_sharded(
     seg_spec = P(AxisNames.BATCH_AXES, axis_name)
     fn = shard_map(
         partial(_ulysses_local, axis_name=axis_name,
-                have_segments=have_segments, impl=impl),
+                have_segments=have_segments, impl=impl, tuning=tuning),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
         out_specs=qkv_spec,

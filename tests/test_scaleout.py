@@ -99,6 +99,70 @@ def test_flash_tuning_defaults_resolution():
     assert _resolve_tuning(q_bf16, 256, 128, "float32") == (256, 128, "float32")
 
 
+def test_flash_tuning_spec_and_env_precedence(monkeypatch):
+    """Round-5: the job's typed kernel config (LlamaConfig.kernel_tuning())
+    seeds the flash knobs; FTC_* env vars override per knob."""
+    from finetune_controller_tpu.models.llama import LlamaConfig
+    from finetune_controller_tpu.ops.attention import flash_tuning_kwargs
+
+    for var in ("FTC_FLASH_BLOCK_Q", "FTC_FLASH_BLOCK_K",
+                "FTC_FLASH_EXP_DTYPE"):
+        monkeypatch.delenv(var, raising=False)
+
+    cfg = LlamaConfig(
+        flash_block_q=256, flash_block_k=512, flash_exp_dtype="bfloat16",
+        ulysses_inner="pallas", ring_inner="flash",
+    )
+    tuning = cfg.kernel_tuning()
+    assert tuning == {
+        "block_q": 256, "block_k": 512, "exp_dtype": "bfloat16",
+        "ring_inner": "flash", "ulysses_inner": "pallas",
+    }
+    assert flash_tuning_kwargs(tuning) == {
+        "block_q": 256, "block_k": 512, "exp_dtype": "bfloat16"
+    }
+    # env overrides spec, knob by knob
+    monkeypatch.setenv("FTC_FLASH_BLOCK_Q", "1024")
+    monkeypatch.setenv("FTC_FLASH_EXP_DTYPE", "float32")
+    assert flash_tuning_kwargs(tuning) == {
+        "block_q": 1024, "block_k": 512, "exp_dtype": "float32"
+    }
+    # defaults stay empty; invalid spec values fail loudly
+    assert LlamaConfig().kernel_tuning() == {}
+    import pytest
+
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_tuning_kwargs({"block_q": 100})
+    with pytest.raises(ValueError, match="float32 or bfloat16"):
+        flash_tuning_kwargs({"exp_dtype": "fp8"})
+
+
+def test_kernel_tuning_flows_from_job_spec():
+    """model_overrides on a job spec land in the resolved LlamaConfig — the
+    API path for shipping measured kernel winners (round-3 weak #5)."""
+    from finetune_controller_tpu.controller.examples import (
+        LoRASFTArguments,
+        TinyTestLoRA,
+    )
+    from finetune_controller_tpu.train.cli import build_model_config
+
+    class TunedTiny(TinyTestLoRA):
+        model_name = "tiny-tuned-lora"
+        model_overrides = {"flash_block_q": 256, "ulysses_inner": "pallas"}
+
+    spec = TunedTiny(
+        training_arguments=LoRASFTArguments()
+    ).build_trainer_spec("j1", "/tmp/a")
+    assert spec["model"]["overrides"] == {
+        "flash_block_q": 256, "ulysses_inner": "pallas"
+    }
+    cfg = build_model_config(spec)
+    assert cfg.flash_block_q == 256 and cfg.ulysses_inner == "pallas"
+    assert cfg.kernel_tuning() == {
+        "block_q": 256, "ulysses_inner": "pallas"
+    }
+
+
 def test_flash_attention_bf16_default_exp_matches_xla():
     """bf16 inputs take the bf16-exp path by default; parity vs the f32-exp
     XLA oracle stays within bf16 rounding noise."""
