@@ -109,25 +109,69 @@ def _base_kernel(leaves: dict[str, np.ndarray], layer: int, cfg: LlamaConfig) ->
     return np.asarray(deq, np.float32)
 
 
+def _expert_stack(moe: dict[str, np.ndarray], name: str, layer: int) -> np.ndarray:
+    """(E, in, out) f32 expert kernels for one layer, dequantizing int4
+    expert storage (the MoE-QLoRA path — ``models/moe.py``)."""
+    if name in moe:
+        return np.asarray(moe[name][layer], np.float32)
+    packed = moe[f"{name}_packed"][layer]
+    scales = moe[f"{name}_scales"][layer]
+    return np.stack([
+        np.asarray(dequantize_int4(packed[e], scales[e], dtype=np.float32))
+        for e in range(packed.shape[0])
+    ])
+
+
+def _hf_layout(cfg: LlamaConfig) -> tuple[str, str]:
+    """(architecture, model_type) for the config's semantics; raises on
+    combinations no HF architecture encodes."""
+    gemma_markers = (cfg.norm_offset, cfg.embed_scale, cfg.mlp_act != "silu")
+    if any(gemma_markers):
+        # Gemma semantics: HF stores the SAME offset-form norm weights and
+        # applies the same sqrt(d) embed scaling/GeGLU from config, so the
+        # tensors export unchanged — only the config names the architecture
+        if not all([cfg.norm_offset == 1.0, cfg.embed_scale,
+                    cfg.mlp_act == "gelu", cfg.tie_embeddings]):
+            raise NotImplementedError(
+                "partial Gemma semantics (norm_offset/embed_scale/mlp_act "
+                "mix) matches no transformers architecture; export the PEFT "
+                "adapter instead"
+            )
+        if cfg.n_experts:
+            raise NotImplementedError(
+                "Gemma-semantics MoE matches no transformers architecture"
+            )
+        arch, model_type = "GemmaForCausalLM", "gemma"
+    elif cfg.n_experts:
+        arch, model_type = "MixtralForCausalLM", "mixtral"
+    elif cfg.attention_qkv_bias:
+        arch, model_type = "Qwen2ForCausalLM", "qwen2"
+    else:
+        arch, model_type = "LlamaForCausalLM", "llama"
+    if cfg.rope_scaling_factor and model_type != "llama":
+        # only the Llama-3.x presets carry rope_scaling today; another
+        # layout with it set would get a config.json whose llama3
+        # rope_scaling block transformers rejects — refuse BEFORE any
+        # tensor file is written
+        raise NotImplementedError(
+            f"rope_scaling export is only supported for the llama layout, "
+            f"not {model_type!r}"
+        )
+    return arch, model_type
+
+
 def export_merged_checkpoint(
     cfg: LlamaConfig,
     variables: dict[str, Any],
     out_dir: Path | str,
 ) -> Path:
-    """Write a full HF Llama checkpoint with LoRA deltas merged into the base
-    (``W_eff = W + (alpha/r)·A·B``), loadable by ``transformers``. Dense text
-    models only (the importer's inverse)."""
-    if cfg.n_experts:
-        raise NotImplementedError("merged export currently covers dense models")
-    # Gemma-specific semantics (norm offset, embed scaling, GeGLU) have no
-    # Llama-config encoding — refuse up front (before any file is written)
-    # rather than emitting a checkpoint transformers would evaluate
-    # differently.
-    if cfg.norm_offset or cfg.embed_scale or cfg.mlp_act != "silu":
-        raise NotImplementedError(
-            "merged export covers the Llama/Qwen-2 layouts; export the PEFT "
-            "adapter and merge against the original Gemma base instead"
-        )
+    """Write a full HF checkpoint with LoRA deltas merged into the base
+    (``W_eff = W + (alpha/r)·A·B``), loadable by ``transformers`` — the
+    importer's inverse, covering every shipped text family: Llama/Qwen-2
+    dense, Gemma (offset norms/GeGLU/embed scaling ride the config), and
+    Mixtral MoE (stacked experts unstacked to per-expert ``w1/w2/w3``,
+    int4-quantized experts dequantized)."""
+    arch, model_type = _hf_layout(cfg)  # raises before any file is written
     out_dir = Path(out_dir).expanduser()
     out_dir.mkdir(parents=True, exist_ok=True)
     params = variables["params"]
@@ -155,7 +199,8 @@ def export_merged_checkpoint(
         tensors[f"{prefix}.post_attention_layernorm.weight"] = np.asarray(
             blocks["mlp_norm"]["scale"][i], np.float32
         )
-        for group_name in ("attn", "mlp"):
+        groups = ("attn",) if cfg.n_experts else ("attn", "mlp")
+        for group_name in groups:
             for proj, leaves in blocks[group_name].items():
                 kernel = _base_kernel(leaves, i, cfg)           # (in, out)
                 ladder = lora_blocks.get(group_name, {}).get(proj)
@@ -168,14 +213,21 @@ def export_merged_checkpoint(
                     tensors[f"{prefix}.{_HF_MODULE[proj]}.bias"] = np.asarray(
                         leaves["bias"][i], np.float32
                     )
+        if cfg.n_experts:
+            moe = blocks["moe"]
+            mp = f"{prefix}.block_sparse_moe"
+            tensors[f"{mp}.gate.weight"] = np.asarray(
+                moe["router_kernel"][i], np.float32
+            ).T
+            # stacked (E, in, out) → per-expert HF (out, in); the importer's
+            # w1=gate / w2=down / w3=up mapping, inverted
+            for name, hf_w in (("experts_gate", "w1"), ("experts_down", "w2"),
+                               ("experts_up", "w3")):
+                stack = _expert_stack(moe, name, i)
+                for e in range(stack.shape[0]):
+                    tensors[f"{mp}.experts.{e}.{hf_w}.weight"] = stack[e].T
 
     _save_safetensors(out_dir / "model.safetensors", tensors)
-    # Qwen-2-family configs (q/k/v biases) export under the Qwen2
-    # architecture; everything else uses the Llama layout
-    if cfg.attention_qkv_bias:
-        arch, model_type = "Qwen2ForCausalLM", "qwen2"
-    else:
-        arch, model_type = "LlamaForCausalLM", "llama"
     hf_config = {
         "architectures": [arch],
         "model_type": model_type,
@@ -196,15 +248,18 @@ def export_merged_checkpoint(
         "mlp_bias": False,
         "torch_dtype": "float32",
     }
+    if model_type == "gemma":
+        # transformers' Gemma applies GeGLU (tanh approximation), the (1+w)
+        # norm form, and sqrt(d) embed scaling from the architecture itself —
+        # both config keys are set for pre/post-4.39 transformers
+        hf_config["hidden_act"] = "gelu_pytorch_tanh"
+        hf_config["hidden_activation"] = "gelu_pytorch_tanh"
+    if model_type == "mixtral":
+        hf_config["num_local_experts"] = cfg.n_experts
+        hf_config["num_experts_per_tok"] = cfg.moe_top_k
+        hf_config["router_aux_loss_coef"] = cfg.router_aux_weight
     if cfg.rope_scaling_factor:
-        if model_type != "llama":
-            # only the Llama-3.x presets carry rope_scaling_factor today; a
-            # qwen2-layout config with it set would get a config.json whose
-            # llama3 rope_scaling block transformers rejects for qwen2
-            raise NotImplementedError(
-                f"rope_scaling export is only supported for the llama "
-                f"layout, not {model_type!r}"
-            )
+        # non-llama layouts were refused in _hf_layout, before any write
         hf_config["rope_scaling"] = {
             "rope_type": "llama3",
             "factor": cfg.rope_scaling_factor,

@@ -180,8 +180,12 @@ def run_job(spec: dict) -> None:
         pretrained_dir=spec.get("model", {}).get("weights_dir"),
         eval_batches=eval_batches,
     )
-    # deployable artifacts: PEFT adapter (+ merged checkpoint if configured)
-    trainer.export_artifacts(state, artifacts_dir)
+    # deployable artifacts: PEFT adapter (+ merged checkpoint if configured;
+    # the base dir enables the multi-host merge's host-side reload)
+    trainer.export_artifacts(
+        state, artifacts_dir,
+        pretrained_dir=spec.get("model", {}).get("weights_dir"),
+    )
 
     if is_rank_zero():
         with open(os.path.join(artifacts_dir, "done.txt"), "w") as f:

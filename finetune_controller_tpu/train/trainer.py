@@ -646,10 +646,23 @@ class Trainer:
             return state.replace(frozen=frozen)
         return state.replace(trainable=adapted)
 
-    def export_artifacts(self, state: TrainState, artifacts_dir: str) -> None:
+    def export_artifacts(
+        self,
+        state: TrainState,
+        artifacts_dir: str,
+        pretrained_dir: str | None = None,
+    ) -> None:
         """Write deployable HF-format artifacts after training: a PEFT
         adapter for text LoRA runs, plus a merged checkpoint when
-        ``cfg.export_merged``. Collective (all hosts gather), rank 0 writes."""
+        ``cfg.export_merged``. Collective (all hosts gather), rank 0 writes.
+
+        ``pretrained_dir`` (the job's base checkpoint) enables the merged
+        export on MULTI-HOST meshes: the sharded frozen base spans
+        non-addressable devices, so instead of an expensive cross-host gather
+        of GBs of frozen weights, rank 0 reloads the base host-side from the
+        original safetensors and merges the already-gathered adapter into it
+        (reference promotion contract: ``app/tasks/promotion.py:11-38`` — a
+        deployable artifact for every job type)."""
         if self._is_multimodal or self.cfg.mode != "lora":
             return
         if not self.model_cfg.scan_layers:
@@ -667,31 +680,45 @@ class Trainer:
         export_lora_adapter(
             self.model_cfg, host["trainable"], f"{artifacts_dir}/adapter"
         )
-        if self.cfg.export_merged and self.model_cfg.n_experts:
-            logger.warning(
-                "export_merged skipped: merged export covers dense models "
-                "(MoE adapters still exported)"
-            )
-        if self.cfg.export_merged and not self.model_cfg.n_experts:
+        if self.cfg.export_merged:
             if jax.process_count() > 1:
-                # frozen base shards span non-addressable devices on a
-                # multi-host mesh; merge offline from the adapter + base
-                logger.warning(
-                    "export_merged skipped on multi-host: merge offline from "
-                    "the adapter and the pretrained base"
+                if not pretrained_dir:
+                    # random-init multi-host run (smoke/proxy): nothing to
+                    # reload host-side; merge offline from the adapter
+                    logger.warning(
+                        "export_merged skipped on multi-host: no pretrained "
+                        "base directory to reload host-side; merge offline "
+                        "from the adapter and the base"
+                    )
+                    return
+                from ..models.hf_import import load_llama_params
+
+                loaded = load_llama_params(pretrained_dir, self.model_cfg)
+                # QLoRA faithfulness: the adapter trained against the
+                # QUANTIZED base — re-apply the same int4 adaptation (against
+                # eval_shape targets, so no device memory is touched) so the
+                # merged weights are deq(Q(W)) + delta, matching the
+                # single-host path's dequantized frozen leaves
+                shapes = jax.eval_shape(
+                    self._raw_init, jax.random.PRNGKey(self.cfg.seed)
                 )
-                return
-            frozen_host = jax.tree.map(
-                lambda x: np.asarray(jax.device_get(x)), dict(state.frozen)
-            )
+                loaded = _adapt_loaded_params(
+                    loaded, shapes.frozen["params"],
+                    quant_block=self.model_cfg.quant_block,
+                )
+                frozen_host: dict = {"params": loaded}
+            else:
+                frozen_host = jax.tree.map(
+                    lambda x: np.asarray(jax.device_get(x)), dict(state.frozen)
+                )
             variables = self._assemble(frozen_host, host["trainable"])
             try:
                 export_merged_checkpoint(
                     self.model_cfg, variables, f"{artifacts_dir}/merged"
                 )
             except NotImplementedError as exc:
-                # an unsupported merged layout (e.g. Gemma semantics) must not
-                # fail a completed training run — the adapter already shipped
+                # an unsupported merged layout (partial Gemma semantics) must
+                # not fail a completed run — the adapter already shipped
                 logger.warning("export_merged skipped: %s", exc)
 
     def state_to_host(

@@ -209,18 +209,166 @@ def test_qwen2_merged_checkpoint_keeps_biases(tmp_path):
     np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
 
 
-def test_gemma_merged_export_refuses(tmp_path):
-    """Gemma semantics have no Llama-config encoding — merged export must
-    refuse loudly, not emit a checkpoint transformers evaluates differently."""
+def test_gemma_merged_checkpoint_roundtrip(tmp_path):
+    """Round-5 (VERDICT #4): Gemma merged export — the offset-form norms,
+    GeGLU, embed scaling and tied head ride the exported config; transformers'
+    GemmaForCausalLM reproduces our merged forward."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
     cfg = PRESETS["tiny-gemma-test"].replace(
-        dtype=jnp.float32, lora=LoRAConfig(rank=2)
+        dtype=jnp.float32, lora=LoRAConfig(rank=4)
     )
     ours = LlamaForCausalLM(cfg)
     variables = ours.init(
-        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32)
+        {"params": jax.random.PRNGKey(4)}, jnp.zeros((1, 8), jnp.int32)
     )
+    lora = _random_lora(variables)
+
+    merged_dir = export_merged_checkpoint(
+        cfg, {"params": variables["params"], "lora": lora},
+        tmp_path / "gemma-merged",
+    )
+    reloaded = AutoModelForCausalLM.from_pretrained(str(merged_dir)).eval()
+    assert reloaded.config.model_type == "gemma"
+
+    tokens = np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 16))
+    out = ours.apply(
+        {"params": variables["params"], "lora": lora},
+        jnp.asarray(tokens, jnp.int32),
+    )
+    with torch.no_grad():
+        ref = reloaded(torch.tensor(tokens)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=5e-4, rtol=1e-3)
+
+
+def test_partial_gemma_semantics_still_refuse(tmp_path):
+    """A hybrid config (embed scaling without the rest) matches no HF
+    architecture — the exporter must refuse before writing any file."""
+    cfg = TINY.replace(embed_scale=True)
     with pytest.raises(NotImplementedError, match="adapter"):
-        export_merged_checkpoint(cfg, variables, tmp_path / "nope")
+        export_merged_checkpoint(cfg, {"params": {}}, tmp_path / "nope")
+    assert not (tmp_path / "nope").exists()
+
+
+def test_mixtral_merged_checkpoint_roundtrip(tmp_path):
+    """Round-5 (VERDICT #4): MoE merged export — stacked experts unstack to
+    per-expert w1/w2/w3, the router exports as gate, attention LoRA merges;
+    transformers' MixtralForCausalLM reproduces our forward (dropless
+    capacity so our static-capacity routing matches HF's per-token top-k)."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    cfg = PRESETS["tiny-moe-test"].replace(
+        dtype=jnp.float32, lora=LoRAConfig(rank=4),
+        capacity_factor=float(PRESETS["tiny-moe-test"].n_experts),
+    )
+    ours = LlamaForCausalLM(cfg)
+    variables = ours.init(
+        {"params": jax.random.PRNGKey(6)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    lora = _random_lora(variables)
+
+    merged_dir = export_merged_checkpoint(
+        cfg, {"params": variables["params"], "lora": lora},
+        tmp_path / "moe-merged",
+    )
+    reloaded = AutoModelForCausalLM.from_pretrained(str(merged_dir)).eval()
+    assert reloaded.config.model_type == "mixtral"
+    assert reloaded.config.num_local_experts == cfg.n_experts
+    assert reloaded.config.num_experts_per_tok == cfg.moe_top_k
+
+    tokens = np.random.default_rng(7).integers(0, cfg.vocab_size, (2, 16))
+    out, _ = ours.apply(
+        {"params": variables["params"], "lora": lora},
+        jnp.asarray(tokens, jnp.int32), mutable=("moe_aux",),
+    )
+    with torch.no_grad():
+        ref = reloaded(torch.tensor(tokens)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-3, rtol=1e-2)
+
+
+def test_mixtral_int4_experts_merged_export(tmp_path):
+    """MoE-QLoRA: int4-packed expert stacks dequantize on export; the written
+    tensors equal the dequantized stacks our forward computes with."""
+    from safetensors.numpy import load_file
+
+    from finetune_controller_tpu.models.quant import dequantize_int4
+
+    cfg = PRESETS["tiny-moe-test"].replace(
+        dtype=jnp.float32, lora=LoRAConfig(rank=2), quantize_base=True,
+    )
+    ours = LlamaForCausalLM(cfg)
+    variables = ours.init(
+        {"params": jax.random.PRNGKey(8)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    merged_dir = export_merged_checkpoint(
+        cfg, {"params": variables["params"], "lora": variables["lora"]},
+        tmp_path / "moe-int4-merged",
+    )
+    tensors = load_file(str(merged_dir / "model.safetensors"))
+    moe = variables["params"]["blocks"]["block"]["moe"]
+    want = np.asarray(dequantize_int4(
+        moe["experts_gate_packed"][0][1], moe["experts_gate_scales"][0][1],
+        dtype=jnp.float32,
+    )).T
+    got = tensors["model.layers.0.block_sparse_moe.experts.1.w1.weight"]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_multihost_merged_export_reloads_base(tmp_path, monkeypatch):
+    """Round-5 (VERDICT #4): on a multi-host mesh the frozen base is never
+    gathered cross-host — rank 0 reloads it from the job's pretrained dir
+    and merges the (already-gathered) adapter into it."""
+    from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+    ours = LlamaForCausalLM(TINY)
+    base_vars = ours.init(
+        {"params": jax.random.PRNGKey(9)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    base_dir = export_merged_checkpoint(
+        TINY, {"params": base_vars["params"]}, tmp_path / "base"
+    )
+
+    tcfg = TrainConfig(mode="lora", batch_size=2, seq_len=16, total_steps=1,
+                       export_merged=True)
+    tr = Trainer(TINY, tcfg)
+    state = tr.init_state()
+    state = tr.load_pretrained(state, str(base_dir))
+    state = state.replace(trainable=_random_lora({"lora": state.trainable}))
+
+    # simulate the 2-host view: process_count lies; the collective gather is
+    # replaced by the single-host equivalent (the adapter IS addressable
+    # here — what the fake must preserve is the code path that skips
+    # gathering the frozen base and reloads it from disk instead)
+    monkeypatch.setattr(
+        Trainer, "state_to_host",
+        lambda self, st, fields=("step", "trainable", "opt_state"): {
+            f: jax.tree.map(lambda x: np.asarray(x), getattr(st, f))
+            for f in fields
+        },
+    )
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    try:
+        tr.export_artifacts(
+            state, str(tmp_path / "art"), pretrained_dir=str(base_dir)
+        )
+    finally:
+        monkeypatch.undo()
+
+    from safetensors.numpy import load_file
+
+    merged = load_file(str(tmp_path / "art" / "merged" / "model.safetensors"))
+    base = load_file(str(base_dir / "model.safetensors"))
+    lora = state.trainable["blocks"]["block"]["attn"]["q_proj"]
+    scale = TINY.lora.alpha / TINY.lora.rank
+    want = base["model.layers.0.self_attn.q_proj.weight"].T + scale * (
+        np.asarray(lora["lora_a"][0]) @ np.asarray(lora["lora_b"][0])
+    )
+    got = merged["model.layers.0.self_attn.q_proj.weight"].T
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # the adapter shipped too (every LoRA run exports one)
+    assert (tmp_path / "art" / "adapter" / "adapter_model.safetensors").exists()
 
 
 def test_rope_scaled_merged_export_roundtrip(tmp_path):
