@@ -51,9 +51,14 @@ class Settings(BaseModel):
     # --- State store (reference: Mongo URL/creds, app/core/config.py:44-49) ---
     state_dir: str = "~/.finetune_controller_tpu/state"
     #: "sqlite" (WAL database — safe for the deployed API+monitor two-process
-    #: layout, like the reference's shared MongoDB) | "jsonl" (single-process
-    #: append-only log)
+    #: layout on one node) | "jsonl" (single-process append-only log) |
+    #: "remote" (the shared state service, ``statestore_main`` — API×N
+    #: replicas + monitor across nodes, the role MongoDB plays for the
+    #: reference, ``app/database/db.py:51``)
     state_backend: str = "sqlite"
+    #: remote state service endpoint + bearer token (state_backend=remote)
+    state_service_url: str = ""
+    state_service_token: str = ""
 
     # --- Object store (reference: S3 buckets, app/core/config.py:53-58) ---
     #: "local" (filesystem root, hermetic CI) | "gcs" | "s3" (cloud buckets)

@@ -76,12 +76,11 @@ class PromotionTask:
         """Crash recovery at startup: anything still IN_PROGRESS/DELETING has
         no task running (the process died) — mark FAILED so the user can retry."""
         n = 0
-        for job in await self.state.jobs.find(
-            lambda d: d.get("promotion_status")
-            in (PromotionStatus.IN_PROGRESS.value, PromotionStatus.DELETING.value)
+        for job in await self.state.find_jobs_with_promotion_in(
+            [PromotionStatus.IN_PROGRESS, PromotionStatus.DELETING]
         ):
             await self.state.update_job_promotion(
-                job["job_id"], PromotionStatus.FAILED
+                job.job_id, PromotionStatus.FAILED
             )
             n += 1
         if n:

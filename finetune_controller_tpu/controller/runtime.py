@@ -64,7 +64,16 @@ def build_runtime(
     """Assemble a runtime from settings (reference startup flow §3.5, made lazy)."""
     settings = settings or get_settings()
     load_model_modules(plugin_dir)
-    state = StateStore(settings.state_path, backend=settings.state_backend)
+    if settings.state_backend == "remote":
+        # the shared state service: N API replicas + the monitor see one
+        # consistent store (and rate limits become cluster-scope)
+        from .statestore_service import RemoteStateStore
+
+        state: StateStore = RemoteStateStore(  # type: ignore[assignment]
+            settings.state_service_url, token=settings.state_service_token
+        )
+    else:
+        state = StateStore(settings.state_path, backend=settings.state_backend)
     store = build_object_store(settings)
     catalog = load_catalog(settings.device_config_file or None)
     backend: TrainingBackend

@@ -14,7 +14,6 @@ is cited on each handler. Differences by design:
 from __future__ import annotations
 
 import asyncio
-import collections
 import json
 import logging
 import tempfile
@@ -57,33 +56,24 @@ BG_TASKS_KEY = web.AppKey("bg_tasks", set)
 
 
 class RateLimiter:
-    """Sliding-window per-user, per-class limiter."""
+    """Sliding-window per-user, per-class limiter, enforced in the STATE
+    STORE's consistency domain (``StateStore.rate_limit_acquire``): memory
+    store → per-process (dev), sqlite → every worker sharing the state dir,
+    remote state service → the whole cluster. The reference's slowapi limits
+    are per-process, so ``--workers N`` silently multiplies them
+    (``app/main.py:377,525,714``); here the scope follows the store."""
 
-    def __init__(self, limits_per_min: dict[str, int]):
+    def __init__(self, state, limits_per_min: dict[str, int]):
+        self.state = state
         self.limits = limits_per_min
-        self._hits: dict[tuple[str, str], collections.deque] = collections.defaultdict(
-            collections.deque
-        )
 
-    def check(self, user_id: str, bucket: str) -> bool:
+    async def check(self, user_id: str, bucket: str) -> bool:
         limit = self.limits.get(bucket)
         if not limit:
             return True
-        now = time.monotonic()
-        if len(self._hits) > 10_000:
-            # sweep fully-stale keys so distinct clients don't accumulate forever
-            stale = [
-                k for k, dq in self._hits.items() if not dq or dq[-1] < now - 60.0
-            ]
-            for k in stale:
-                del self._hits[k]
-        q = self._hits[(user_id, bucket)]
-        while q and q[0] < now - 60.0:
-            q.popleft()
-        if len(q) >= limit:
-            return False
-        q.append(now)
-        return True
+        return await self.state.rate_limit_acquire(
+            f"rl/{bucket}/{user_id}", limit, 60.0
+        )
 
 
 def _limited(bucket: str):
@@ -94,7 +84,7 @@ def _limited(bucket: str):
             limiter: RateLimiter = request.app[LIMITER_KEY]
             user = request.get("user")
             uid = user.user_id if user else request.remote or "anon"
-            if not limiter.check(uid, bucket):
+            if not await limiter.check(uid, bucket):
                 raise web.HTTPTooManyRequests(
                     text=json.dumps({"detail": f"rate limit exceeded ({bucket})"}),
                     content_type="application/json",
@@ -906,11 +896,12 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
     app[RUNTIME_KEY] = runtime
     app[PROMOTION_KEY] = PromotionTask(runtime.state, runtime.store)
     app[LIMITER_KEY] = RateLimiter(
+        runtime.state,
         {
             "submit": settings.rate_limit_submit_per_min,
             "read": settings.rate_limit_read_per_min,
             "promote": settings.rate_limit_promote_per_min,
-        }
+        },
     )
     app[BG_TASKS_KEY] = set()
 

@@ -31,6 +31,7 @@ Fixes a reference wart on the way: the monitor's N+1 per-job DB reads
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import os
 import sqlite3
@@ -502,6 +503,9 @@ class StateStore:
         for name, key, idx in self._COLLECTIONS:
             setattr(self, name, make(name, key, idx))
         self._connected = False
+        #: rate-limit windows for the memory/jsonl engines (per-process —
+        #: the sqlite engine keeps them in the database, cross-process)
+        self._mem_rate: dict[str, collections.deque] = {}
 
     # -- lifecycle (reference: connect/_ensure_indexes, db.py:33-105) --------
 
@@ -639,6 +643,18 @@ class StateStore:
     async def update_job_fields(self, job_id: str, **fields: Any) -> bool:
         return await self.jobs.update(job_id, _jsonify(fields))
 
+    async def find_jobs_with_promotion_in(
+        self, states: list[PromotionStatus | str]
+    ) -> list[JobRecord]:
+        """Jobs whose promotion_status is in ``states`` — the promotion
+        manager's crash-recovery sweep (kept a domain method so the remote
+        state service can serve it; predicates don't cross the wire)."""
+        vals = {PromotionStatus(s).value for s in states}
+        docs = await self.jobs.find(
+            lambda d: d.get("promotion_status") in vals
+        )
+        return [JobRecord(**d) for d in docs]
+
     async def get_user_jobs(
         self,
         user_id: str | None,
@@ -743,6 +759,84 @@ class StateStore:
 
     async def delete_dataset(self, dataset_id: str) -> bool:
         return (await self.datasets.delete(dataset_id)) is not None
+
+    # -- rate limiting --------------------------------------------------------
+
+    async def rate_limit_acquire(
+        self, key: str, limit: int, window_s: float = 60.0
+    ) -> bool:
+        """Sliding-window rate-limit check-and-record, atomic in this store's
+        consistency domain: memory/jsonl → per-process (dev), sqlite → all
+        processes sharing the state dir, the remote state service → the whole
+        cluster (the reference's per-process slowapi limits multiply by the
+        worker count — ``app/main.py:377,525,714``; here the scope follows
+        the store)."""
+        if limit <= 0:
+            return True
+        now = time.time()
+        if self._db is not None:
+            # periodic prune: anonymous users key on client IP, so a scanned
+            # deployment accumulates one row per distinct IP — fully-stale
+            # rows (last hit older than their own window) are swept every
+            # few hundred acquires instead of on every hot-path transaction
+            self._rate_ops = getattr(self, "_rate_ops", 0) + 1
+            prune = self._rate_ops % 512 == 0
+
+            def op(conn: sqlite3.Connection) -> bool:
+                conn.execute(
+                    'CREATE TABLE IF NOT EXISTS "rate_limits" '
+                    "(key TEXT PRIMARY KEY, hits TEXT NOT NULL, "
+                    "last_hit REAL NOT NULL DEFAULT 0, "
+                    "window_s REAL NOT NULL DEFAULT 60)"
+                )
+                conn.execute("BEGIN IMMEDIATE")
+                try:
+                    if prune:
+                        conn.execute(
+                            'DELETE FROM "rate_limits" '
+                            "WHERE last_hit + window_s < ?", (now,)
+                        )
+                    row = conn.execute(
+                        'SELECT hits FROM "rate_limits" WHERE key = ?', (key,)
+                    ).fetchone()
+                    hits = [
+                        t for t in (json.loads(row[0]) if row else [])
+                        if t > now - window_s
+                    ]
+                    ok = len(hits) < limit
+                    if ok:
+                        hits.append(now)
+                    conn.execute(
+                        'INSERT INTO "rate_limits" '
+                        "(key, hits, last_hit, window_s) VALUES (?, ?, ?, ?) "
+                        "ON CONFLICT(key) DO UPDATE SET hits = excluded.hits, "
+                        "last_hit = excluded.last_hit, "
+                        "window_s = excluded.window_s",
+                        (key, json.dumps(hits), now, window_s),
+                    )
+                    conn.execute("COMMIT")
+                    return ok
+                except BaseException:
+                    conn.execute("ROLLBACK")
+                    raise
+
+            return await asyncio.to_thread(self._db.run, op)
+
+        if len(self._mem_rate) > 10_000:
+            # sweep fully-stale keys so distinct clients don't grow forever
+            stale = [
+                k for k, dq in self._mem_rate.items()
+                if not dq or dq[-1] <= now - window_s
+            ]
+            for k in stale:
+                del self._mem_rate[k]
+        q = self._mem_rate.setdefault(key, collections.deque())
+        while q and q[0] <= now - window_s:
+            q.popleft()
+        if len(q) >= limit:
+            return False
+        q.append(now)
+        return True
 
 
 def _jsonify(fields: dict[str, Any]) -> dict[str, Any]:

@@ -106,6 +106,155 @@ def controller_deployments(namespace: str, image: str) -> list[dict]:
     return [pvc, deployment, service]
 
 
+def ha_deployments(namespace: str, image: str, api_replicas: int = 3) -> list[dict]:
+    """The HA layout (round-5): N stateless API replicas + one monitor, all
+    pointing ``state_backend=remote`` at the shared state service — the role
+    the reference's external MongoDB plays for its API×4 + monitor split
+    (``app/database/db.py:51``). Only the state service owns the PVC, so the
+    API replicas can land on any node and scale horizontally; rate limits
+    enforced through the service are cluster-scope."""
+    token_env = {
+        "name": "FTC_STATE_SERVICE_TOKEN",
+        "valueFrom": {"secretKeyRef": {
+            "name": "finetune-controller-state-token", "key": "token",
+        }},
+    }
+    svc_token_env = {
+        "name": "FTC_STATE_TOKEN",
+        "valueFrom": {"secretKeyRef": {
+            "name": "finetune-controller-state-token", "key": "token",
+        }},
+    }
+    shared_env = [
+        {"name": "FTC_BACKEND", "value": "k8s"},
+        {"name": "FTC_OBJECT_STORE_BACKEND", "value": "gcs"},
+        {"name": "FTC_NAMESPACE", "value": namespace},
+        {"name": "FTC_STATE_BACKEND", "value": "remote"},
+        {"name": "FTC_STATE_SERVICE_URL",
+         "value": "http://finetune-controller-state:8081"},
+        token_env,
+    ]
+    pvc = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "finetune-controller-state", "namespace": namespace},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": "10Gi"}},
+        },
+    }
+    statestore = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "finetune-controller-state", "namespace": namespace},
+        "spec": {
+            "replicas": 1,  # the one stateful writer; everyone else is stateless
+            "strategy": {"type": "Recreate"},
+            "selector": {"matchLabels": {"app": "finetune-controller-state"}},
+            "template": {
+                "metadata": {"labels": {"app": "finetune-controller-state"}},
+                "spec": {
+                    "containers": [{
+                        "name": "statestore",
+                        "image": image,
+                        "command": [
+                            "python", "-m",
+                            "finetune_controller_tpu.controller.statestore_main",
+                            "--state-dir", "/state", "--port", "8081",
+                        ],
+                        "env": [svc_token_env],
+                        "ports": [{"containerPort": 8081}],
+                        "volumeMounts": [{"name": "state", "mountPath": "/state"}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/healthz", "port": 8081},
+                        },
+                    }],
+                    "volumes": [{
+                        "name": "state",
+                        "persistentVolumeClaim": {
+                            "claimName": "finetune-controller-state"
+                        },
+                    }],
+                },
+            },
+        },
+    }
+    state_svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "finetune-controller-state", "namespace": namespace},
+        "spec": {
+            "selector": {"app": "finetune-controller-state"},
+            "ports": [{"port": 8081, "targetPort": 8081}],
+        },
+    }
+    api = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "finetune-controller-api", "namespace": namespace},
+        "spec": {
+            "replicas": api_replicas,
+            "selector": {"matchLabels": {"app": "finetune-controller-api"}},
+            "template": {
+                "metadata": {"labels": {"app": "finetune-controller-api"}},
+                "spec": {
+                    "serviceAccountName": "finetune-controller",
+                    "containers": [{
+                        "name": "api",
+                        "image": image,
+                        "command": [
+                            "python", "-m",
+                            "finetune_controller_tpu.controller.server",
+                            "--host", "0.0.0.0", "--port", "8787",
+                        ],
+                        "env": shared_env + [
+                            {"name": "FTC_MONITOR_IN_PROCESS", "value": "false"},
+                        ],
+                        "ports": [{"containerPort": 8787}],
+                    }],
+                },
+            },
+        },
+    }
+    monitor = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "finetune-controller-monitor",
+                     "namespace": namespace},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "finetune-controller-monitor"}},
+            "template": {
+                "metadata": {
+                    "labels": {"app": "finetune-controller-monitor"}
+                },
+                "spec": {
+                    "serviceAccountName": "finetune-controller",
+                    "containers": [{
+                        "name": "monitor",
+                        "image": image,
+                        "command": [
+                            "python", "-m",
+                            "finetune_controller_tpu.controller.monitor_main",
+                        ],
+                        "env": shared_env,
+                    }],
+                },
+            },
+        },
+    }
+    api_svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "finetune-controller-api", "namespace": namespace},
+        "spec": {
+            "selector": {"app": "finetune-controller-api"},
+            "ports": [{"port": 80, "targetPort": 8787}],
+        },
+    }
+    return [pvc, statestore, state_svc, api, monitor, api_svc]
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--device-config", default=None,
@@ -113,6 +262,12 @@ def main() -> int:
     p.add_argument("--namespace", default="default")
     p.add_argument("--image", default="finetune-controller-tpu:latest")
     p.add_argument("--out", default="deploy")
+    p.add_argument("--layout", choices=("single", "ha"), default="single",
+                   help="single: API+monitor co-located with an embedded "
+                        "sqlite store; ha: N stateless API replicas + monitor "
+                        "sharing the state service")
+    p.add_argument("--api-replicas", type=int, default=3,
+                   help="API replica count for --layout ha")
     args = p.parse_args()
 
     catalog = load_catalog(args.device_config)
@@ -121,7 +276,12 @@ def main() -> int:
 
     crds = render_kueue_crds(catalog, namespace=args.namespace)
     (out / "kueue-crds.yaml").write_text(yaml.safe_dump_all(crds, sort_keys=False))
-    deployments = controller_deployments(args.namespace, args.image)
+    if args.layout == "ha":
+        deployments = ha_deployments(
+            args.namespace, args.image, args.api_replicas
+        )
+    else:
+        deployments = controller_deployments(args.namespace, args.image)
     (out / "controller.yaml").write_text(
         yaml.safe_dump_all(deployments, sort_keys=False)
     )

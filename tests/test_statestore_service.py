@@ -1,0 +1,243 @@
+"""Shared state service tests (round-5, VERDICT #5).
+
+The reference scales its API horizontally because all replicas talk to one
+external MongoDB (``app/database/db.py:51``). Our equivalent is the state
+service (``controller/statestore_service.py``): these tests run the real
+daemon app with TWO independent ``RemoteStateStore`` clients — the API×N +
+monitor layout in miniature — and prove shared visibility, CAS semantics
+across clients, cluster-scope rate limiting, and token auth. A subprocess
+test covers the ``statestore_main`` entrypoint end to end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import subprocess
+import sys
+import time
+
+from aiohttp.test_utils import TestServer
+
+from conftest import run_async as run
+from finetune_controller_tpu.controller.schemas import (
+    DatabaseStatus,
+    JobRecord,
+    MetricsDocument,
+    PromotionStatus,
+)
+from finetune_controller_tpu.controller.statestore import StateStore
+from finetune_controller_tpu.controller.statestore_service import (
+    RemoteStateStore,
+    build_state_app,
+)
+
+
+def _job(job_id: str, user_id: str = "u") -> JobRecord:
+    return JobRecord(
+        job_id=job_id, user_id=user_id, model_name="tiny-test-lora",
+        device="chip-1", arguments={},
+    )
+
+
+async def _service(tmp_path, token: str = ""):
+    store = StateStore(tmp_path / "state", backend="sqlite")
+    await store.connect()
+    server = TestServer(build_state_app(store, token))
+    await server.start_server()
+    url = str(server.make_url("")).rstrip("/")
+    return store, server, url
+
+
+def test_two_clients_share_one_view(tmp_path):
+    async def go():
+        store, server, url = await _service(tmp_path)
+        a = RemoteStateStore(url)
+        b = RemoteStateStore(url)
+        await a.connect()
+        await b.connect()
+
+        # writes by A are immediately visible to B (the monitor/API split)
+        await a.create_job(_job("j-1"))
+        rec = await b.get_job("j-1")
+        assert rec is not None and rec.status is DatabaseStatus.QUEUED
+
+        assert await b.update_job_status(
+            "j-1", DatabaseStatus.RUNNING,
+            metadata={"node": "n1"}, start_time=100.0,
+        )
+        rec = await a.get_job("j-1")
+        assert rec.status is DatabaseStatus.RUNNING
+        assert rec.start_time == 100.0 and rec.metadata["node"] == "n1"
+
+        # batch + active sweeps
+        await a.create_job(_job("j-2"))
+        jobs = await b.get_jobs_by_ids(["j-1", "j-2", "missing"])
+        assert set(jobs) == {"j-1", "j-2"}
+        assert {j.job_id for j in await a.get_active_jobs()} == {"j-1", "j-2"}
+
+        # paginated table with computed fields
+        page = await b.get_user_jobs("u", page=1, page_size=1)
+        assert page.total == 2 and len(page.items) == 1
+        assert "status_merged" in page.items[0]
+
+        # metrics + datasets round-trip
+        await a.upsert_metrics(MetricsDocument(
+            job_id="j-1", records=[{"step": 1, "loss": 2.0}]
+        ))
+        doc = await b.get_metrics("j-1")
+        assert doc.records[0]["loss"] == 2.0
+
+        # promotion recovery sweep crosses the wire without predicates
+        await a.update_job_promotion("j-1", PromotionStatus.IN_PROGRESS, "obj://d/x")
+        stuck = await b.find_jobs_with_promotion_in([PromotionStatus.IN_PROGRESS])
+        assert [j.job_id for j in stuck] == ["j-1"]
+
+        # archive-on-delete
+        assert await b.delete_job("j-2")
+        assert await a.get_job("j-2") is None
+
+        await a.close()
+        await b.close()
+        await server.close()
+        await store.close()
+
+    run(go())
+
+
+def test_begin_promotion_cas_across_clients(tmp_path):
+    """Concurrent promotion claims from two replicas: exactly one wins."""
+
+    async def go():
+        store, server, url = await _service(tmp_path)
+        a = RemoteStateStore(url)
+        b = RemoteStateStore(url)
+        await a.create_job(_job("p-1"))
+
+        results = await asyncio.gather(*[
+            c.begin_promotion("p-1", PromotionStatus.IN_PROGRESS, "obj://d/p")
+            for c in (a, b) for _ in range(4)
+        ])
+        assert sum(results) == 1
+
+        await a.close()
+        await b.close()
+        await server.close()
+        await store.close()
+
+    run(go())
+
+
+def test_rate_limit_is_cluster_scope(tmp_path):
+    """N replicas share ONE window through the service — the per-process
+    multiplication the reference suffers (app/main.py:377) cannot happen."""
+
+    async def go():
+        store, server, url = await _service(tmp_path)
+        a = RemoteStateStore(url)
+        b = RemoteStateStore(url)
+
+        grants = [
+            await c.rate_limit_acquire("rl/submit/u", 5, 60.0)
+            for _ in range(5) for c in (a, b)
+        ]
+        assert sum(grants) == 5  # NOT 10
+
+        await a.close()
+        await b.close()
+        await server.close()
+        await store.close()
+
+    run(go())
+
+
+def test_token_auth_rejects_bad_clients(tmp_path):
+    async def go():
+        store, server, url = await _service(tmp_path, token="s3cret")
+        good = RemoteStateStore(url, token="s3cret")
+        await good.create_job(_job("t-1"))
+        assert (await good.get_job("t-1")).job_id == "t-1"
+
+        bad = RemoteStateStore(url, token="wrong")
+        try:
+            await bad.get_job("t-1")
+            raise AssertionError("expected auth rejection")
+        except IOError as e:
+            assert "401" in str(e)
+
+        await good.close()
+        await bad.close()
+        await server.close()
+        await store.close()
+
+    run(go())
+
+
+def test_statestore_main_subprocess_entrypoint(tmp_path):
+    """The real daemon process serves a real client — the deployment seam."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "finetune_controller_tpu.controller.statestore_main",
+         "--state-dir", str(tmp_path / "state"),
+         "--host", "127.0.0.1", "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        async def go():
+            client = RemoteStateStore(f"http://127.0.0.1:{port}")
+            deadline = time.time() + 30
+            while True:
+                try:
+                    await client.connect()
+                    break
+                except Exception:
+                    assert time.time() < deadline, "state service never came up"
+                    assert proc.poll() is None, "state service exited early"
+                    await asyncio.sleep(0.2)
+            await client.create_job(_job("sub-1"))
+            assert (await client.get_job("sub-1")).job_id == "sub-1"
+            assert await client.rate_limit_acquire("k", 1, 60.0)
+            assert not await client.rate_limit_acquire("k", 1, 60.0)
+            await client.close()
+
+        run(go())
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_sqlite_rate_limit_shared_across_store_instances(tmp_path):
+    """Two StateStore instances on one state dir (API worker + monitor on a
+    node) share the sliding window through the WAL database."""
+
+    async def go():
+        a = StateStore(tmp_path / "state", backend="sqlite")
+        b = StateStore(tmp_path / "state", backend="sqlite")
+        await a.connect()
+        await b.connect()
+        grants = [
+            await c.rate_limit_acquire("rl/read/u", 3, 60.0)
+            for _ in range(3) for c in (a, b)
+        ]
+        assert sum(grants) == 3
+        await a.close()
+        await b.close()
+
+    run(go())
+
+
+def test_memory_store_rate_limit_window(tmp_path):
+    """The in-memory engine keeps the old per-process semantics (dev)."""
+
+    async def go():
+        store = StateStore(None)
+        assert await store.rate_limit_acquire("k", 2, 0.2)
+        assert await store.rate_limit_acquire("k", 2, 0.2)
+        assert not await store.rate_limit_acquire("k", 2, 0.2)
+        await asyncio.sleep(0.25)
+        assert await store.rate_limit_acquire("k", 2, 0.2)
+
+    run(go())
