@@ -1,0 +1,58 @@
+"""End-to-end fidelity proof on the CPU backend (round-5, VERDICT #1).
+
+Everything else in the suite measures mechanics; this test proves the
+*product claim* — a pretrained base fine-tuned through the full controller
+path gets measurably better on real data and visibly changes behavior —
+mirroring how the reference's one example trains real MNIST to convergence
+(reference ``app/models/examples/mnist.py:13-99``).
+
+The scale is shrunk (smaller corpus, fewer steps) but nothing is mocked:
+real English text, a real pretraining run, an HF-format export/import round
+trip, a controller-submitted subprocess LoRA job, and greedy generation from
+the job's synced artifacts.
+"""
+
+import json
+from pathlib import Path
+
+from finetune_controller_tpu.fidelity import (
+    HOLDOUT_TOPICS,
+    SFT_PREFIX,
+    run_proof,
+    sft_prompt,
+)
+
+
+def test_fidelity_proof_end_to_end(tmp_path):
+    record = run_proof(
+        tmp_path,
+        pretrain_steps=120,
+        corpus_bytes=80_000,
+        sft_steps=80,
+        job_deadline_s=240.0,
+    )
+
+    # the base must have learned real English: far below random-init loss
+    assert record["pretrain_final_loss"] < 0.7 * record["pretrain_first_loss"]
+
+    # step-0 loss from the base << random init (knowledge transferred
+    # through export -> controller submit -> hf_import)
+    assert record["checks"]["base_transfers"], record
+    assert record["base_step0_loss"] < 0.75 * record["random_init_loss"]
+
+    # the fine-tune learned from the SFT signal
+    assert record["checks"]["finetune_learns"], record
+    assert record["final_loss"] < record["base_step0_loss"]
+
+    # behavior change on a HELD-OUT topic: the SFT style appears only after
+    assert record["probe_prompt"] == sft_prompt(HOLDOUT_TOPICS[0])
+    assert record["after_generation"].startswith(SFT_PREFIX)
+    assert not record["before_generation"].startswith(SFT_PREFIX)
+    assert record["passed"]
+
+    # the record ships with the job's artifacts (promotion publishes it)
+    on_disk = json.loads(Path(record["record_path"]).read_text())
+    assert on_disk["passed"] is True
+    art = Path(record["record_path"]).parent
+    assert (art / "adapter" / "adapter_config.json").exists()
+    assert (art / "metrics.csv").exists()
