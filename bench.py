@@ -98,7 +98,9 @@ def _latest_session_tpu_record(kind_prefix: str) -> dict | None:
     carries the most recent chip-measured headline alongside the honest CPU
     fallback instead of looking like a perf regression.  Prefers the newest
     record whose metric matches the requested bench kind (``lora_``,
-    ``qlora_`` …); falls back to the newest TPU record of any kind.
+    ``qlora_`` …); returns None when no same-kind record exists — a cached
+    headline of a DIFFERENT kind would misattribute the number to automated
+    consumers reading only value/vs_baseline.
     """
     def is_default_config(rec: dict) -> bool:
         # the session script's headline steps, or an ad-hoc run with no
@@ -111,7 +113,7 @@ def _latest_session_tpu_record(kind_prefix: str) -> dict | None:
         return not any(k in env for k in
                        ("BENCH_PRESET", "BENCH_SEQ", "BENCH_BATCH"))
 
-    best_any = best_kind = best_default = None
+    best_kind = best_default = None
     try:
         with open(SESSION_LOG) as f:
             for line in f:
@@ -123,14 +125,14 @@ def _latest_session_tpu_record(kind_prefix: str) -> dict | None:
                         or not rec.get("metric")
                         or "tpu" not in str(rec.get("device_kind", "")).lower()):
                     continue
-                best_any = rec  # file is append-ordered: last wins
+                # file is append-ordered: last matching record wins
                 if str(rec["metric"]).startswith(kind_prefix):
                     best_kind = rec
                     if is_default_config(rec):
                         best_default = rec
     except OSError:
         return None
-    rec = best_default or best_kind or best_any
+    rec = best_default or best_kind
     if rec is None:
         return None
     keep = ("ts", "step", "metric", "value", "unit", "vs_baseline", "mfu",

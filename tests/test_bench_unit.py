@@ -88,8 +88,9 @@ def test_latest_session_tpu_record_prefers_kind(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "SESSION_LOG", str(log))
     rec = bench._latest_session_tpu_record("qlora_")
     assert rec["step"] == "b" and rec["value"] == 50.0
-    rec = bench._latest_session_tpu_record("mm_lora_")
-    assert rec["step"] == "b"  # newest TPU record of any kind
+    # no same-kind record -> None (a different kind's headline cached under
+    # this bench's name would misattribute the number)
+    assert bench._latest_session_tpu_record("mm_lora_") is None
     monkeypatch.setattr(bench, "SESSION_LOG", str(tmp_path / "absent.jsonl"))
     assert bench._latest_session_tpu_record("lora_") is None
 
