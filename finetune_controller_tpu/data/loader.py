@@ -80,10 +80,10 @@ def _render_chat(messages, encode_fragment, header_cache: dict) -> "Document":
 Document = tuple[list[int], list[int]]
 
 
-def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[Document]:
-    if path.endswith(".npy"):
-        toks = np.load(path).astype(np.int32).tolist()
-        return [(toks, [1] * len(toks))]
+def make_encoders(tokenizer_file: str | None):
+    """(encode, encode_fragment) pair: HF ``tokenizers`` file when given,
+    byte-level fallback otherwise — shared by the text and multimodal
+    loaders so tokenizer-handling fixes land in both."""
     tokenizer = None
     if tokenizer_file:
         from tokenizers import Tokenizer
@@ -102,6 +102,14 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
             return tokenizer.encode(text, add_special_tokens=False).ids
         return _byte_tokenize(text)
 
+    return encode, encode_fragment
+
+
+def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[Document]:
+    if path.endswith(".npy"):
+        toks = np.load(path).astype(np.int32).tolist()
+        return [(toks, [1] * len(toks))]
+    encode, encode_fragment = make_encoders(tokenizer_file)
     header_cache: dict[str, list[int]] = {}
     docs: list[Document] = []
     with open(path) as f:
@@ -109,40 +117,50 @@ def load_token_documents(path: str, tokenizer_file: str | None = None) -> list[D
             line = line.strip()
             if not line:
                 continue
-            row = json.loads(line)
-            if "tokens" in row:
-                toks = [int(t) for t in row["tokens"]]
-                docs.append((toks, [1] * len(toks)))
-            elif "text" in row:
-                toks = encode(row["text"])
-                docs.append((toks, [1] * len(toks)))
-            elif "prompt_tokens" in row and "completion_tokens" in row:
-                p = [int(t) for t in row["prompt_tokens"]]
-                c = [int(t) for t in row["completion_tokens"]]
-                docs.append((p + c, [0] * len(p) + [1] * len(c)))
-            elif "prompt" in row and "completion" in row:
-                p, c = encode(row["prompt"]), encode(row["completion"])
-                docs.append((p + c, [0] * len(p) + [1] * len(c)))
-            elif "messages" in row:
-                doc = _render_chat(row["messages"], encode_fragment, header_cache)
-                if not any(doc[1]):
-                    # an all-masked chat doc trains on NOTHING — the classic
-                    # wrong-role footgun ({"role": "model"}), caught per row
-                    # so a mixed corpus can't hide it
-                    raise ValueError(
-                        "chat row produced no assistant-content tokens (the "
-                        "loss mask is empty): the template counts loss only "
-                        f"for role == 'assistant'. Row: {line[:120]}"
-                    )
-                docs.append(doc)
-            else:
-                raise ValueError(
-                    "jsonl rows must have 'tokens', 'text', "
-                    "'prompt'/'completion', or 'messages' fields"
-                )
+            docs.append(parse_text_row(
+                json.loads(line), encode, encode_fragment, header_cache,
+                line=line,
+            ))
     if not docs:
         raise ValueError(f"no documents found in {path}")
     return docs
+
+
+def parse_text_row(
+    row: dict, encode, encode_fragment, header_cache: dict, line: str = ""
+) -> Document:
+    """One jsonl row → (tokens, loss_flags). Shared by the text loader and
+    the multimodal loader (``data/mm_loader.py``), which reads the same text
+    schemas next to an ``image`` field."""
+    if "tokens" in row:
+        toks = [int(t) for t in row["tokens"]]
+        return toks, [1] * len(toks)
+    if "text" in row:
+        toks = encode(row["text"])
+        return toks, [1] * len(toks)
+    if "prompt_tokens" in row and "completion_tokens" in row:
+        p = [int(t) for t in row["prompt_tokens"]]
+        c = [int(t) for t in row["completion_tokens"]]
+        return p + c, [0] * len(p) + [1] * len(c)
+    if "prompt" in row and "completion" in row:
+        p, c = encode(row["prompt"]), encode(row["completion"])
+        return p + c, [0] * len(p) + [1] * len(c)
+    if "messages" in row:
+        doc = _render_chat(row["messages"], encode_fragment, header_cache)
+        if not any(doc[1]):
+            # an all-masked chat doc trains on NOTHING — the classic
+            # wrong-role footgun ({"role": "model"}), caught per row
+            # so a mixed corpus can't hide it
+            raise ValueError(
+                "chat row produced no assistant-content tokens (the "
+                "loss mask is empty): the template counts loss only "
+                f"for role == 'assistant'. Row: {line[:120]}"
+            )
+        return doc
+    raise ValueError(
+        "jsonl rows must have 'tokens', 'text', "
+        "'prompt'/'completion', or 'messages' fields"
+    )
 
 
 def pack_documents(

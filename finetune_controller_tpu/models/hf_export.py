@@ -61,6 +61,7 @@ def export_lora_adapter(
     out_dir: Path | str,
     *,
     base_model_name: str = "",
+    hf_prefix: str = "base_model.model.model.layers",
 ) -> Path:
     """Write a PEFT-format LoRA adapter directory.
 
@@ -68,6 +69,8 @@ def export_lora_adapter(
     target module with scaling ``alpha / r`` — ours are flax ``(in, r)`` /
     ``(r, out)`` kernels with the same scaling, so the export is a transpose
     per tensor (verified numerically against ``peft`` in the tests).
+    ``hf_prefix`` names the base model's layer path — multimodal adapters
+    target the decoder nested under ``language_model`` in HF's LLaVA.
     """
     out_dir = Path(out_dir).expanduser()
     out_dir.mkdir(parents=True, exist_ok=True)
@@ -76,7 +79,7 @@ def export_lora_adapter(
     for proj, leaves in modules.items():
         a, b = leaves["lora_a"], leaves["lora_b"]     # (L, in, r), (L, r, out)
         for i in range(a.shape[0]):
-            prefix = f"base_model.model.model.layers.{i}.{_HF_MODULE[proj]}"
+            prefix = f"{hf_prefix}.{i}.{_HF_MODULE[proj]}"
             tensors[f"{prefix}.lora_A.weight"] = a[i].T.astype(np.float32)
             tensors[f"{prefix}.lora_B.weight"] = b[i].T.astype(np.float32)
     _save_safetensors(out_dir / "adapter_model.safetensors", tensors)
@@ -96,6 +99,30 @@ def export_lora_adapter(
     (out_dir / "adapter_config.json").write_text(json.dumps(adapter_config, indent=2))
     logger.info("wrote PEFT adapter (%d tensors) -> %s", len(tensors), out_dir)
     return out_dir
+
+
+def export_mm_projector(projector: dict, out_dir: Path | str) -> Path:
+    """Write the trained LLaVA projector beside the adapter, in HF's
+    ``multi_modal_projector`` naming — the piece the LLaVA recipe trains
+    outside the PEFT adapter (upstream llava ships it as
+    ``non_lora_trainables``; ours is a safetensors file a deploy script maps
+    straight onto ``LlavaForConditionalGeneration``)."""
+    out_dir = Path(out_dir).expanduser()
+    out_dir.mkdir(parents=True, exist_ok=True)
+    tensors = {
+        "multi_modal_projector.linear_1.weight": np.asarray(
+            projector["projector_fc1"]["kernel"], np.float32).T,
+        "multi_modal_projector.linear_1.bias": np.asarray(
+            projector["projector_fc1"]["bias"], np.float32),
+        "multi_modal_projector.linear_2.weight": np.asarray(
+            projector["projector_fc2"]["kernel"], np.float32).T,
+        "multi_modal_projector.linear_2.bias": np.asarray(
+            projector["projector_fc2"]["bias"], np.float32),
+    }
+    path = out_dir / "projector.safetensors"
+    _save_safetensors(path, tensors)
+    logger.info("wrote multimodal projector -> %s", path)
+    return path
 
 
 def _base_kernel(leaves: dict[str, np.ndarray], layer: int, cfg: LlamaConfig) -> np.ndarray:

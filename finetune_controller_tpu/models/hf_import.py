@@ -29,6 +29,7 @@ import logging
 from pathlib import Path
 from typing import Any, Iterator
 
+import jax
 import numpy as np
 
 from .llama import LlamaConfig
@@ -78,7 +79,19 @@ def load_llama_params(
     silent garbage training.
     """
     ckpt_dir = Path(ckpt_dir).expanduser()
-    dtype = dtype or cfg.param_dtype
+    pairs = ((_strip(n), a) for n, a in _iter_checkpoint_tensors(ckpt_dir))
+    params = _map_llama_tensors(pairs, cfg, dtype or cfg.param_dtype)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    logger.info("loaded %d tensors (%.1fM params) from %s",
+                len(jax.tree.leaves(params)), n_params / 1e6, ckpt_dir)
+    return params
+
+
+def _map_llama_tensors(
+    pairs, cfg: LlamaConfig, dtype: Any
+) -> dict[str, Any]:
+    """Map stripped ``(hf_name, array)`` pairs onto the Llama param tree
+    (shared by the text-only loader and the LLaVA language-model half)."""
     L = cfg.n_layers
 
     # staging area: per-layer dicts to stack once everything is read
@@ -86,8 +99,7 @@ def load_llama_params(
     top: dict[str, np.ndarray] = {}
     unexpected: list[str] = []
 
-    for name, arr in _iter_checkpoint_tensors(ckpt_dir):
-        key = _strip(name)
+    for key, arr in pairs:
         if "rotary_emb.inv_freq" in key:
             # non-persistent RoPE buffer serialized by transformers < 4.32
             # (Llama-2-era .bin checkpoints); recomputed from config here
@@ -107,7 +119,7 @@ def load_llama_params(
                 )
             layers[idx][rest] = arr
         else:
-            unexpected.append(name)
+            unexpected.append(key)
     if unexpected:
         raise ValueError(f"unexpected checkpoint tensors: {unexpected[:5]}")
 
@@ -157,8 +169,6 @@ def load_llama_params(
     if missing:
         raise ValueError(f"checkpoint has no tensors for layers {missing[:5]}")
     trees = [layer_tree(rest, i) for i, rest in enumerate(layers)]
-    import jax
-
     stacked = jax.tree.map(lambda *xs: np.stack(xs).astype(dtype), *trees)
 
     if "embedding" not in top or "final_norm" not in top:
@@ -177,7 +187,144 @@ def load_llama_params(
                 "checkpoint has no lm_head.weight but cfg.tie_embeddings=False"
             )
         params["lm_head"] = {"kernel": top["lm_head"].astype(dtype)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# LLaVA: CLIP vision tower + projector + Llama language model (round 5)
+# ---------------------------------------------------------------------------
+
+
+def _map_vision_tensors(vt: dict[str, np.ndarray], vcfg, dtype) -> dict[str, Any]:
+    """Map CLIP vision-model tensors (``vision_tower.vision_model.`` stripped)
+    onto our :class:`~.multimodal.ViTEncoder` tree.
+
+    Layout notes: HF conv weight ``(out, in, h, w)`` → flax ``(h, w, in,
+    out)``; q/k/v/out ``(d, d)`` matrices reshape onto flax
+    ``MultiHeadDotProductAttention``'s ``(d, H, hd)`` / ``(H, hd, d)``
+    kernels. With ``feature_layer=-k`` the final ``k-1`` encoder layers and
+    the post norm exist in the checkpoint but are never run (LLaVA-1.5 takes
+    hidden_states[-2]) — they are skipped, not errors."""
+    d, H = vcfg.d_model, vcfg.n_heads
+    hd = d // H
+    tree: dict[str, Any] = {}
+
+    def pop(key: str) -> np.ndarray:
+        try:
+            return vt.pop(key)
+        except KeyError:
+            raise ValueError(
+                f"vision tower missing tensor {key!r} — config/checkpoint "
+                "mismatch"
+            ) from None
+
+    tree["patch_embed"] = {
+        "kernel": pop("embeddings.patch_embedding.weight").transpose(2, 3, 1, 0)
+    }
+    if vcfg.patch_bias:
+        tree["patch_embed"]["bias"] = pop("embeddings.patch_embedding.bias")
+    tree["pos_embed"] = pop("embeddings.position_embedding.weight")[None]
+    if vcfg.cls_token:
+        tree["cls"] = pop("embeddings.class_embedding").reshape(1, 1, d)
+    if vcfg.pre_norm:
+        # (the "pre_layrnorm" typo is transformers' own attribute name)
+        tree["pre_norm"] = {
+            "scale": pop("pre_layrnorm.weight"),
+            "bias": pop("pre_layrnorm.bias"),
+        }
+    n_run = (
+        vcfg.n_layers if vcfg.feature_layer == 0
+        else vcfg.n_layers + vcfg.feature_layer + 1
+    )
+    for i in range(n_run):
+        p = f"encoder.layers.{i}."
+
+        def qkv(nm: str) -> dict[str, np.ndarray]:
+            return {
+                "kernel": pop(f"{p}self_attn.{nm}_proj.weight").T.reshape(d, H, hd),
+                "bias": pop(f"{p}self_attn.{nm}_proj.bias").reshape(H, hd),
+            }
+
+        tree[f"block_{i}"] = {
+            "ln1": {"scale": pop(f"{p}layer_norm1.weight"),
+                    "bias": pop(f"{p}layer_norm1.bias")},
+            "attn": {
+                "query": qkv("q"), "key": qkv("k"), "value": qkv("v"),
+                "out": {
+                    "kernel": pop(f"{p}self_attn.out_proj.weight").T.reshape(H, hd, d),
+                    "bias": pop(f"{p}self_attn.out_proj.bias"),
+                },
+            },
+            "ln2": {"scale": pop(f"{p}layer_norm2.weight"),
+                    "bias": pop(f"{p}layer_norm2.bias")},
+            "fc1": {"kernel": pop(f"{p}mlp.fc1.weight").T,
+                    "bias": pop(f"{p}mlp.fc1.bias")},
+            "fc2": {"kernel": pop(f"{p}mlp.fc2.weight").T,
+                    "bias": pop(f"{p}mlp.fc2.bias")},
+        }
+    if vcfg.feature_layer == 0:
+        tree["final_norm"] = {
+            "scale": pop("post_layernorm.weight"),
+            "bias": pop("post_layernorm.bias"),
+        }
+    # tensors the selected feature layer never touches
+    skippable = tuple(
+        f"encoder.layers.{i}." for i in range(n_run, vcfg.n_layers)
+    ) + (("post_layernorm.",) if vcfg.feature_layer != 0 else ())
+    leftover = [k for k in vt if not k.startswith(skippable)]
+    if leftover:
+        raise ValueError(f"unmapped vision tensors: {sorted(leftover)[:5]}")
+    return jax.tree.map(lambda x: np.asarray(x, dtype), tree)
+
+
+def load_llava_params(
+    ckpt_dir: Path | str,
+    cfg,  # LlavaConfig
+    *,
+    dtype: Any = None,
+) -> dict[str, Any]:
+    """Build ``LlavaForCausalLM``'s ``params`` collection from an HF LLaVA
+    checkpoint dir (``LlavaForConditionalGeneration`` layout:
+    ``vision_tower.vision_model.*`` + ``multi_modal_projector.*`` +
+    ``language_model.*``). Numerically parity-tested against transformers in
+    ``tests/test_hf_import.py``."""
+    ckpt_dir = Path(ckpt_dir).expanduser()
+    dtype = dtype or cfg.text.param_dtype
+
+    text_pairs: list[tuple[str, np.ndarray]] = []
+    vision: dict[str, np.ndarray] = {}
+    proj: dict[str, np.ndarray] = {}
+    unexpected: list[str] = []
+    for name, arr in _iter_checkpoint_tensors(ckpt_dir):
+        # transformers >= 4.52 nests the text model under model.*
+        name = name.removeprefix("model.")
+        if name.startswith("language_model."):
+            text_pairs.append((_strip(name.removeprefix("language_model.")), arr))
+        elif name.startswith("vision_tower.vision_model."):
+            vision[name.removeprefix("vision_tower.vision_model.")] = arr
+        elif name.startswith("multi_modal_projector."):
+            proj[name.removeprefix("multi_modal_projector.")] = arr
+        else:
+            unexpected.append(name)
+    if unexpected:
+        raise ValueError(f"unexpected checkpoint tensors: {unexpected[:5]}")
+
+    params = _map_llama_tensors(iter(text_pairs), cfg.text, dtype)
+    params["vision_tower"] = _map_vision_tensors(vision, cfg.vision, dtype)
+    try:
+        params["projector_fc1"] = {
+            "kernel": np.asarray(proj.pop("linear_1.weight").T, dtype),
+            "bias": np.asarray(proj.pop("linear_1.bias"), dtype),
+        }
+        params["projector_fc2"] = {
+            "kernel": np.asarray(proj.pop("linear_2.weight").T, dtype),
+            "bias": np.asarray(proj.pop("linear_2.bias"), dtype),
+        }
+    except KeyError as e:
+        raise ValueError(f"projector missing tensor {e}") from None
+    if proj:
+        raise ValueError(f"unmapped projector tensors: {sorted(proj)[:5]}")
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    logger.info("loaded %d tensors (%.1fM params) from %s",
-                len(jax.tree.leaves(params)), n_params / 1e6, ckpt_dir)
+    logger.info("loaded LLaVA checkpoint (%.1fM params) from %s",
+                n_params / 1e6, ckpt_dir)
     return params

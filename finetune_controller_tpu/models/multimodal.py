@@ -40,6 +40,25 @@ class ViTConfig:
     d_ff: int = 4096
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # --- CLIP-compatibility knobs (round-5: real LLaVA towers import from
+    # HF checkpoints — hf_import.load_llava_params). Defaults keep the
+    # native recipe; the llava preset flips them to CLIP ViT-L/14 semantics.
+    #: prepend a learned class token (CLIP); LLaVA's feature selection drops
+    #: it from the encoder OUTPUT, but it participates in attention
+    cls_token: bool = False
+    #: LayerNorm right after embeddings (CLIP's pre_layrnorm)
+    pre_norm: bool = False
+    #: patch conv bias (CLIP uses none)
+    patch_bias: bool = True
+    #: MLP activation: "gelu" (exact, HF nn.GELU) | "quick_gelu"
+    #: (x·sigmoid(1.702x) — OpenAI CLIP)
+    act: str = "gelu"
+    #: which hidden state feeds the projector: 0 = all layers + final norm
+    #: (native); negative = CLIP hidden_states index (LLaVA-1.5 uses -2 —
+    #: stop before the last layer, skip the post norm)
+    feature_layer: int = 0
+    #: LayerNorm epsilon (CLIP uses 1e-5; flax's default is 1e-6)
+    ln_eps: float = 1e-5
 
     @property
     def n_patches(self) -> int:
@@ -99,22 +118,34 @@ class LlavaConfig:
         return vit + proj + self.text.param_count()
 
 
+def _vit_act(cfg: ViTConfig, h: jax.Array) -> jax.Array:
+    if cfg.act == "quick_gelu":
+        return h * jax.nn.sigmoid(1.702 * h)
+    if cfg.act == "gelu":
+        return nn.gelu(h, approximate=False)
+    raise ValueError(f"unknown ViT activation {cfg.act!r}")
+
+
 class ViTBlock(nn.Module):
     cfg: ViTConfig
 
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln1")(x)
         h = nn.MultiHeadDotProductAttention(
             num_heads=cfg.n_heads, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="attn",
         )(h, h)
         x = x + h
-        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
-        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=cfg.param_dtype)(h)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype)(h)
+        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln2")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="fc1")(h)
+        h = _vit_act(cfg, h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="fc2")(h)
         return x + h
 
 
@@ -123,29 +154,62 @@ class ViTEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, pixels: jax.Array) -> jax.Array:
-        """pixels (B, H, W, 3) → (B, n_patches, d_model)."""
+        """pixels (B, H, W, 3) → (B, n_patches, d_model).
+
+        With ``cls_token`` the class token rides through attention and is
+        dropped from the OUTPUT (LLaVA's "default" feature selection);
+        ``feature_layer=-k`` stops k-1 layers early and skips the post norm
+        (LLaVA-1.5 takes CLIP's hidden_states[-2])."""
         cfg = self.cfg
         x = nn.Conv(
             cfg.d_model,
             kernel_size=(cfg.patch_size, cfg.patch_size),
             strides=(cfg.patch_size, cfg.patch_size),
+            use_bias=cfg.patch_bias,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="patch_embed",
         )(pixels.astype(cfg.dtype))
         b = x.shape[0]
         x = x.reshape(b, -1, cfg.d_model)
+        n_tokens = cfg.n_patches
+        if cfg.cls_token:
+            cls = self.param(
+                "cls", nn.initializers.normal(stddev=0.02),
+                (1, 1, cfg.d_model), cfg.param_dtype,
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls.astype(cfg.dtype), (b, 1, cfg.d_model)), x],
+                axis=1,
+            )
+            n_tokens += 1
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(stddev=0.02),
-            (1, cfg.n_patches, cfg.d_model),
+            (1, n_tokens, cfg.d_model),
             cfg.param_dtype,
         )
         x = x + pos.astype(cfg.dtype)
-        for i in range(cfg.n_layers):
+        if cfg.pre_norm:
+            x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="pre_norm")(x)
+        n_run = (
+            cfg.n_layers if cfg.feature_layer == 0
+            else cfg.n_layers + cfg.feature_layer + 1
+        )
+        if not 0 < n_run <= cfg.n_layers:
+            raise ValueError(
+                f"feature_layer {cfg.feature_layer} out of range for "
+                f"{cfg.n_layers} layers"
+            )
+        for i in range(n_run):
             x = ViTBlock(cfg, name=f"block_{i}")(x)
-        return nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                            name="final_norm")(x)
+        if cfg.feature_layer == 0:
+            x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="final_norm")(x)
+        if cfg.cls_token:
+            x = x[:, 1:]  # feature selection drops CLS
+        return x
 
 
 class LlavaForCausalLM(nn.Module):
@@ -177,7 +241,9 @@ class LlavaForCausalLM(nn.Module):
             # 2-layer MLP projector (LLaVA-1.5 recipe)
             h = nn.Dense(cfg.projector_hidden, dtype=tcfg.dtype,
                          param_dtype=tcfg.param_dtype, name="projector_fc1")(patches)
-            h = nn.gelu(h)
+            # exact GELU — HF's multi_modal_projector uses nn.GELU (erf
+            # form), and the imported projector must reproduce it
+            h = nn.gelu(h, approximate=False)
             img_emb = nn.Dense(tcfg.d_model, dtype=tcfg.dtype,
                                param_dtype=tcfg.param_dtype, name="projector_fc2")(h)
             n_img = img_emb.shape[1]
@@ -238,9 +304,14 @@ class LlavaForCausalLM(nn.Module):
 
 MM_PRESETS: dict[str, LlavaConfig] = {
     "llava-1.5-7b": LlavaConfig(
-        vision=ViTConfig(),  # ViT-L/14-ish at 336px
+        # CLIP ViT-L/14 @ 336px with LLaVA-1.5 semantics: class token, CLIP
+        # pre-norm, quick-gelu, bias-free patch conv, penultimate-layer
+        # features — the exact tower llava-hf/llava-1.5-7b-hf ships, so
+        # hf_import.load_llava_params maps it 1:1
+        vision=ViTConfig(cls_token=True, pre_norm=True, patch_bias=False,
+                         act="quick_gelu", feature_layer=-2),
         text=LlamaConfig(
-            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            vocab_size=32064, d_model=4096, n_layers=32, n_heads=32,
             n_kv_heads=32, d_ff=11008, max_seq_len=4096, attention_impl="auto",
         ),
         projector_hidden=4096,
@@ -251,6 +322,19 @@ MM_PRESETS: dict[str, LlavaConfig] = {
         text=LlamaConfig(
             vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
             d_ff=128, max_seq_len=128,
+        ),
+        projector_hidden=64,
+    ),
+    # CLIP-semantics tiny model: the import/e2e test shape — structurally a
+    # miniature llava-1.5-7b (class token, pre-norm, quick-gelu,
+    # penultimate-layer features), loadable from a tiny HF LLaVA checkpoint
+    "tiny-mm-clip-test": LlavaConfig(
+        vision=ViTConfig(image_size=16, patch_size=8, d_model=32, n_layers=3,
+                         n_heads=2, d_ff=64, cls_token=True, pre_norm=True,
+                         patch_bias=False, act="quick_gelu", feature_layer=-2),
+        text=LlamaConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, rms_eps=1e-6,
         ),
         projector_hidden=64,
     ),

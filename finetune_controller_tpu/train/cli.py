@@ -87,6 +87,22 @@ def build_batches(
 
     ds = spec.get("dataset", {})
     path = ds.get("eval_path") if split == "eval" else ds.get("path")
+    if path and model_cfg.image_size:
+        # image-bearing rows: one sample per row, pixels resized to the
+        # model's vision tower (data/mm_loader.py)
+        from ..data.mm_loader import mm_jsonl_batches
+
+        return mm_jsonl_batches(
+            path,
+            batch_size=local_batch_size,
+            seq_len=train_cfg.seq_len,
+            image_size=model_cfg.image_size,
+            tokenizer_file=ds.get("tokenizer_file"),
+            seed=train_cfg.seed,
+            shard_index=shard_index,
+            shard_count=shard_count,
+            normalize=ds.get("image_normalize", "clip"),
+        )
     if path:
         return jsonl_token_batches(
             path,

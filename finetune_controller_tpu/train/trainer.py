@@ -393,17 +393,24 @@ class Trainer:
             assert not self._use_dropout, "pp path has no dropout support"
             from ..models.llama import pipelined_causal_lm_logits
 
+            from ..parallel.pipeline import (
+                bubble_fraction,
+                default_pp_microbatches,
+            )
+
             n_micro = self.cfg.pp_microbatches
             if not n_micro:
-                # default: the largest microbatch count <= 2·pp that divides
-                # the per-data-shard batch (2·pp halves the GPipe bubble)
                 local = batch["tokens"].shape[0] // (
                     self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
                 )
-                n_micro = max(
-                    (m for m in range(1, 2 * self._pp + 1) if local % m == 0),
-                    default=1,
-                )
+                n_micro = default_pp_microbatches(local, self._pp)
+
+            # trace-time (runs once per compilation, not per step)
+            logger.info(
+                "GPipe schedule: %d microbatches over %d stages — bubble "
+                "fraction %.1f%%", n_micro, self._pp,
+                100 * bubble_fraction(n_micro, self._pp),
+            )
             logits = pipelined_causal_lm_logits(
                 self.model_cfg, variables, batch["tokens"],
                 mesh=self.mesh, n_micro=n_micro,
@@ -626,25 +633,51 @@ class Trainer:
         LoRA/QLoRA modes load into the frozen ``params`` collection (int4
         kernels are quantized on the way in); full fine-tune loads into the
         trainable tree. The loaded tree is shape-checked leaf-by-leaf against
-        the initialised state so a config mismatch fails loudly."""
-        if self._is_multimodal:
-            raise ValueError("pretrained import currently covers the Llama family")
-        from ..models.hf_import import load_llama_params
+        the initialised state so a config mismatch fails loudly.
 
-        loaded = load_llama_params(ckpt_dir, self.model_cfg)
-        if self.cfg.mode == "lora":
-            target, shardings = state.frozen["params"], self._state_shardings.frozen["params"]
-        else:
-            target, shardings = state.trainable, self._state_shardings.trainable
-        adapted = _adapt_loaded_params(
-            loaded, target, quant_block=self.model_cfg.quant_block
+        Multimodal (LLaVA): the checkpoint's vision tower + language model
+        fill the frozen base, and the projector fills its slot in the
+        TRAINABLE tree (the LLaVA recipe always trains the projector)."""
+        quant_block = getattr(self.model_cfg, "quant_block", None) or (
+            self.model_cfg.text.quant_block if self._is_multimodal else 64
         )
-        adapted = reshard(adapted, shardings)
-        if self.cfg.mode == "lora":
-            frozen = dict(state.frozen)
-            frozen["params"] = adapted
-            return state.replace(frozen=frozen)
-        return state.replace(trainable=adapted)
+        if self._is_multimodal:
+            from ..models.hf_import import load_llava_params
+
+            loaded = load_llava_params(ckpt_dir, self.model_cfg)
+        else:
+            from ..models.hf_import import load_llama_params
+
+            loaded = load_llama_params(ckpt_dir, self.model_cfg)
+        if self.cfg.mode != "lora":
+            adapted = _adapt_loaded_params(
+                loaded, state.trainable, quant_block=quant_block
+            )
+            adapted = reshard(adapted, self._state_shardings.trainable)
+            return state.replace(trainable=adapted)
+        if self._is_multimodal:
+            proj_loaded = {
+                k: loaded.pop(k) for k in self._MM_TRAINED_PARAMS if k in loaded
+            }
+            proj = _adapt_loaded_params(
+                proj_loaded, state.trainable["projector"],
+                quant_block=quant_block,
+            )
+            proj = reshard(proj, self._state_shardings.trainable["projector"])
+            trainable = dict(state.trainable)
+            trainable["projector"] = proj
+        else:
+            trainable = None
+        adapted = _adapt_loaded_params(
+            loaded, state.frozen["params"], quant_block=quant_block
+        )
+        adapted = reshard(adapted, self._state_shardings.frozen["params"])
+        frozen = dict(state.frozen)
+        frozen["params"] = adapted
+        state = state.replace(frozen=frozen)
+        if trainable is not None:
+            state = state.replace(trainable=trainable)
+        return state
 
     def export_artifacts(
         self,
@@ -662,10 +695,20 @@ class Trainer:
         of GBs of frozen weights, rank 0 reloads the base host-side from the
         original safetensors and merges the already-gathered adapter into it
         (reference promotion contract: ``app/tasks/promotion.py:11-38`` — a
-        deployable artifact for every job type)."""
-        if self._is_multimodal or self.cfg.mode != "lora":
+        deployable artifact for every job type).
+
+        Multimodal LoRA runs export the decoder adapter (PEFT format, keyed
+        under ``language_model`` — HF LLaVA's layout) plus the trained
+        projector (``adapter/projector.safetensors``); merged multimodal
+        export is out of scope (the tower/projector/decoder split has no
+        single-file HF form a text merge could produce)."""
+        if self.cfg.mode != "lora":
             return
-        if not self.model_cfg.scan_layers:
+        scan = (
+            self.model_cfg.text.scan_layers if self._is_multimodal
+            else self.model_cfg.scan_layers
+        )
+        if not scan:
             logger.warning(
                 "HF adapter export supports the scanned layer layout only "
                 "(scan_layers=False run): skipping export"
@@ -675,8 +718,27 @@ class Trainer:
         host = self.state_to_host(state, fields=("trainable",))
         if jax.process_index() != 0:
             return
-        from ..models.hf_export import export_lora_adapter, export_merged_checkpoint
+        from ..models.hf_export import (
+            export_lora_adapter,
+            export_merged_checkpoint,
+            export_mm_projector,
+        )
 
+        if self._is_multimodal:
+            export_lora_adapter(
+                self.model_cfg.text, host["trainable"]["lora"],
+                f"{artifacts_dir}/adapter",
+                hf_prefix="base_model.model.language_model.model.layers",
+            )
+            export_mm_projector(
+                host["trainable"]["projector"], f"{artifacts_dir}/adapter"
+            )
+            if self.cfg.export_merged:
+                logger.warning(
+                    "export_merged skipped: multimodal runs export the "
+                    "adapter + projector (no single-file HF merge exists)"
+                )
+            return
         export_lora_adapter(
             self.model_cfg, host["trainable"], f"{artifacts_dir}/adapter"
         )

@@ -345,3 +345,78 @@ def test_qlora_moe_experts_quantize_on_load(tmp_path):
              "loss_mask": np.ones((2, 16), np.float32)}
     _, metrics = trainer.step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_llava_import_matches_transformers(tmp_path):
+    """Round-5 (VERDICT #3): a real LLaVA checkpoint — CLIP vision tower
+    (class token, pre-norm, quick-gelu, penultimate-layer features),
+    projector, and Llama language model — imports with exact logits parity
+    against transformers' LlavaForConditionalGeneration."""
+    torch = pytest.importorskip("torch")
+    from transformers import (
+        CLIPVisionConfig,
+        LlamaConfig as HFLlamaConfig,
+        LlavaConfig as HFLlavaConfig,
+        LlavaForConditionalGeneration,
+    )
+
+    from finetune_controller_tpu.models.hf_import import load_llava_params
+    from finetune_controller_tpu.models.llama import LlamaConfig
+    from finetune_controller_tpu.models.multimodal import (
+        LlavaConfig,
+        LlavaForCausalLM,
+        ViTConfig,
+    )
+
+    torch.manual_seed(0)
+    vcfg = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=3,
+        num_attention_heads=2, image_size=16, patch_size=8,
+        hidden_act="quick_gelu",
+    )
+    tcfg = HFLlamaConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )
+    hf_cfg = HFLlavaConfig(
+        vision_config=vcfg, text_config=tcfg, image_token_index=255,
+        projector_hidden_act="gelu", vision_feature_layer=-2,
+        vision_feature_select_strategy="default",
+    )
+    hf_model = LlavaForConditionalGeneration(hf_cfg).eval()
+    ckpt = tmp_path / "llava-tiny"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+
+    n_patches = (16 // 8) ** 2
+    text = [5, 6, 7, 8, 9, 10]
+    input_ids = torch.tensor([[255] * n_patches + text])
+    pixels = torch.tensor(
+        np.random.default_rng(0).normal(0, 1, (1, 3, 16, 16)).astype(np.float32)
+    )
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=input_ids, pixel_values=pixels,
+            attention_mask=torch.ones_like(input_ids),
+        ).logits[:, n_patches:].float().numpy()
+
+    cfg = LlavaConfig(
+        vision=ViTConfig(
+            image_size=16, patch_size=8, d_model=32, n_layers=3, n_heads=2,
+            d_ff=64, cls_token=True, pre_norm=True, patch_bias=False,
+            act="quick_gelu", feature_layer=-2, dtype=jnp.float32,
+        ),
+        text=LlamaConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, rms_eps=1e-6, dtype=jnp.float32,
+        ),
+        projector_hidden=64,
+    )
+    params = load_llava_params(ckpt, cfg)
+    ours = LlavaForCausalLM(cfg)
+    out = ours.apply(
+        {"params": params},
+        jnp.asarray([text], jnp.int32),
+        jnp.asarray(np.transpose(pixels.numpy(), (0, 2, 3, 1))),  # NCHW→NHWC
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5, rtol=1e-4)

@@ -6,7 +6,10 @@ greenfield multimodal compute path: forward shape, the vision→text wiring prob
 projector-trains-with-LoRA split, and the e2e control-plane lifecycle.
 """
 
+import json
+
 import numpy as np
+import pytest
 
 import jax
 
@@ -97,3 +100,143 @@ def test_multimodal_e2e_lifecycle(tmp_path):
         await client.close()
 
     run_async(main())
+
+
+def test_llava_job_trains_from_imported_tower_and_exports(tmp_path):
+    """Round-5 (VERDICT #3): the LLaVA path end to end on REAL pixels — a
+    tiny HF LLaVA checkpoint imports as the pretrained base (CLIP tower +
+    projector + decoder), a jsonl dataset of actual PNG files trains through
+    the CLI, and the run exports the PEFT adapter (keyed under
+    language_model — HF LLaVA's layout) plus the trained projector."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("PIL")
+    from transformers import (
+        CLIPVisionConfig,
+        LlamaConfig as HFLlamaConfig,
+        LlavaConfig as HFLlavaConfig,
+        LlavaForConditionalGeneration,
+    )
+
+    torch.manual_seed(0)
+    hf_cfg = HFLlavaConfig(
+        vision_config=CLIPVisionConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=3,
+            num_attention_heads=2, image_size=16, patch_size=8,
+            hidden_act="quick_gelu",
+        ),
+        text_config=HFLlamaConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=128, max_position_embeddings=128,
+            tie_word_embeddings=False,
+        ),
+        image_token_index=255, projector_hidden_act="gelu",
+        vision_feature_layer=-2, vision_feature_select_strategy="default",
+    )
+    ckpt = tmp_path / "llava-base"
+    LlavaForConditionalGeneration(hf_cfg).save_pretrained(
+        str(ckpt), safe_serialization=True
+    )
+
+    # real pixels: 6 distinct PNGs + prompt/completion rows referencing them
+    from PIL import Image
+
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir()
+    rows = []
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        arr = (rng.uniform(0, 255, (20, 24, 3))).astype(np.uint8)
+        Image.fromarray(arr).save(img_dir / f"im{i}.png")
+        rows.append(json.dumps({
+            "image": str(img_dir / f"im{i}.png"),
+            "prompt": f"describe {i}: ",
+            "completion": f"a picture {i}",
+        }))
+    data = tmp_path / "mm.jsonl"
+    data.write_text("\n".join(rows) + "\n")
+
+    from finetune_controller_tpu.train import cli
+
+    spec = {
+        "job_id": "mm-e2e",
+        "model": {"preset": "tiny-mm-clip-test", "lora": {"rank": 2},
+                  "weights_dir": str(ckpt)},
+        "training": {"mode": "lora", "total_steps": 3, "batch_size": 2,
+                     "seq_len": 32, "log_every": 1, "checkpoint_every": 100,
+                     "learning_rate": 1e-3},
+        "mesh": {"dp": 1, "fsdp": 1},
+        "dataset": {"path": str(data)},
+        "artifacts_dir": str(tmp_path / "artifacts"),
+    }
+    cli.run_job(spec)
+
+    art = tmp_path / "artifacts"
+    assert (art / "done.txt").exists()
+    rows = (art / "metrics.csv").read_text().strip().splitlines()
+    assert len(rows) >= 4  # header + 3 steps
+    from safetensors.numpy import load_file
+
+    adapter = load_file(str(art / "adapter" / "adapter_model.safetensors"))
+    assert all(
+        k.startswith("base_model.model.language_model.model.layers.")
+        for k in adapter
+    )
+    proj = load_file(str(art / "adapter" / "projector.safetensors"))
+    assert proj["multi_modal_projector.linear_1.weight"].shape == (64, 32)
+    assert proj["multi_modal_projector.linear_2.weight"].shape == (64, 64)
+
+
+def test_mm_loader_decodes_paths_npy_and_base64(tmp_path):
+    """The multimodal loader's row schemas and image reference forms."""
+    import base64 as b64
+
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    from finetune_controller_tpu.data.mm_loader import mm_jsonl_batches
+
+    img = (np.random.default_rng(1).uniform(0, 255, (10, 10, 3))).astype(np.uint8)
+    Image.fromarray(img).save(tmp_path / "a.png")
+    np.save(tmp_path / "b.npy", img.astype(np.float32) / 255.0)
+    import io as _io
+
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    data_uri = "data:image/png;base64," + b64.b64encode(buf.getvalue()).decode()
+
+    rows = [
+        {"image": "a.png", "prompt": "p: ", "completion": "done"},  # relative
+        {"image": str(tmp_path / "b.npy"), "text": "plain lm row"},
+        {"image": data_uri,
+         "messages": [{"role": "user", "content": "hi"},
+                      {"role": "assistant", "content": "yo"}]},
+    ]
+    path = tmp_path / "mm.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+
+    it = mm_jsonl_batches(str(path), batch_size=3, seq_len=48, image_size=8)
+    batch = next(it)
+    assert batch["tokens"].shape == (3, 48)
+    assert batch["pixels"].shape == (3, 8, 8, 3)
+    assert batch["loss_mask"].shape == (3, 48)
+    # SFT rows mask the prompt; plain text rows count everything unpadded
+    assert batch["loss_mask"].sum() > 0
+    # CLIP normalization: values are centered (not raw [0,1])
+    assert batch["pixels"].min() < -0.5
+
+    # a row without an image must fail loudly
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"text": "no image"}) + "\n")
+    with pytest.raises(ValueError, match="image"):
+        next(mm_jsonl_batches(str(bad), batch_size=1, seq_len=8, image_size=8))
+
+    # a row whose every loss position falls past seq_len would train on
+    # NOTHING — the loader must refuse, not silently zero the gradient
+    longp = tmp_path / "long.jsonl"
+    longp.write_text(json.dumps({
+        "image": str(tmp_path / "a.png"),
+        "prompt": "x" * 32, "completion": "y",
+    }) + "\n")
+    with pytest.raises(ValueError, match="past seq_len"):
+        next(mm_jsonl_batches(str(longp), batch_size=1, seq_len=16, image_size=8))
