@@ -12,13 +12,34 @@ the backward pipeline (activation hops reverse through the ppermute
 transpose) for free — no hand-written backward schedule.
 
 Schedule: plain GPipe with ``M`` microbatches over ``P`` stages,
-``T = M + P − 1`` ticks and the classic ``(P−1)/T`` bubble. Idle ticks still
-execute the stage body (SPMD — every device runs the same program) with their
-output masked out, which costs the same wall-clock the bubble would anyway.
+``T = M + P − 1`` ticks and the classic ``(P−1)/T`` bubble
+(:func:`bubble_fraction` — the trainer logs it for every pp run). Idle ticks
+still execute the stage body (SPMD — every device runs the same program) with
+their output masked out, which costs the same wall-clock the bubble would
+anyway.
+
+**Why GPipe and not 1F1B (a considered decision, round 5):** 1F1B's benefit
+over GPipe is peak-activation memory — it holds at most ``P`` microbatches'
+activations where GPipe holds ``M``. It does NOT shrink the bubble (same
+``(P−1)/(M+P−1)``). The cost would be structural: this implementation gets
+its backward pipeline *derived by autodiff* from a single differentiable
+``lax.scan`` — reverse-mode replays the ticks backward and transposes the
+``ppermute`` hops automatically. 1F1B interleaves forward and backward ticks
+in one schedule, which autodiff cannot derive; it needs a hand-written
+backward schedule with manual activation stashing (and custom_vjp through
+the collectives). On TPU the memory lever 1F1B buys is already covered
+cheaper: per-layer remat (``remat_policy``) bounds stashed activations to
+the remat boundaries, and ``M`` is a free dial (the trainer's default
+``M = 2P`` keeps the bubble ≤ ``(P−1)/(3P−1)`` ≈ 33% worst-case, 20% at
+``P=2``). If a future profile shows activation residency — not bubble — as
+the pp bottleneck at a scale remat can't hold, that is the signal to revisit.
 
 Composition: ``pp × dp`` (the classic GPipe layout). Weights within a stage
 are replicated across ``dp``; combining pp with fsdp/tp/sp is rejected at
-mesh-resolution time rather than silently mis-sharded.
+mesh-resolution time rather than silently mis-sharded. (pp × fsdp would need
+manual per-stage weight all-gathers inside the shard_map body — XLA's
+automatic FSDP gathering doesn't reach in there; rejected rather than
+half-supported.)
 """
 
 from __future__ import annotations
@@ -35,6 +56,22 @@ from .mesh import AxisNames as Ax
 
 # stage body: (stage_params, x_mb, positions_mb, segids_mb) -> y_mb
 StageFn = Callable[[Any, jax.Array, jax.Array, jax.Array | None], jax.Array]
+
+
+def bubble_fraction(n_micro: int, pp: int) -> float:
+    """GPipe idle fraction: ``(P−1) / (M + P − 1)`` — the share of the
+    ``M + P − 1`` ticks each stage spends masked out."""
+    return (pp - 1) / (n_micro + pp - 1)
+
+
+def default_pp_microbatches(local_batch: int, pp: int) -> int:
+    """The trainer's default schedule: the largest microbatch count ≤ 2·pp
+    that divides the per-data-shard batch (2·pp halves the GPipe bubble).
+    One definition — the trainer and the AOT report both call this, so the
+    reported schedule cannot drift from what actually runs."""
+    return max(
+        (m for m in range(1, 2 * pp + 1) if local_batch % m == 0), default=1
+    )
 
 
 def validate_pp_mesh(mesh: Mesh) -> None:

@@ -56,6 +56,14 @@ REALSCALE: dict[str, dict[str, Any]] = {
         preset="llama3-8b", mesh=dict(dp=2, fsdp=16), n_devices=32,
         batch=32, seq=2048, chip="v5e", num_slices=2,
     ),
+    # real-shape PIPELINE leg (round 5): the 8B model's layer stack split
+    # into 2 GPipe stages × dp4 on v5p (pp composes with dp; the frozen base
+    # is replicated over dp, so the roomier chip hosts this layout). The
+    # report carries the schedule's analytic bubble fraction.
+    "llama3-8b-dp4-pp2": dict(
+        preset="llama3-8b", mesh=dict(dp=4, pp=2), n_devices=8,
+        batch=32, seq=2048, chip="v5p",
+    ),
 }
 
 _COLLECTIVE_RE = re.compile(
@@ -188,7 +196,7 @@ def aot_report(name: str) -> dict[str, Any]:
         for p, l in jax.tree_util.tree_leaves_with_path(state_shapes)
     }
     fsdp_sharded = unsharded_big = 0
-    ep_sharded = 0
+    ep_sharded = pp_sharded = 0
     for path, sharding in leaves:
         key = jax.tree_util.keystr(path)
         shp = shape_leaves[key]
@@ -201,6 +209,10 @@ def aot_report(name: str) -> dict[str, Any]:
         ]
         if "fsdp" in flat_axes:
             fsdp_sharded += 1
+        elif "pp" in flat_axes:
+            # stage-sharded on the leading layer axis — sharded, just not
+            # by fsdp; must not be reported as an unsharded giant
+            pp_sharded += 1
         elif math.prod(shp.shape or (1,)) * shp.dtype.itemsize > 4 << 20:
             unsharded_big += 1
             spec_samples.setdefault(f"UNSHARDED {key}", str(pspec))
@@ -221,6 +233,21 @@ def aot_report(name: str) -> dict[str, Any]:
     except Exception:
         pass
 
+    pp = mesh_shape.get("pp", 1)
+    pp_schedule = None
+    if pp > 1:
+        from ..parallel.pipeline import (
+            bubble_fraction,
+            default_pp_microbatches,
+        )
+
+        local = b // (mesh_shape.get("dp", 1) * mesh_shape.get("fsdp", 1))
+        n_micro = default_pp_microbatches(local, pp)
+        pp_schedule = {
+            "n_micro": n_micro,
+            "bubble_fraction": round(bubble_fraction(n_micro, pp), 4),
+        }
+
     hbm = _HBM_GIB[spec["chip"]] * (1 << 30)
     return {
         "name": name,
@@ -231,7 +258,9 @@ def aot_report(name: str) -> dict[str, Any]:
         "collectives": collectives,
         "num_slices": num_slices,
         "dcn_split": dcn_split,
+        "pp_schedule": pp_schedule,
         "fsdp_sharded_leaves": fsdp_sharded,
+        "pp_sharded_leaves": pp_sharded,
         "ep_sharded_leaves": ep_sharded,
         "unsharded_big_leaves": unsharded_big,
         "state_bytes_per_device": int(state_bytes),

@@ -41,3 +41,22 @@ def test_mixtral_ep8_fsdp8_real_shapes():
     assert "all-to-all" in rep["collectives"]
     assert "all-gather" in rep["collectives"]
     assert rep["state_fits_hbm"]
+
+
+@pytest.mark.slow
+def test_llama3_8b_pp2_real_shapes():
+    """Round-5 (VERDICT #7): the PIPELINE leg at real 8B shapes — the layer
+    stack splits into 2 GPipe stages (leading-axis pp sharding), composes
+    with dp4, compiles with stage-hop collectives, and reports the analytic
+    bubble for its schedule."""
+    rep = _report("llama3-8b-dp4-pp2")
+    assert rep["param_count"] > 8e9
+    assert rep["mesh"]["pp"] == 2 and rep["mesh"]["dp"] == 4
+    # the stacked block weights are stage-sharded on the layer axis
+    assert rep["pp_sharded_leaves"] >= 20
+    assert rep["unsharded_big_leaves"] <= 3  # embed/head/norm replicate by design
+    # activation hops between stages ride collective-permute
+    assert "collective-permute" in rep["collectives"]
+    # trainer default schedule: local batch 8 over pp2 -> 4 microbatches
+    assert rep["pp_schedule"] == {"n_micro": 4, "bubble_fraction": 0.2}
+    assert rep["state_fits_hbm"]
