@@ -294,6 +294,32 @@ def step_moe(log_path: Path) -> None:
         log_result(log_path, {"step": step, **rec})
 
 
+def step_fidelity(log_path: Path) -> None:
+    """Round-5 fidelity proof on the chip (VERDICT #1/#9): the full
+    pretrain→export→controller-LoRA→before/after-generation pipeline via
+    scripts/fidelity_proof.py, which appends its own `fidelity` record to
+    the session log when it sees a TPU platform."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "fidelity_proof.py")],
+            capture_output=True, text=True, timeout=3600,
+        )
+    except subprocess.TimeoutExpired:
+        # a crash-resilient session must RECORD the timeout, not die on it
+        log_result(log_path, {
+            "step": "fidelity", "error": "timeout after 3600s",
+        })
+        return
+    if out.returncode != 0:
+        log_result(log_path, {
+            "step": "fidelity", "error": out.stderr[-800:],
+        })
+    else:
+        print(out.stdout[-400:], flush=True)
+
+
 def winner_from_log(log_path: Path) -> dict[str, str]:
     """Latest kernel_ab verdict recorded in the session log, as env vars."""
     best: dict[str, str] = {}
@@ -319,13 +345,13 @@ def main() -> int:
     ap.add_argument("--log", default=str(REPO / "tpu_session.jsonl"))
     ap.add_argument("--only", default="",
                     help="parity|headline|kernel_ab|headline_tuned|longctx|"
-                         "families|moe|gen7b")
+                         "families|moe|gen7b|fidelity")
     args = ap.parse_args()
     log_path = Path(args.log)
 
     steps = args.only.split(",") if args.only else [
         "parity", "headline", "kernel_ab", "headline_tuned", "longctx",
-        "families", "moe", "gen7b"
+        "families", "moe", "gen7b", "fidelity"
     ]
     for step in steps:
         print(f"=== step: {step} ===", flush=True)
@@ -350,6 +376,8 @@ def main() -> int:
             step_moe(log_path)
         elif step == "gen7b":
             step_gen7b(log_path)
+        elif step == "fidelity":
+            step_fidelity(log_path)
         else:
             print(f"unknown step {step!r}", file=sys.stderr)
             return 2
