@@ -29,13 +29,20 @@ import jax
 import jax.numpy as jnp
 
 
-def _logits_fn(model: Any, variables: dict, tokens: jax.Array) -> jax.Array:
-    """Last-position logits (B, V); MoE models sow aux state we discard."""
+def _logits_fn(
+    model: Any, variables: dict, tokens: jax.Array,
+    pixels: jax.Array | None = None,
+) -> jax.Array:
+    """Last-position logits (B, V); MoE models sow aux state we discard;
+    multimodal models take the image prefix via ``pixels``."""
+    kw: dict = {}
+    if pixels is not None:
+        kw["pixels"] = pixels
     n_experts = getattr(getattr(model, "cfg", None), "n_experts", 0)
     if n_experts:
-        logits, _ = model.apply(variables, tokens, mutable=("moe_aux",))
+        logits, _ = model.apply(variables, tokens, mutable=("moe_aux",), **kw)
     else:
-        logits = model.apply(variables, tokens)
+        logits = model.apply(variables, tokens, **kw)
     return logits[:, -1].astype(jnp.float32)
 
 
@@ -49,11 +56,14 @@ def generate(
     top_k: int = 0,                # 0 = full distribution
     eos_id: int | None = None,
     rng: jax.Array | None = None,
+    pixels: jax.Array | None = None,  # (B, H, W, 3) for multimodal models
 ) -> jax.Array:
     """Autoregressive sampling; returns (B, S + max_new_tokens) tokens.
 
     Rows that emit ``eos_id`` keep emitting it (a poor man's stop mask), so
-    callers can trim on the first EOS per row.
+    callers can trim on the first EOS per row. ``pixels`` feeds a multimodal
+    model's image prefix (re-encoded every step — this is the oracle path;
+    fine for sanity checks, not serving).
     """
     tokens = jnp.asarray(prompt_tokens, jnp.int32)
     if tokens.ndim != 2:
@@ -63,7 +73,7 @@ def generate(
     done = jnp.zeros((tokens.shape[0],), bool)
 
     for _ in range(max_new_tokens):
-        logits = _logits_fn(model, variables, tokens)        # (B, V)
+        logits = _logits_fn(model, variables, tokens, pixels)  # (B, V)
         nxt, rng = _sample(logits, temperature=temperature, top_k=top_k, rng=rng)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
@@ -158,6 +168,11 @@ def cached_generate(
     (cached is the *less* lossy of the two).  ``tests/test_generate.py``
     verifies equivalence under a dropless capacity.
     """
+    if getattr(model.cfg, "vision", None) is not None:
+        raise NotImplementedError(
+            "cached decode does not cover multimodal models yet — use "
+            "generate(..., pixels=...) (the oracle path)"
+        )
     tokens = jnp.asarray(prompt_tokens, jnp.int32)
     if tokens.ndim != 2:
         raise ValueError(f"prompt_tokens must be (B, S), got {tokens.shape}")

@@ -56,6 +56,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--oracle", action="store_true",
                    help="uncached O(n^2) forward per token — the numerics "
                         "oracle; impractically slow past ~1B params")
+    p.add_argument("--image",
+                   help="image for multimodal jobs (path / data URI / "
+                        "base64) — required for LLaVA-family artifacts")
     args = p.parse_args(argv)
 
     if (args.prompt is None) == (args.prompt_tokens is None):
@@ -70,11 +73,14 @@ def main(argv: list[str] | None = None) -> int:
     from ..train.cli import build_model_config, build_train_config
 
     cfg = build_model_config(spec)
-    if getattr(cfg, "vision", None) is not None:
+    multimodal = getattr(cfg, "vision", None) is not None
+    if multimodal and not args.image:
         raise SystemExit(
-            "multimodal presets need an image input; generation covers the "
-            "text families (Llama/Gemma/Qwen/Mixtral)"
+            "this is a multimodal job's artifacts dir — pass --image "
+            "(path / data URI / base64) for the image prefix"
         )
+    if args.image and not multimodal:
+        raise SystemExit("--image given but the job's model is text-only")
 
     # ---- tokenize ---------------------------------------------------------
     # tokenizer resolution: an explicit --tokenizer always loads (and, in
@@ -163,23 +169,38 @@ def main(argv: list[str] | None = None) -> int:
 
     from .generate import cached_generate, generate
 
-    if len(ids) + args.max_new_tokens > cfg.max_seq_len:
+    prefix = cfg.vision.n_patches if multimodal else 0
+    if prefix + len(ids) + args.max_new_tokens > cfg.max_seq_len:
         print(
-            f"warning: prompt ({len(ids)}) + max_new_tokens "
-            f"({args.max_new_tokens}) exceeds the model's trained "
-            f"max_seq_len ({cfg.max_seq_len}) — RoPE positions past the "
-            "trained range degrade quality",
+            f"warning: image prefix ({prefix}) + prompt ({len(ids)}) + "
+            f"max_new_tokens ({args.max_new_tokens}) exceeds the model's "
+            f"trained max_seq_len ({cfg.max_seq_len}) — RoPE positions past "
+            "the trained range degrade quality",
             file=sys.stderr,
         )
 
     variables = trainer._assemble(state.frozen, state.trainable)
     prompt = jnp.asarray([ids], jnp.int32)
-    gen_fn = generate if args.oracle else cached_generate
+    gen_kw: dict = {}
+    if multimodal:
+        # oracle path only: the KV-cached decode doesn't cover the image
+        # prefix yet, and a sanity generation re-encoding one image per
+        # token is acceptable at the scales this CLI targets
+        from ..data.images import preprocess_image
+
+        gen_kw["pixels"] = jnp.asarray(preprocess_image(
+            args.image, cfg.image_size,
+            normalize=spec.get("dataset", {}).get("image_normalize", "clip"),
+        ))[None]
+        gen_fn = generate
+    else:
+        gen_fn = generate if args.oracle else cached_generate
     out = gen_fn(
         trainer.model, variables, prompt,
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature, top_k=args.top_k, eos_id=args.eos_id,
         rng=jax.random.PRNGKey(args.seed),
+        **gen_kw,
     )
     new_ids = np.asarray(out)[0, len(ids):].tolist()
     if args.eos_id is not None and args.eos_id in new_ids:

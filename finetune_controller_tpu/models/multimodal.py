@@ -99,6 +99,12 @@ class LlavaConfig:
     def image_size(self) -> int:
         return self.vision.image_size
 
+    @property
+    def max_seq_len(self) -> int:
+        """Decoder position budget — the image prefix (``n_patches``) and the
+        text share it."""
+        return self.text.max_seq_len
+
     def replace(self, **kw) -> "LlavaConfig":
         # route llama-level overrides (lora=...) into the text config
         text_keys = {f.name for f in dataclasses.fields(LlamaConfig)}

@@ -33,6 +33,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--sft-steps", type=int, default=200)
     p.add_argument("--corpus-bytes", type=int, default=400_000)
     p.add_argument("--max-new-tokens", type=int, default=48)
+    p.add_argument("--session-log", default=str(REPO / "tpu_session.jsonl"),
+                   help="where the TPU-run record is appended "
+                        "(scripts/tpu_session.py passes its --log here)")
     args = p.parse_args(argv)
 
     import jax
@@ -70,7 +73,7 @@ def main(argv: list[str] | None = None) -> int:
                 )
             },
         }
-        with open(REPO / "tpu_session.jsonl", "a") as f:
+        with open(args.session_log, "a") as f:
             f.write(json.dumps(session_rec) + "\n")
 
     return 0 if record["passed"] else 1

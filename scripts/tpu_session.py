@@ -298,12 +298,12 @@ def step_fidelity(log_path: Path) -> None:
     """Round-5 fidelity proof on the chip (VERDICT #1/#9): the full
     pretrain→export→controller-LoRA→before/after-generation pipeline via
     scripts/fidelity_proof.py, which appends its own `fidelity` record to
-    the session log when it sees a TPU platform."""
-    import subprocess
-
+    THIS session log when it sees a TPU platform (--session-log plumbs the
+    path so a --log override keeps success and failure records together)."""
     try:
         out = subprocess.run(
-            [sys.executable, str(REPO / "scripts" / "fidelity_proof.py")],
+            [sys.executable, str(REPO / "scripts" / "fidelity_proof.py"),
+             "--session-log", str(log_path)],
             capture_output=True, text=True, timeout=3600,
         )
     except subprocess.TimeoutExpired:

@@ -186,6 +186,30 @@ def test_llava_job_trains_from_imported_tower_and_exports(tmp_path):
     assert proj["multi_modal_projector.linear_1.weight"].shape == (64, 32)
     assert proj["multi_modal_projector.linear_2.weight"].shape == (64, 64)
 
+    # post-finetune sanity generation WITH an image, from the job's own
+    # artifacts (the operator surface; oracle path for multimodal)
+    import contextlib
+    import io
+
+    from finetune_controller_tpu.models.generate_cli import main as gen_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = gen_main([
+            "--artifacts", str(art),
+            "--prompt", "describe 0: ",
+            "--image", str(img_dir / "im0.png"),
+            "--max-new-tokens", "4",
+        ])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert len(out["new_tokens"]) == 4
+
+    # a text-only prompt against multimodal artifacts must refuse clearly
+    with pytest.raises(SystemExit, match="--image"):
+        gen_main(["--artifacts", str(art), "--prompt", "x",
+                  "--max-new-tokens", "2"])
+
 
 def test_mm_loader_decodes_paths_npy_and_base64(tmp_path):
     """The multimodal loader's row schemas and image reference forms."""
