@@ -105,10 +105,14 @@ def _decode_fns(model_type, dcfg):
     dmodel = model_type(cfg=dcfg)
     mutable = ("cache", "moe_aux") if dcfg.n_experts else ("cache",)
 
+    # one pair serves both families: fill takes pixels variadically (the
+    # multimodal [image; text] prefix — cached_generate passes it only for
+    # LLaVA models), and both model classes accept positions by keyword
+    # (decode steps use ABSOLUTE positions; the mm wrapper offsets nothing)
     @jax.jit
-    def fill(variables, tokens):
+    def fill(variables, tokens, *pixels):
         logits, updated = dmodel.apply(
-            variables, tokens, deterministic=True, decode=True,
+            variables, tokens, *pixels, deterministic=True, decode=True,
             mutable=mutable,
         )
         return logits[:, -1].astype(jnp.float32), updated["cache"]
@@ -117,8 +121,8 @@ def _decode_fns(model_type, dcfg):
     def decode_step(variables, token, pos):
         positions = jnp.broadcast_to(pos[None, None], (token.shape[0], 1))
         logits, updated = dmodel.apply(
-            variables, token, positions, deterministic=True, decode=True,
-            mutable=mutable,
+            variables, token, positions=positions, deterministic=True,
+            decode=True, mutable=mutable,
         )
         return logits[:, -1].astype(jnp.float32), updated["cache"]
 
@@ -151,10 +155,12 @@ def cached_generate(
     top_k: int = 0,
     eos_id: int | None = None,
     rng: jax.Array | None = None,
+    pixels: jax.Array | None = None,  # (B, H, W, 3) for multimodal models
 ) -> jax.Array:
     """KV-cached fill-then-decode sampling; same contract as :func:`generate`.
 
     The cache is a static ``prompt_len + max_new_tokens`` slots per layer
+    (plus the ``n_patches`` image-prefix slots for multimodal models)
     (flax ``cache`` collection — ``models/llama.py`` ``_decode_attention``),
     so each new token costs one single-position forward instead of a full
     re-run: at 7B this is the difference between a usable post-finetune
@@ -168,23 +174,30 @@ def cached_generate(
     (cached is the *less* lossy of the two).  ``tests/test_generate.py``
     verifies equivalence under a dropless capacity.
     """
-    if getattr(model.cfg, "vision", None) is not None:
-        raise NotImplementedError(
-            "cached decode does not cover multimodal models yet — use "
-            "generate(..., pixels=...) (the oracle path)"
-        )
+    multimodal = getattr(model.cfg, "vision", None) is not None
+    if multimodal and pixels is None:
+        raise ValueError("multimodal cached decode needs pixels=")
+    if pixels is not None and not multimodal:
+        # fail fast like generate() does — a silently dropped image would
+        # return plausible text that never saw it
+        raise ValueError("pixels= given but the model is text-only")
     tokens = jnp.asarray(prompt_tokens, jnp.int32)
     if tokens.ndim != 2:
         raise ValueError(f"prompt_tokens must be (B, S), got {tokens.shape}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, prompt_len = tokens.shape
-    cache_len = prompt_len + max_new_tokens
+    # the image prefix occupies cache slots before the text (multimodal)
+    prefix = model.cfg.vision.n_patches if multimodal else 0
+    cache_len = prefix + prompt_len + max_new_tokens
     dcfg = model.cfg.replace(
         remat=False, attention_impl="xla", max_seq_len=cache_len
     )
     fill, decode_step = _decode_fns(type(model), dcfg)
-    logits, cache = fill(variables, tokens)
+    if multimodal:
+        logits, cache = fill(variables, tokens, jnp.asarray(pixels))
+    else:
+        logits, cache = fill(variables, tokens)
     done = jnp.zeros((b,), bool)
     for t in range(max_new_tokens):
         nxt, rng = _sample(logits, temperature=temperature, top_k=top_k, rng=rng)
@@ -198,6 +211,6 @@ def cached_generate(
         logits, cache = decode_step(
             {**variables, "cache": cache},
             nxt[:, None].astype(jnp.int32),
-            jnp.asarray(prompt_len + t, jnp.int32),
+            jnp.asarray(prefix + prompt_len + t, jnp.int32),
         )
     return tokens

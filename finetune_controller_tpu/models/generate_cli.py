@@ -183,18 +183,13 @@ def main(argv: list[str] | None = None) -> int:
     prompt = jnp.asarray([ids], jnp.int32)
     gen_kw: dict = {}
     if multimodal:
-        # oracle path only: the KV-cached decode doesn't cover the image
-        # prefix yet, and a sanity generation re-encoding one image per
-        # token is acceptable at the scales this CLI targets
         from ..data.images import preprocess_image
 
         gen_kw["pixels"] = jnp.asarray(preprocess_image(
             args.image, cfg.image_size,
             normalize=spec.get("dataset", {}).get("image_normalize", "clip"),
         ))[None]
-        gen_fn = generate
-    else:
-        gen_fn = generate if args.oracle else cached_generate
+    gen_fn = generate if args.oracle else cached_generate
     out = gen_fn(
         trainer.model, variables, prompt,
         max_new_tokens=args.max_new_tokens,

@@ -219,7 +219,13 @@ class ViTEncoder(nn.Module):
 
 
 class LlavaForCausalLM(nn.Module):
-    """Image-prefix causal LM. Call with (tokens, pixels)."""
+    """Image-prefix causal LM. Call with (tokens, pixels).
+
+    KV-cached decode (round 5): ``decode=True`` with pixels fills the cache
+    over the combined ``[image; text]`` sequence; subsequent single-token
+    calls pass ``pixels=None`` and ABSOLUTE ``positions`` (offset by
+    ``n_patches`` — the caller owns the position arithmetic, as in
+    ``models/generate.py::cached_generate``)."""
 
     cfg: LlavaConfig
 
@@ -230,6 +236,8 @@ class LlavaForCausalLM(nn.Module):
         pixels: jax.Array | None = None,  # (B, H, W, 3)
         segment_ids: jax.Array | None = None,
         deterministic: bool = True,
+        decode: bool = False,
+        positions: jax.Array | None = None,
     ) -> jax.Array:
         cfg = self.cfg
         tcfg = cfg.text
@@ -258,7 +266,8 @@ class LlavaForCausalLM(nn.Module):
             x = text_emb
 
         total = n_img + s
-        positions = jnp.broadcast_to(jnp.arange(total), (b, total))
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(total), (b, total))
         if segment_ids is not None and n_img:
             # image prefix joins the first text segment so text can attend to it
             first = segment_ids[:, :1]
@@ -274,26 +283,27 @@ class LlavaForCausalLM(nn.Module):
             block_cls = _ScanBlock
             if tcfg.remat and policy is not None:
                 block_cls = nn.remat(
-                    _ScanBlock, prevent_cse=False, static_argnums=(4,),
+                    _ScanBlock, prevent_cse=False, static_argnums=(4, 5),
                     policy=policy,
                 )
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "lora": 0, "moe_aux": 0},
+                variable_axes={"params": 0, "lora": 0, "moe_aux": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
                 length=tcfg.n_layers,
             )(tcfg, name="blocks")
-            x, _ = stack(x, positions, segment_ids, deterministic)
+            x, _ = stack(x, positions, segment_ids, deterministic, decode)
         else:
             block_cls = (
-                nn.remat(Block, prevent_cse=False, static_argnums=(4,), policy=policy)
+                nn.remat(Block, prevent_cse=False, static_argnums=(4, 5),
+                         policy=policy)
                 if tcfg.remat and policy is not None
                 else Block
             )
             for i in range(tcfg.n_layers):
                 x = block_cls(tcfg, name=f"layer_{i}")(
-                    x, positions, segment_ids, deterministic
+                    x, positions, segment_ids, deterministic, decode
                 )
 
         x = RMSNorm(tcfg.rms_eps, tcfg.dtype, tcfg.param_dtype, tcfg.norm_offset, name="final_norm")(x)

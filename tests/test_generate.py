@@ -123,3 +123,36 @@ def test_cached_generate_eos_and_sampling_shapes():
         if seen:
             assert t == 19
         seen = seen or t == 19
+
+
+def test_multimodal_cached_generate_matches_oracle():
+    """Round-5: the KV-cached decode covers LLaVA — fill caches the
+    [image; text] prefix, decode steps run at absolute positions; greedy
+    tokens must match the per-step full-recompute oracle."""
+    from finetune_controller_tpu.models.multimodal import (
+        MM_PRESETS,
+        LlavaForCausalLM,
+    )
+
+    cfg = MM_PRESETS["tiny-mm-clip-test"].replace(
+        dtype=jnp.float32, lora=LoRAConfig(rank=0)
+    )
+    model = LlavaForCausalLM(cfg)
+    rng = jax.random.PRNGKey(11)
+    size = cfg.vision.image_size
+    pixels = jax.random.uniform(rng, (1, size, size, 3), jnp.float32)
+    variables = model.init(
+        {"params": rng}, jnp.zeros((1, 6), jnp.int32), pixels
+    )
+    prompt = jnp.asarray([[7, 12, 99, 4, 5, 6]], jnp.int32)
+
+    oracle = generate(
+        model, variables, prompt, max_new_tokens=8, pixels=pixels
+    )
+    cached = cached_generate(
+        model, variables, prompt, max_new_tokens=8, pixels=pixels
+    )
+    np.testing.assert_array_equal(np.asarray(cached), np.asarray(oracle))
+
+    with pytest.raises(ValueError, match="pixels"):
+        cached_generate(model, variables, prompt, max_new_tokens=2)
