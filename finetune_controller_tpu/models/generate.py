@@ -65,6 +65,10 @@ def generate(
     model's image prefix (re-encoded every step — this is the oracle path;
     fine for sanity checks, not serving).
     """
+    if getattr(model.cfg, "vision", None) is not None and pixels is None:
+        # a multimodal model quietly falls back to text-only embeddings —
+        # the sanity check would "work" without ever seeing the image
+        raise ValueError("multimodal generation needs pixels=")
     tokens = jnp.asarray(prompt_tokens, jnp.int32)
     if tokens.ndim != 2:
         raise ValueError(f"prompt_tokens must be (B, S), got {tokens.shape}")

@@ -186,3 +186,49 @@ def test_chat_rows_without_assistant_role_fail_loudly(tmp_path):
     ]}) + "\n")
     with pytest.raises(ValueError, match="assistant"):
         load_token_documents(str(path))
+
+
+def test_image_decode_paths(tmp_path):
+    """data/images.py reference forms: npy path, grayscale promotion, bare
+    base64, data URI, and the loud failure for junk refs."""
+    import base64
+
+    import pytest
+
+    from finetune_controller_tpu.data.images import (
+        CLIP_MEAN,
+        CLIP_STD,
+        decode_image,
+        preprocess_image,
+    )
+
+    # float .npy in [0,1] passes through; grayscale (H, W) promotes to 3ch
+    arr = np.random.default_rng(0).uniform(0, 1, (6, 5)).astype(np.float32)
+    np.save(tmp_path / "g.npy", arr)
+    img = decode_image(str(tmp_path / "g.npy"))
+    assert img.shape == (6, 5, 3)
+    np.testing.assert_allclose(img[..., 0], arr, atol=1e-6)
+
+    # uint8 .npy rescales to [0,1]
+    np.save(tmp_path / "u.npy", (arr * 255).astype(np.uint8)[..., None].repeat(3, -1))
+    assert decode_image(str(tmp_path / "u.npy")).max() <= 1.0
+
+    # bare base64 of an npy payload
+    import io
+
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    b64 = base64.b64encode(buf.getvalue()).decode()
+    assert decode_image(b64).shape == (6, 5, 3)
+    assert decode_image("data:application/npy;base64," + b64).shape == (6, 5, 3)
+
+    # normalization: "clip" centers, "none" keeps [0,1]
+    raw = preprocess_image(str(tmp_path / "g.npy"), 4, normalize="none")
+    assert raw.shape == (4, 4, 3) and raw.min() >= 0.0
+    cl = preprocess_image(str(tmp_path / "g.npy"), 4, normalize="clip")
+    np.testing.assert_allclose(cl, (raw - CLIP_MEAN) / CLIP_STD, atol=1e-5)
+
+    with pytest.raises(FileNotFoundError, match="neither"):
+        decode_image("no/such/file.png!!")
+    with pytest.raises(ValueError, match="normalize"):
+        preprocess_image(str(tmp_path / "g.npy"), 4, normalize="bogus")
