@@ -30,6 +30,16 @@ Measurement discipline (round-2 rework):
 Env knobs: BENCH_PRESET, BENCH_STEPS, BENCH_BATCH, BENCH_SEQ, BENCH_TINY=1
 (CI-sized run), BENCH_MODE=qlora (int4 config #3), BENCH_REMAT_POLICY,
 BENCH_ATTN_IMPL, BENCH_FROZEN_DTYPE, BENCH_LOGITS_DTYPE (perf experiments).
+
+Input-pipeline knobs (round 6): BENCH_PREFETCH (background prefetch depth
+for the batch stream, default 2; 0 = synchronous host build on the timing
+thread) and BENCH_PREFETCH_AB (default on in BENCH_MODE=mm: run a prefetch
+off/on A/B over REAL decoded images — a generated on-disk jsonl of PNGs fed
+through data/mm_loader.py with the pixel cache disabled — and attach the
+per-leg step time + input_fraction under "prefetch_ab"). Every bench JSON now
+carries "input_fraction": the share of the timed window the training thread
+spent WAITING on its next batch — the number that catches an input-bound
+config that raw tokens/sec would hide.
 """
 
 from __future__ import annotations
@@ -249,6 +259,97 @@ def _init_backend_with_fallback() -> None:
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
+def _write_mm_bench_dataset(dir_path: str, n_rows: int, src_px: int) -> str:
+    """Write an image-bearing jsonl of REAL encoded images (PNG via PIL when
+    available, ``.npy`` otherwise) so the mm input A/B measures genuine
+    per-batch decode+resize host work, not synthetic in-memory arrays."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    path = os.path.join(dir_path, "mm_bench.jsonl")
+    with open(path, "w") as f:
+        for i in range(n_rows):
+            arr = rng.integers(0, 256, (src_px, src_px, 3)).astype("uint8")
+            try:
+                from PIL import Image
+
+                name = f"img_{i:03d}.png"
+                Image.fromarray(arr).save(os.path.join(dir_path, name))
+            except ImportError:
+                name = f"img_{i:03d}.npy"
+                np.save(os.path.join(dir_path, name), arr)
+            f.write(json.dumps({
+                "image": name,
+                "prompt": f"describe image {i}: ",
+                "completion": "a square of colored noise",
+            }) + "\n")
+    return path
+
+
+def measure_mm_prefetch_ab(
+    trainer, state, dataset_path: str, *,
+    image_size: int, batch: int, seq: int,
+    steps: int = 8, depth: int = 2, warmup: int = 2,
+):
+    """Prefetch off/on A/B over the real multimodal loader (pixel cache
+    disabled, so every batch pays its decode+resize — the steady-state cost
+    of any epoch past the cache cap).
+
+    Steps are individually blocked so each leg's step time is deterministic;
+    the device wait releases the GIL, which is exactly the window the
+    prefetch producer uses to build (and device_put) the next batch.
+    Per-leg step time is the MEDIAN over the timed steps (host-side decode
+    timing on a shared box is long-tailed; a mean would let one scheduler
+    hiccup decide the A/B), while input_fraction keeps the honest totals.
+    Returns ``(state, legs)`` where legs carries per-leg step time,
+    input wait, and input_fraction, plus the off/on speedup.
+    """
+    import jax
+    import numpy as np
+
+    from finetune_controller_tpu.data.mm_loader import mm_jsonl_batches
+    from finetune_controller_tpu.data.prefetch import prefetch_batches
+
+    legs: dict = {}
+    for leg, leg_depth in (("off", 0), ("on", depth)):
+        raw = mm_jsonl_batches(
+            dataset_path, batch_size=batch, seq_len=seq,
+            image_size=image_size, pixel_cache_size=0,
+        )
+        it = prefetch_batches(
+            raw, depth=leg_depth,
+            transfer=trainer._shard_batch if leg_depth else None,
+        )
+        try:
+            for _ in range(warmup):
+                state, _ = trainer.step(state, next(it))
+                state = jax.block_until_ready(state)
+            input_s = 0.0
+            step_times = []
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                ts = time.perf_counter()
+                b = next(it)
+                input_s += time.perf_counter() - ts
+                state, _ = trainer.step(state, b)
+                state = jax.block_until_ready(state)
+                step_times.append(time.perf_counter() - ts)
+            total_s = time.perf_counter() - t0
+        finally:
+            if hasattr(it, "close"):
+                it.close()
+        legs[leg] = {
+            "step_time_avg_s": round(float(np.median(step_times)), 4),
+            "input_ms_avg": round(input_s / steps * 1000, 2),
+            "input_fraction": round(input_s / total_s, 4),
+        }
+    legs["speedup"] = round(
+        legs["off"]["step_time_avg_s"]
+        / max(legs["on"]["step_time_avg_s"], 1e-9), 3,
+    )
+    return state, legs
+
+
 def main() -> None:
     _init_backend_with_fallback()
     import jax
@@ -364,6 +465,14 @@ def main() -> None:
         task="brightness" if mm else "increment",
         image_size=image_size,
     )
+    # background input prefetch (data/prefetch.py) — the trainer-path default;
+    # BENCH_PREFETCH=0 measures the synchronous legacy pipeline
+    from finetune_controller_tpu.data.prefetch import prefetch_batches
+
+    prefetch_depth = int(os.environ.get("BENCH_PREFETCH", "2"))
+    batches = prefetch_batches(
+        batches, depth=prefetch_depth, transfer=trainer._shard_batch
+    )
 
     # Warmup: first step compiles; two more reach dispatch steady-state.
     warmup_losses = []
@@ -386,15 +495,23 @@ def main() -> None:
 
     # Timed window: dispatch all steps, block once on the final state — the
     # throughput an uninstrumented training loop achieves, with every step's
-    # device work still forced to complete inside the window.
+    # device work still forced to complete inside the window.  The input wait
+    # (time blocked on next(batches)) is accounted separately: its share of
+    # the window is the input_fraction the JSON reports.
     t0 = time.perf_counter()
     window_metrics = []
+    input_s = 0.0
     for _ in range(steps):
-        state, metrics = trainer.step(state, next(batches))
+        t_in = time.perf_counter()
+        step_batch = next(batches)
+        input_s += time.perf_counter() - t_in
+        state, metrics = trainer.step(state, step_batch)
         window_metrics.append(metrics)
     state = jax.block_until_ready(state)
     window_s = time.perf_counter() - t0
     timed_losses += [float(m["loss"]) for m in window_metrics]
+    if hasattr(batches, "close"):
+        batches.close()
 
     # --- sanity: the steps must have done real optimization work -----------
     if not all(np.isfinite(warmup_losses + timed_losses)):
@@ -479,11 +596,31 @@ def main() -> None:
         "step_time_avg_s": round(med, 4),
         "probe_step_p10_s": round(p10, 4),
         "probe_step_p90_s": round(p90, 4),
+        "prefetch_depth": prefetch_depth,
+        "input_ms_avg": round(input_s / steps * 1000, 3),
+        "input_fraction": round(input_s / window_s, 4),
         "n_chips": n_chips,
         "device_kind": devices[0].device_kind,
         "warmup_loss_mean": round(float(np.mean(warmup_losses)), 4),
         "timed_loss_mean": round(float(np.mean(timed_losses)), 4),
     }
+    if mm and env_flag("BENCH_PREFETCH_AB", default=True):
+        # prefetch off/on A/B over REAL decoded images (BASELINE #5's "mixed
+        # host-image pipeline"): measured, not asserted — the JSON carries
+        # both legs so a regression in the overlap is visible per round
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="ftc_mm_bench_") as d:
+            ds = _write_mm_bench_dataset(
+                d, n_rows=max(3 * batch, 24),
+                src_px=max(512, 2 * image_size),
+            )
+            state, result["prefetch_ab"] = measure_mm_prefetch_ab(
+                trainer, state, ds, image_size=image_size,
+                batch=batch, seq=seq,
+                steps=min(8, steps), depth=max(prefetch_depth, 1),
+            )
+
     if on_tpu:
         _session_log_append(result)
     elif env_flag("BENCH_IS_FALLBACK"):

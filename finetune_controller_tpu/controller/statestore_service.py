@@ -21,6 +21,7 @@ Python predicates that cannot cross a wire. Auth is a static bearer token
 from __future__ import annotations
 
 import asyncio
+import hmac
 import logging
 from typing import Any, Awaitable, Callable
 
@@ -174,7 +175,16 @@ def build_state_app(store: StateStore, token: str = ""):
     from aiohttp import web
 
     async def rpc_handler(request: web.Request) -> web.Response:
-        if token and request.headers.get("Authorization") != f"Bearer {token}":
+        # constant-time comparison: a plain != short-circuits on the first
+        # differing byte, leaking token prefixes to an in-cluster attacker
+        # who can measure latency. Compare as bytes — compare_digest on str
+        # raises TypeError for non-ASCII input, which would turn a garbage
+        # Authorization header into a 500 instead of a 401.
+        presented = request.headers.get("Authorization", "")
+        if token and not hmac.compare_digest(
+            presented.encode("utf-8", "surrogateescape"),
+            f"Bearer {token}".encode(),
+        ):
             return web.json_response({"error": "unauthorized"}, status=401)
         method = request.match_info["method"]
         handler = _RPC.get(method)

@@ -1,4 +1,11 @@
 from .synthetic import synthetic_batches
 from .loader import jsonl_token_batches, batches_from_tokens
+from .prefetch import PrefetchIterator, prefetch_batches
 
-__all__ = ["synthetic_batches", "jsonl_token_batches", "batches_from_tokens"]
+__all__ = [
+    "synthetic_batches",
+    "jsonl_token_batches",
+    "batches_from_tokens",
+    "PrefetchIterator",
+    "prefetch_batches",
+]

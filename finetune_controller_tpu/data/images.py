@@ -62,10 +62,22 @@ def decode_image(ref: str, *, base_dir: Path | str | None = None) -> np.ndarray:
         if p.suffix == ".npy":
             return _as_float01(np.load(p, allow_pickle=False))
         return _from_bytes(p.read_bytes())
-    # not a file — try bare base64 before giving up
+    if Path(ref).suffix or "\\" in ref:
+        # a file suffix ("." in the last component) or a backslash cannot
+        # appear in base64 — this is a missing/typo'd PATH, so don't even try
+        # the fallback ("/" alone is NOT a path signal: it is in the base64
+        # alphabet, and bare payloads legitimately contain it)
+        raise FileNotFoundError(
+            f"image ref {ref[:80]!r} is neither an existing file nor "
+            "decodable base64 (its file suffix rules the base64 fallback out)"
+        )
+    # not a file — try bare base64 before giving up. A typo'd extensionless
+    # path can be VALID base64 of garbage bytes, which then dies inside the
+    # image decoder (PIL's UnidentifiedImageError is an OSError) — catch that
+    # too and raise the intended error instead of an uncaught decode failure.
     try:
         return _from_bytes(base64.b64decode(ref, validate=True))
-    except (binascii.Error, ValueError):
+    except (binascii.Error, ValueError, OSError):
         raise FileNotFoundError(
             f"image ref {ref[:80]!r} is neither an existing file nor "
             "decodable base64"

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+from collections import OrderedDict
 from pathlib import Path
 from typing import Iterator
 
@@ -29,6 +30,42 @@ logger = logging.getLogger(__name__)
 
 #: decoded-pixel LRU cap: ~336²·3·4B ≈ 1.4 MB per image → ~700 MB ceiling
 _PIXEL_CACHE_MAX = 512
+
+
+class PixelCache:
+    """Bounded LRU for decoded pixel arrays, keyed by row index.
+
+    A real LRU, not clear-everything-at-capacity: steady-state epochs over a
+    dataset just past the cap evict only the least-recently-used entries, so
+    most rows keep their decode instead of the whole dataset re-decoding
+    every epoch. ``capacity <= 0`` disables caching entirely (every access
+    decodes — what the input-pipeline bench uses to measure raw decode cost).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._entries: OrderedDict[int, np.ndarray] = OrderedDict()
+
+    def get(self, key: int) -> np.ndarray | None:
+        px = self._entries.get(key)
+        if px is not None:
+            self._entries.move_to_end(key)
+        return px
+
+    def put(self, key: int, px: np.ndarray) -> None:
+        if self.capacity <= 0:
+            return
+        if key in self._entries:
+            self._entries.move_to_end(key)
+        elif len(self._entries) >= self.capacity:
+            self._entries.popitem(last=False)
+        self._entries[key] = px
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: int) -> bool:
+        return key in self._entries
 
 
 def load_mm_rows(
@@ -71,6 +108,7 @@ def mm_jsonl_batches(
     shard_index: int = 0,
     shard_count: int = 1,
     normalize: str = "clip",
+    pixel_cache_size: int | None = None,
 ) -> Iterator[dict]:
     """Infinite shuffled sample batches:
     ``{"tokens": (B, S) i32, "loss_mask": (B, S) f32, "pixels": (B, H, W, 3)
@@ -80,12 +118,23 @@ def mm_jsonl_batches(
     rows = load_mm_rows(path, tokenizer_file)
     base_dir = Path(path).resolve().parent
     rng = np.random.default_rng(seed)
-    pixel_cache: dict[int, np.ndarray] = {}
+    pixel_cache = PixelCache(
+        _PIXEL_CACHE_MAX if pixel_cache_size is None else pixel_cache_size
+    )
     truncated = 0
     for i, (toks, flags, _) in enumerate(rows):
         if len(toks) > seq_len:
             truncated += 1
-        if any(flags) and not any(flags[:seq_len]):
+        if not any(flags):
+            # no loss-counted tokens at ALL (empty completion, empty text):
+            # the row would contribute ZERO gradient every epoch — the same
+            # silent failure the chat-row empty-mask check in data/loader.py
+            # catches, so refuse it here too
+            raise ValueError(
+                f"row {i}: no loss-counted tokens (empty completion?): the "
+                "sample would train on nothing every epoch"
+            )
+        if not any(flags[:seq_len]):
             # truncation cut away every loss position (e.g. a prompt longer
             # than seq_len): the sample would contribute ZERO gradient every
             # epoch — fail loudly rather than silently training on nothing
@@ -111,9 +160,7 @@ def mm_jsonl_batches(
             px = preprocess_image(
                 image, image_size, base_dir=base_dir, normalize=normalize
             )
-            if len(pixel_cache) >= _PIXEL_CACHE_MAX:
-                pixel_cache.clear()
-            pixel_cache[idx] = px
+            pixel_cache.put(idx, px)
         return t, m, px
 
     n = len(rows)

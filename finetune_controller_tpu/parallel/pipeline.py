@@ -49,7 +49,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .shard_map_compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import AxisNames as Ax
@@ -94,7 +94,7 @@ def _gpipe_local(
     n_micro: int,
     axis_name: str,
 ) -> jax.Array:
-    p_count = jax.lax.axis_size(axis_name)
+    p_count = axis_size(axis_name)
     p_idx = jax.lax.axis_index(axis_name)
     b_loc, s, d = x.shape
     if b_loc % n_micro:

@@ -29,7 +29,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .shard_map_compat import axis_size, pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import AxisNames
@@ -74,7 +74,7 @@ def _ring_attention_local(
     *,
     axis_name: str,
 ) -> jax.Array:
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     hkv = k.shape[2]
@@ -88,9 +88,9 @@ def _ring_attention_local(
     # mark the accumulator inits as device-varying so the fori carry types
     # match after the ppermute makes K/V varying (shard_map vma tracking)
     vary = (*AxisNames.BATCH_AXES, axis_name)
-    acc = jax.lax.pcast(jnp.zeros((b, hkv, g, s_local, d), jnp.float32), vary, to="varying")
-    m = jax.lax.pcast(jnp.full((b, hkv, g, s_local, 1), NEG_INF, jnp.float32), vary, to="varying")
-    l = jax.lax.pcast(jnp.zeros((b, hkv, g, s_local, 1), jnp.float32), vary, to="varying")
+    acc = pcast(jnp.zeros((b, hkv, g, s_local, d), jnp.float32), vary, to="varying")
+    m = pcast(jnp.full((b, hkv, g, s_local, 1), NEG_INF, jnp.float32), vary, to="varying")
+    l = pcast(jnp.zeros((b, hkv, g, s_local, 1), jnp.float32), vary, to="varying")
 
     def step(t, carry):
         acc, m, l, k_blk, v_blk, kseg_blk = carry
@@ -165,7 +165,7 @@ def _ring_attention_local_flash(
     from ..ops.attention import flash_tuning_kwargs
     from ..ops.pallas.flash_attention import flash_attention_with_lse
 
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
 

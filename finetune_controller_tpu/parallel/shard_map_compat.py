@@ -1,0 +1,50 @@
+"""``shard_map`` / ``axis_size`` across jax versions.
+
+Modern jax exports ``jax.shard_map`` with a ``check_vma`` kwarg; older
+releases (0.4.x) keep it in ``jax.experimental.shard_map`` under the previous
+``check_rep`` name for the same knob. Call sites import from here and always
+use the modern ``check_vma`` spelling; the shim translates when running on an
+older jax so the container's baked-in toolchain works unmodified.
+
+Same story for ``jax.lax.axis_size``: absent on 0.4.x, where ``psum(1,
+axis)`` is the classic idiom (it constant-folds to the mesh axis size).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+try:  # modern jax: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_vma"
+except ImportError:  # jax 0.4.x: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_rep"
+
+try:  # modern jax
+    from jax.lax import axis_size
+except ImportError:  # jax 0.4.x
+
+    def axis_size(axis_name: Any) -> int:
+        import jax
+
+        return jax.lax.psum(1, axis_name)
+
+
+try:  # modern jax: varying-manual-axes casts for the vma type system
+    from jax.lax import pcast
+except ImportError:  # jax 0.4.x has no vma tracking — the cast is a no-op
+
+    def pcast(x: Any, axes: Any, *, to: str) -> Any:
+        return x
+
+
+__all__ = ["shard_map", "axis_size", "pcast"]
+
+
+def shard_map(
+    f: Callable[..., Any], *, check_vma: bool = True, **kwargs: Any
+) -> Callable[..., Any]:
+    return _shard_map(f, **{_CHECK_KWARG: check_vma}, **kwargs)

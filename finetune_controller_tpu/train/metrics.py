@@ -5,6 +5,16 @@ writes ``*metrics*.csv`` under the artifacts dir, monitor syncs the newest
 match into the DB; reference ``app/utils/S3Handler.py:252-258``,
 ``app/core/monitor.py:34-95``): one header row, one row per logging step,
 flushed on every write so the monitor sees fresh data mid-run.
+
+Standard trainer columns (``train/trainer.py`` writes them every log step):
+``timestamp``, ``step``, ``loss``, ``accuracy``, ``tokens_per_sec``, and the
+input-pipeline observability pair — ``input_ms`` (host time the training
+thread waited per step for its next batch; with the default background
+prefetch this is residual stall, not the overlapped build time) and
+``input_fraction`` (that wait as a share of the logging window: ~0 means
+device-bound/healthy, toward 1 means input-bound — raise the prefetch depth
+or move host work off the loader). Eval-cadence columns (``eval_*``,
+including ``eval_input_ms``) are declared via ``extra_fields``.
 """
 
 from __future__ import annotations

@@ -93,6 +93,16 @@ class TrainConfig:
     #: fit HBM. batch_size must divide by it; numerics match the unsplit
     #: step up to float reduction order (tested).
     grad_accum_steps: int = 1
+    #: host input-pipeline prefetch depth (``data/prefetch.py``): a
+    #: background thread builds up to N batches ahead while the device runs
+    #: the current step, preserving batch order exactly (loss trajectories
+    #: are bit-identical to the synchronous path — tested). 0 is the escape
+    #: hatch back to the synchronous on-thread build.
+    prefetch: int = 2
+    #: also ``device_put`` the NEXT batch with the training-step sharding on
+    #: the prefetch thread (double-buffered host→HBM copy that overlaps the
+    #: running step). Ignored when ``prefetch == 0``.
+    prefetch_to_device: bool = True
 
 
 class PreemptionGuard:
@@ -547,8 +557,12 @@ class Trainer:
 
         sums: dict[str, float] = {}
         n = 0
-        for _ in range(max(1, self.cfg.eval_steps)):
+        n_batches = max(1, self.cfg.eval_steps)
+        input_s = 0.0  # host build + transfer time the eval pass waited on
+        for _ in range(n_batches):
+            t_in = time.perf_counter()
             host_batch = next(eval_batches)
+            input_s += time.perf_counter() - t_in
             # grad accumulation exists because the full batch's activations
             # don't fit HBM — eval must microbatch the same way or it OOMs
             # at the first eval step of exactly those configs
@@ -566,11 +580,13 @@ class Trainer:
                     "grad_accum_steps", rows, accum,
                 )
             for c in range(chunks):
+                t_in = time.perf_counter()
                 piece = {
                     k: v[c * (rows // chunks):(c + 1) * (rows // chunks)]
                     for k, v in host_batch.items()
                 }
                 batch = self._shard_batch(piece)
+                input_s += time.perf_counter() - t_in
                 fn = self._get_eval_jit(batch)
                 with self.mesh, ring_mesh(self.mesh):
                     metrics = fn(state, batch)
@@ -579,9 +595,13 @@ class Trainer:
                 n += 1
         # target_tokens is a per-batch count — averaging it is meaningless,
         # and only declared columns survive the CSV header
-        return {
+        out = {
             f"eval_{k}": v / n for k, v in sums.items() if k != "target_tokens"
         }
+        # input-pipeline observability: host build + transfer time per eval
+        # batch (ms) — an input-bound eval shows up here, not in eval_loss
+        out["eval_input_ms"] = input_s / n_batches * 1000.0
+        return out
 
     # ---- host-side API ---------------------------------------------------
 
@@ -618,6 +638,13 @@ class Trainer:
 
     def _shard_batch(self, batch: dict) -> dict:
         def put(x):
+            if isinstance(x, jax.Array):
+                # already transferred (the prefetch pipeline device_puts with
+                # these same shardings on its own thread) — a np.asarray here
+                # would copy the batch BACK to host and resubmit it
+                if x.sharding == self._batch_leaf_sharding(x):
+                    return x
+                return jax.device_put(x, self._batch_leaf_sharding(x))
             x = np.asarray(x)
             sh = self._batch_leaf_sharding(x)
             if jax.process_count() > 1:
@@ -889,18 +916,49 @@ class Trainer:
             raise ValueError(
                 "eval_every > 0 but no eval_batches were supplied to fit()"
             )
+        # input_ms/input_fraction ride every logged row, but must ALSO be
+        # declared so a resume appending to a pre-input-metrics CSV rewrites
+        # the header union instead of silently dropping the new columns
         writer = MetricsWriter(
             artifacts_dir, append=start_step > 0,
-            extra_fields=("eval_loss", "eval_accuracy") if eval_it is not None else (),
+            extra_fields=("input_ms", "input_fraction") + (
+                ("eval_loss", "eval_accuracy", "eval_input_ms")
+                if eval_it is not None else ()
+            ),
         )
         it: Iterator[dict] = iter(batches)
         # Fast-forward past already-consumed batches so a resumed run sees the
-        # same data stream an uninterrupted run would have.
+        # same data stream an uninterrupted run would have. This happens on
+        # the RAW iterator, before the prefetch wrap — the skip loop and the
+        # prefetch producer must never race for batches.
         for _ in range(start_step):
             next(it)
+        prefetch_its: list[Any] = []
+        if self.cfg.prefetch > 0:
+            from ..data.prefetch import PrefetchIterator
+
+            # the producer thread builds batch N+1..N+k while the device runs
+            # step N; the transfer stage additionally device_puts the next
+            # batch with the step's shardings (async dispatch → the host→HBM
+            # copy overlaps compute, double-buffered by the queue)
+            it = PrefetchIterator(
+                it, depth=self.cfg.prefetch,
+                transfer=self._shard_batch if self.cfg.prefetch_to_device else None,
+            )
+            prefetch_its.append(it)
+            if eval_it is not None and self.cfg.eval_every > 0:
+                # eval_every == 0 means evaluate() never runs — don't spin a
+                # producer that eagerly builds eval batches nobody consumes
+                eval_it = PrefetchIterator(eval_it, depth=1)
+                prefetch_its.append(eval_it)
         tokens_per_batch = self.cfg.batch_size * self.cfg.seq_len
         window_t0 = time.perf_counter()
         window_tokens = 0
+        # input-pipeline observability: host time each step actually WAITED
+        # for its batch (with prefetch on this is the residual stall, not the
+        # overlapped build time — a healthy pipeline logs input_fraction ~0)
+        window_input_s = 0.0
+        window_steps = 0
         # jax.profiler trace window (rank 0 only): ships with the artifacts
         profiling = False
         prof_first = start_step + self.cfg.profile_start_step
@@ -928,7 +986,10 @@ class Trainer:
                 if want_profile and not profiling and step_idx == prof_first:
                     jax.profiler.start_trace(f"{artifacts_dir}/profile")
                     profiling = True
+                t_in = time.perf_counter()
                 batch = next(it)
+                window_input_s += time.perf_counter() - t_in
+                window_steps += 1
                 state, metrics = self.step(state, batch)
                 window_tokens += tokens_per_batch
                 if profiling and step_idx + 1 >= prof_last:
@@ -964,18 +1025,29 @@ class Trainer:
                     # the evaluation pause doesn't count against throughput
                     dt = time.perf_counter() - window_t0 - eval_elapsed
                     metrics["tokens_per_sec"] = window_tokens / max(dt, 1e-9)
+                    # input-time share of the window: near 0 = device-bound
+                    # (healthy); toward 1 = input-bound (grow prefetch depth
+                    # or move host work off the loader)
+                    metrics["input_ms"] = (
+                        window_input_s / max(window_steps, 1) * 1000.0
+                    )
+                    metrics["input_fraction"] = window_input_s / max(dt, 1e-9)
                     metrics.update(eval_metrics)
                     row = {"step": step_idx + 1, **metrics}
                     writer.write(row)
                     if on_metrics:
                         on_metrics(step_idx + 1, metrics)
                     logger.info(
-                        "step %d loss %.4f acc %.3f tok/s %.0f",
+                        "step %d loss %.4f acc %.3f tok/s %.0f input %.1fms"
+                        " (%.1f%% of step)",
                         step_idx + 1, metrics["loss"], metrics["accuracy"],
-                        metrics["tokens_per_sec"],
+                        metrics["tokens_per_sec"], metrics["input_ms"],
+                        100.0 * metrics["input_fraction"],
                     )
                     window_t0 = time.perf_counter()
                     window_tokens = 0
+                    window_input_s = 0.0
+                    window_steps = 0
 
                 # SIGTERM may reach only some hosts; state_to_host is a
                 # collective, so the preempt flag must be agreed across hosts
@@ -998,6 +1070,10 @@ class Trainer:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
                     raise SystemExit(143)
         finally:
+            # stop the prefetch producers FIRST: a producer mid-build must
+            # not keep decoding images while teardown waits on checkpoints
+            for p in prefetch_its:
+                p.close()
             if profiling:
                 jax.profiler.stop_trace()
             # Must be read before the inner except handler runs: inside an

@@ -15,6 +15,11 @@ result to a JSONL log so a mid-session tunnel drop loses nothing:
 5. Gemma-7B + Qwen2-7B QLoRA measurements (first batch size that fits HBM);
 6. 7B cached-decode generation smoke (cold/warm latency + decode tok/s).
 
+Round 6 adds `baseline_rows`: one committed record for every BASELINE table
+entry still cited only in prose (Llama-3.2-1B/3B, the 16k-context Mistral
+point, the Llama-3-8B QLoRA proxy, and `BENCH_MODE=mm` — whose record now
+also carries the input-pipeline prefetch off/on A/B).
+
 Usage:  python scripts/tpu_session.py [--log tpu_session.jsonl] [--only STEP]
 """
 
@@ -294,6 +299,37 @@ def step_moe(log_path: Path) -> None:
         log_result(log_path, {"step": step, **rec})
 
 
+def step_baseline_rows(log_path: Path) -> None:
+    """Erase the remaining prose-only BASELINE rows (VERDICT r5 next-round
+    #3): every table entry whose number lives only in BASELINE.md prose gets
+    a committed `tpu_session.jsonl` record in one tunnel-up window. Configs
+    are copied verbatim from the rows' own reproduction command lines
+    (BASELINE rows 2, 5, 8, 9 and the 16k long-context table)."""
+    for step, env in (
+        # rows 8/9: the Llama-3.2 family (128k-vocab → bf16 logits to fit)
+        ("lora_llama3.2-1b_bs4",
+         {"BENCH_PRESET": "llama3.2-1b", "BENCH_BATCH": "4",
+          "BENCH_LOGITS_DTYPE": "bfloat16"}),
+        ("lora_llama3.2-3b_bs2",
+         {"BENCH_PRESET": "llama3.2-3b", "BENCH_BATCH": "2",
+          "BENCH_LOGITS_DTYPE": "bfloat16"}),
+        # long-context table: deepest single-chip point, 16k on the 32k preset
+        ("longctx_mistral7b-32k_seq16384_bs1",
+         {"BENCH_MODE": "qlora", "BENCH_PRESET": "mistral-7b-32k",
+          "BENCH_SEQ": "16384", "BENCH_BATCH": "1",
+          "BENCH_LOGITS_DTYPE": "bfloat16"}),
+        # row 2's single-chip proxy: Llama-3-8B QLoRA int4
+        ("qlora_llama3-8b_bs4",
+         {"BENCH_MODE": "qlora", "BENCH_PRESET": "llama3-8b",
+          "BENCH_BATCH": "4", "BENCH_LOGITS_DTYPE": "bfloat16"}),
+        # row 5: LLaVA multimodal SFT — also carries the prefetch off/on A/B
+        # over real decoded images (input_fraction + prefetch_ab in the JSON)
+        ("mm_llava_bs4", {"BENCH_MODE": "mm"}),
+    ):
+        rec = run_bench(dict(env))
+        log_result(log_path, {"step": step, **rec})
+
+
 def step_fidelity(log_path: Path) -> None:
     """Round-5 fidelity proof on the chip (VERDICT #1/#9): the full
     pretrain→export→controller-LoRA→before/after-generation pipeline via
@@ -345,13 +381,13 @@ def main() -> int:
     ap.add_argument("--log", default=str(REPO / "tpu_session.jsonl"))
     ap.add_argument("--only", default="",
                     help="parity|headline|kernel_ab|headline_tuned|longctx|"
-                         "families|moe|gen7b|fidelity")
+                         "families|moe|baseline_rows|gen7b|fidelity")
     args = ap.parse_args()
     log_path = Path(args.log)
 
     steps = args.only.split(",") if args.only else [
         "parity", "headline", "kernel_ab", "headline_tuned", "longctx",
-        "families", "moe", "gen7b", "fidelity"
+        "families", "moe", "baseline_rows", "gen7b", "fidelity"
     ]
     for step in steps:
         print(f"=== step: {step} ===", flush=True)
@@ -374,6 +410,8 @@ def main() -> int:
             step_new_families(log_path)
         elif step == "moe":
             step_moe(log_path)
+        elif step == "baseline_rows":
+            step_baseline_rows(log_path)
         elif step == "gen7b":
             step_gen7b(log_path)
         elif step == "fidelity":

@@ -230,5 +230,14 @@ def test_image_decode_paths(tmp_path):
 
     with pytest.raises(FileNotFoundError, match="neither"):
         decode_image("no/such/file.png!!")
+    # a typo'd EXTENSIONLESS path can be valid base64 of garbage bytes —
+    # that must surface as the intended error, not an uncaught decode
+    # failure from inside the image decoder (PIL's UnidentifiedImageError)
+    with pytest.raises(FileNotFoundError, match="neither"):
+        decode_image("imahetypo+00")
+    # a file suffix rules the base64 fallback out entirely: a missing
+    # "cat0.png" is a missing FILE, never a base64 payload
+    with pytest.raises(FileNotFoundError, match="suffix"):
+        decode_image("cat0.png")
     with pytest.raises(ValueError, match="normalize"):
         preprocess_image(str(tmp_path / "g.npy"), 4, normalize="bogus")

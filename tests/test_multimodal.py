@@ -264,3 +264,73 @@ def test_mm_loader_decodes_paths_npy_and_base64(tmp_path):
     }) + "\n")
     with pytest.raises(ValueError, match="past seq_len"):
         next(mm_jsonl_batches(str(longp), batch_size=1, seq_len=16, image_size=8))
+
+    # a row with NO loss-counted tokens at all (empty completion) is the
+    # same zero-gradient failure, before truncation even enters — refuse it
+    # like the chat-row empty-mask check in data/loader.py
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({
+        "image": str(tmp_path / "a.png"), "prompt": "p: ", "completion": "",
+    }) + "\n")
+    with pytest.raises(ValueError, match="no loss-counted tokens"):
+        next(mm_jsonl_batches(str(empty), batch_size=1, seq_len=16, image_size=8))
+
+
+def test_pixel_cache_is_a_bounded_lru():
+    """The decoded-pixel cache evicts ONLY the least-recently-used entry at
+    capacity — not clear-everything — so an epoch over a dataset just past
+    the cap keeps most decodes warm instead of re-decoding the whole set."""
+    from finetune_controller_tpu.data.mm_loader import PixelCache
+
+    cache = PixelCache(3)
+    px = {k: np.full((2, 2, 3), k, np.float32) for k in range(5)}
+    for k in (0, 1, 2):
+        cache.put(k, px[k])
+    assert cache.get(0) is px[0]  # refresh 0 → 1 is now the LRU
+    cache.put(3, px[3])
+    assert len(cache) == 3
+    assert 1 not in cache and 0 in cache and 2 in cache and 3 in cache
+    # re-putting an existing key refreshes it instead of growing the cache
+    cache.put(2, px[2])
+    cache.put(4, px[4])
+    assert 0 not in cache and 2 in cache and len(cache) == 3
+
+    # capacity <= 0 disables caching (the bench's measure-every-decode mode)
+    off = PixelCache(0)
+    off.put(1, px[1])
+    assert len(off) == 0 and off.get(1) is None
+
+
+def test_mm_loader_lru_avoids_full_redecide_per_epoch(tmp_path, monkeypatch):
+    """Steady-state epochs over a dataset ONE row past the cache cap decode
+    ~1 row per epoch (the evicted one), not the whole dataset — the failure
+    mode of the old clear-at-capacity cache."""
+    from finetune_controller_tpu.data import mm_loader
+    from finetune_controller_tpu.data.mm_loader import mm_jsonl_batches
+
+    n_rows, cap = 6, 5
+    rows = []
+    for i in range(n_rows):
+        np.save(tmp_path / f"{i}.npy", np.full((4, 4, 3), i / 8, np.float32))
+        rows.append({"image": f"{i}.npy", "prompt": "p: ", "completion": "z"})
+    path = tmp_path / "mm.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+
+    decodes = []
+    real = mm_loader.preprocess_image
+
+    def counting(image, *a, **kw):
+        decodes.append(image)
+        return real(image, *a, **kw)
+
+    monkeypatch.setattr(mm_loader, "preprocess_image", counting)
+    it = mm_jsonl_batches(
+        str(path), batch_size=n_rows, seq_len=16, image_size=4,
+        pixel_cache_size=cap,
+    )
+    next(it)  # epoch 1: cold — all rows decode
+    assert len(decodes) == n_rows
+    for _ in range(3):  # steady state: ≤ 2 decodes/epoch (evictee + churn)
+        decodes.clear()
+        next(it)
+        assert len(decodes) <= 2, f"cache thrash: {len(decodes)} decodes"
