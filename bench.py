@@ -29,7 +29,9 @@ Measurement discipline (round-2 rework):
 
 Env knobs: BENCH_PRESET, BENCH_STEPS, BENCH_BATCH, BENCH_SEQ, BENCH_TINY=1
 (CI-sized run), BENCH_MODE=qlora (int4 config #3), BENCH_REMAT_POLICY,
-BENCH_ATTN_IMPL, BENCH_FROZEN_DTYPE, BENCH_LOGITS_DTYPE (perf experiments).
+BENCH_ATTN_IMPL, BENCH_FROZEN_DTYPE, BENCH_LOGITS_DTYPE (perf experiments),
+BENCH_RECOMPILE_BUDGET (distinct jit signatures allowed before the run is
+declared a measurement bug and aborted — analysis/recompile_guard.py; 0 off).
 
 Input-pipeline knobs (round 6): BENCH_PREFETCH (background prefetch depth
 for the batch stream, default 2; 0 = synchronous host build on the timing
@@ -456,6 +458,14 @@ def main() -> None:
         total_steps=steps + 3 + probe_steps,
         log_every=10**9, checkpoint_every=10**9,
         frozen_dtype=os.environ.get("BENCH_FROZEN_DTYPE", frozen_default) or None,
+        # recompilation guard (analysis/recompile_guard.py): a step that
+        # recompiles mid-window is a measurement bug (the timed window would
+        # include XLA compiles), so the bench RAISES instead of printing a
+        # slow number. Budget 0 disables; the default of 4 covers every batch
+        # structure a bench run legitimately produces (text window, mm A/B
+        # legs) while a per-step shape leak burns through it immediately.
+        recompile_budget=int(os.environ.get("BENCH_RECOMPILE_BUDGET", "4")),
+        recompile_action="raise",
     )
     trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
     state = trainer.init_state()

@@ -1,0 +1,44 @@
+"""`ftc-lint`: JAX-aware static analysis for the two planes of this repo.
+
+The compute plane (jitted training/inference code) and the controller plane
+(async control-plane services, thread-backed pipelines) fail in different,
+equally silent ways: a host sync inside a jitted step loop shows up only as a
+mysteriously slow TPU profile; a swallowed exception in the reconciler shows
+up as a job stuck QUEUED forever.  This package makes both classes of hazard
+a mechanical CI failure instead of an expensive rediscovery:
+
+* :mod:`engine` — the AST walker, rule registry, ``# ftc: ignore[rule-id]``
+  suppressions, text/JSON reporting, and the ``ftc-lint`` console entry;
+* :mod:`rules_compute` — host-sync-in-jit, prng-key-reuse, recompile
+  hazards, missing-donation;
+* :mod:`rules_controller` — silent-except, shared-mutable-without-lock,
+  blocking-io-in-async;
+* :mod:`recompile_guard` — the runtime complement: counts distinct jit
+  signatures behind ``TrainConfig.recompile_budget`` / bench env knobs and
+  warns or raises when a shape-unstable step blows the budget.
+
+``tests/test_lint_clean.py`` gates the repo: zero unsuppressed findings over
+``finetune_controller_tpu/``.  See ``docs/static_analysis.md``.
+"""
+
+from .engine import Finding, LintResult, lint_paths, lint_source, main  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "lint_paths",
+    "lint_source",
+    "main",
+    "RecompileGuard",
+    "RecompileBudgetExceeded",
+]
+
+
+def __getattr__(name: str):
+    # the guard pulls in jax; loaded lazily so the pure-AST `ftc-lint` CLI
+    # (and scripts/ci_check.sh, which runs it first) stays jax-import-free
+    if name in ("RecompileGuard", "RecompileBudgetExceeded"):
+        from . import recompile_guard
+
+        return getattr(recompile_guard, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

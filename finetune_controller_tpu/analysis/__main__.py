@@ -1,0 +1,8 @@
+"""`python -m finetune_controller_tpu.analysis` == the ftc-lint CLI."""
+
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

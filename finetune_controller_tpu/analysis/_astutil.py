@@ -1,0 +1,116 @@
+"""Shared AST plumbing for the lint rules: dotted names, parent chains,
+and jitted-function discovery."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+FuncDef = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.random.split`` for a Name/Attribute chain, else ``""``."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def terminal_name(node: ast.AST) -> str:
+    """Last segment of a Name/Attribute chain (``self._train_step`` ->
+    ``_train_step``), else ``""``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def parent_map(module: ast.Module) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(module):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> Iterator[ast.AST]:
+    while node in parents:
+        node = parents[node]
+        yield node
+
+
+def functions(module: ast.Module) -> Iterator[FuncDef]:
+    for node in ast.walk(module):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def walk_in_order(node: ast.AST) -> list[ast.AST]:
+    """All descendants sorted by source position (linear-scan heuristics)."""
+    out = [n for n in ast.walk(node) if hasattr(n, "lineno")]
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit"}
+
+
+def is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression name ``jax.jit`` (or pjit)?"""
+    return dotted_name(node) in _JIT_NAMES
+
+
+def jit_call_target(call: ast.Call) -> ast.AST | None:
+    """For ``jax.jit(f, ...)`` return the ``f`` expression, else None."""
+    if is_jit_callable(call.func) and call.args:
+        return call.args[0]
+    return None
+
+
+def _decorator_jits(fn: FuncDef) -> bool:
+    """True when ``fn`` is decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``
+    / ``@jax.jit(...)``."""
+    for dec in fn.decorator_list:
+        if is_jit_callable(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if is_jit_callable(dec.func):
+                return True
+            if dotted_name(dec.func) in ("partial", "functools.partial") and (
+                dec.args and is_jit_callable(dec.args[0])
+            ):
+                return True
+    return False
+
+
+def jitted_functions(module: ast.Module) -> dict[FuncDef, str]:
+    """Functions whose bodies are traced: decorated with jit, or referenced
+    by name in a ``jax.jit(...)``/``shard_map(...)`` call anywhere in the
+    module (``jax.jit(self._train_step, ...)`` marks ``_train_step``).
+
+    Returns {function def: how it was detected} for diagnostics.
+    """
+    out: dict[FuncDef, str] = {}
+    referenced: set[str] = set()
+    for node in ast.walk(module):
+        if not isinstance(node, ast.Call):
+            continue
+        target = jit_call_target(node)
+        if target is None and dotted_name(node.func).endswith("shard_map") and node.args:
+            target = node.args[0]
+        if target is not None:
+            name = terminal_name(target)
+            if name:
+                referenced.add(name)
+    for fn in functions(module):
+        if _decorator_jits(fn):
+            out[fn] = "decorated"
+        elif fn.name in referenced:
+            out[fn] = "referenced"
+    return out

@@ -1,0 +1,263 @@
+"""The ftc-lint engine: file walker, rule registry, suppressions, reporting.
+
+A rule is a callable ``(module: ast.Module, src: str, path: str) -> iterable
+of (line, col, message)`` registered under a kebab-case id with
+:func:`register`.  The engine parses each file once, runs every selected rule
+over the tree, then drops findings covered by an inline suppression::
+
+    risky_line()  # ftc: ignore[rule-id] -- why this is intentional
+
+A suppression comment matches on the finding's own line or the line directly
+above it (for statements too long to share a line with their justification),
+and may carry several ids: ``# ftc: ignore[silent-except,host-sync-in-jit]``.
+The ``-- reason`` tail is free text; CI policy (docs/static_analysis.md) is
+that every suppression carries one.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 parse/usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "register",
+    "all_rules",
+    "lint_source",
+    "lint_paths",
+    "main",
+]
+
+#: ``# ftc: ignore[id1,id2]`` with an optional ``-- reason`` tail
+_SUPPRESS_RE = re.compile(
+    r"#\s*ftc:\s*ignore\[(?P<ids>[a-z0-9_,\-\s]+)\]"
+    r"(?:\s*--\s*(?P<reason>.*))?",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule hit at one source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{tag}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    plane: str  # "compute" | "controller"
+    summary: str
+    check: Callable[[ast.Module, str, str], Iterable[tuple[int, int, str]]]
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(rule_id: str, plane: str, summary: str):
+    """Decorator: register ``check(module, src, path)`` under ``rule_id``."""
+
+    def deco(fn):
+        if rule_id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        _REGISTRY[rule_id] = Rule(rule_id, plane, summary, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    """The full registry (importing the rule modules on first use)."""
+    # imported lazily so `from .engine import register` inside the rule
+    # modules doesn't cycle at package import time
+    from . import rules_compute, rules_controller  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---- suppression handling --------------------------------------------------
+
+
+def _suppressions(src: str) -> dict[int, set[str]]:
+    """line number -> rule ids suppressed on that line."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out[i] = {s.strip() for s in m.group("ids").split(",") if s.strip()}
+    return out
+
+
+def _is_suppressed(f: Finding, supp: dict[int, set[str]]) -> bool:
+    for line in (f.line, f.line - 1):
+        ids = supp.get(line)
+        if ids and (f.rule in ids or "all" in ids):
+            return True
+    return False
+
+
+# ---- running ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    errors: list[str]  # unparseable files etc.
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        return 1 if self.active else 0
+
+
+def lint_source(
+    src: str,
+    path: str = "<string>",
+    rules: dict[str, Rule] | None = None,
+) -> list[Finding]:
+    """Lint one source string; returns findings with suppressions applied
+    (suppressed findings are kept, flagged, for ``--show-suppressed``)."""
+    rules = rules if rules is not None else all_rules()
+    module = ast.parse(src, filename=path)
+    supp = _suppressions(src)
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    for rule in rules.values():
+        for line, col, message in rule.check(module, src, path):
+            key = (rule.id, line, col)
+            if key in seen:
+                continue  # rules scanning nested scopes can visit a site twice
+            seen.add(key)
+            f = Finding(rule.id, path, line, col, message)
+            if _is_suppressed(f, supp):
+                f = dataclasses.replace(f, suppressed=True)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        else:
+            yield p
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: dict[str, Rule] | None = None,
+) -> LintResult:
+    rules = rules if rules is not None else all_rules()
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for path in _iter_py_files(paths):
+        try:
+            src = path.read_text(encoding="utf-8")
+        except OSError as exc:
+            errors.append(f"{path}: unreadable: {exc}")
+            continue
+        try:
+            findings.extend(lint_source(src, str(path), rules))
+        except SyntaxError as exc:
+            errors.append(f"{path}: parse error: {exc}")
+    return LintResult(findings=findings, errors=errors)
+
+
+# ---- CLI -------------------------------------------------------------------
+
+
+def _select_rules(select: str | None, ignore: str | None) -> dict[str, Rule]:
+    rules = all_rules()
+    if select:
+        wanted = {s.strip() for s in select.split(",") if s.strip()}
+        unknown = wanted - rules.keys()
+        if unknown:
+            raise SystemExit(f"ftc-lint: unknown rule(s): {sorted(unknown)}")
+        rules = {k: v for k, v in rules.items() if k in wanted}
+    if ignore:
+        dropped = {s.strip() for s in ignore.split(",") if s.strip()}
+        unknown = dropped - all_rules().keys()
+        if unknown:
+            raise SystemExit(f"ftc-lint: unknown rule(s): {sorted(unknown)}")
+        rules = {k: v for k, v in rules.items() if k not in dropped}
+    return rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ftc-lint",
+        description="JAX-aware static analysis for finetune-controller-tpu "
+        "(docs/static_analysis.md)",
+    )
+    p.add_argument("paths", nargs="*", default=["finetune_controller_tpu"],
+                   help="files or directories (default: the package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", help="comma-separated rule ids to run")
+    p.add_argument("--ignore", help="comma-separated rule ids to skip")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by ftc: ignore")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(all_rules().values(), key=lambda r: (r.plane, r.id)):
+            print(f"{rule.id:30} [{rule.plane:10}] {rule.summary}")
+        return 0
+
+    rules = _select_rules(args.select, args.ignore)
+    result = lint_paths(args.paths, rules)
+
+    shown = result.findings if args.show_suppressed else result.active
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in shown],
+            "errors": result.errors,
+            "counts": {
+                "active": len(result.active),
+                "suppressed": len(result.findings) - len(result.active),
+            },
+        }, indent=2))
+    else:
+        for f in shown:
+            print(f.render())
+        for err in result.errors:
+            print(f"error: {err}", file=sys.stderr)
+        n_sup = len(result.findings) - len(result.active)
+        print(
+            f"ftc-lint: {len(result.active)} finding(s), "
+            f"{n_sup} suppressed, {len(result.errors)} error(s)",
+            file=sys.stderr,
+        )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,308 @@
+"""Compute-plane rules: the JAX performance/correctness contract.
+
+These rules encode the hazards that only surface on a TPU profile (or as a
+silently wrong run): host-device syncs traced into a jitted body, PRNG keys
+consumed twice, recompilation traps, and un-donated training state.  They
+are heuristics over the AST — interprocedural data flow is out of scope —
+so each carries a suppression escape hatch for the intentional cases
+(``docs/static_analysis.md`` has the catalog with before/after examples).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ._astutil import (
+    FuncDef,
+    ancestors,
+    dotted_name,
+    jit_call_target,
+    jitted_functions,
+    parent_map,
+    terminal_name,
+    walk_in_order,
+)
+from .engine import register
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+#: attribute calls that force a device->host transfer of their receiver
+_SYNC_METHODS = {"item", "tolist"}
+#: numpy entry points that materialise a traced value on the host
+_NP_CONVERTERS = {"asarray", "array", "copyto", "save", "savez"}
+#: builtins that concretise a tracer when applied to one
+_CONCRETISERS = {"float", "int", "bool"}
+
+
+def _references_param(expr: ast.AST, params: set[str]) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in params for n in ast.walk(expr)
+    )
+
+
+def _param_names(fn: FuncDef) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n != "self"}
+
+
+@register(
+    "host-sync-in-jit",
+    "compute",
+    "device->host sync (.item()/float()/np.asarray/print) inside a jitted body",
+)
+def host_sync_in_jit(module: ast.Module, src: str, path: str):
+    for fn, how in jitted_functions(module).items():
+        params = _param_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            msg = None
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+                msg = f".{node.func.attr}() forces a device->host transfer"
+            elif name == "print":
+                msg = (
+                    "print() in a traced body runs at TRACE time only (or "
+                    "syncs, if the value escapes) — use jax.debug.print"
+                )
+            elif name in ("jax.device_get", "device_get"):
+                msg = "jax.device_get blocks on the device inside the traced body"
+            elif (
+                name.split(".", 1)[0] in ("np", "numpy", "onp")
+                and name.split(".")[-1] in _NP_CONVERTERS
+                and node.args
+                and _references_param(node.args[0], params)
+            ):
+                msg = f"{name} materialises a traced value on the host"
+            elif (
+                name in _CONCRETISERS
+                and node.args
+                and _references_param(node.args[0], params)
+            ):
+                msg = f"{name}() concretises a traced value (host sync at best)"
+            if msg:
+                yield (
+                    node.lineno, node.col_offset,
+                    f"in jitted fn `{fn.name}` ({how}): {msg}",
+                )
+
+
+# ---------------------------------------------------------------------------
+# prng-key-reuse
+# ---------------------------------------------------------------------------
+
+#: jax.random functions that mint/derive keys rather than consume them
+_KEY_PRODUCERS = {
+    "PRNGKey", "key", "split", "fold_in", "clone", "wrap_key_data",
+}
+
+
+def _is_random_call(node: ast.AST, kinds: set[str] | None = None) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if not (name.startswith("jax.random.") or name.startswith("random.")
+            or name.startswith("jrandom.") or name.startswith("jr.")):
+        return False
+    leaf = name.split(".")[-1]
+    if kinds is None:
+        return True
+    return leaf in kinds
+
+
+def _contains_key_producer(expr: ast.AST) -> bool:
+    return any(
+        _is_random_call(n, _KEY_PRODUCERS) for n in ast.walk(expr)
+    )
+
+
+def _assign_targets(node: ast.AST) -> list[str]:
+    if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        return []
+    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+    names: list[str] = []
+    for t in targets:
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            names.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+    return names
+
+
+@register(
+    "prng-key-reuse",
+    "compute",
+    "the same PRNG key flows into two consumers without split/fold_in",
+)
+def prng_key_reuse(module: ast.Module, src: str, path: str):
+    """Linear-scan heuristic per function: a name bound from a key producer
+    (PRNGKey/split/fold_in/...) that is passed to MORE than one jax.random
+    consumer without being rebound in between is flagged at the second use.
+    Control flow is ignored (branches that each use the key once can FP —
+    suppress with a reason)."""
+    from ._astutil import functions
+
+    for fn in functions(module):
+        keys: dict[str, int] = {}  # live key name -> consumer uses so far
+        for node in walk_in_order(fn):
+            names = _assign_targets(node)
+            if names:
+                value = getattr(node, "value", None)
+                if value is not None and _contains_key_producer(value):
+                    for n in names:
+                        keys[n] = 0  # fresh key material
+                else:
+                    for n in names:
+                        keys.pop(n, None)  # rebound to something else
+                continue
+            if _is_random_call(node) and not _is_random_call(node, _KEY_PRODUCERS):
+                call = node  # a consumer: count key names in its args
+                for arg in [*call.args, *[kw.value for kw in call.keywords]]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) and sub.id in keys:
+                            keys[sub.id] += 1
+                            if keys[sub.id] > 1:
+                                yield (
+                                    call.lineno, call.col_offset,
+                                    f"key `{sub.id}` already consumed once in "
+                                    f"`{fn.name}` — jax.random.split it (reusing "
+                                    "a key correlates the two draws)",
+                                )
+
+
+# ---------------------------------------------------------------------------
+# recompile hazards
+# ---------------------------------------------------------------------------
+
+
+@register(
+    "recompile-jit-in-loop",
+    "compute",
+    "jax.jit called inside a loop body (a fresh wrapper per iteration)",
+)
+def recompile_jit_in_loop(module: ast.Module, src: str, path: str):
+    parents = parent_map(module)
+    for node in ast.walk(module):
+        if not (isinstance(node, ast.Call) and jit_call_target(node) is not None):
+            continue
+        for anc in ancestors(node, parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break  # deferred: the loop doesn't run this jit per iteration
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                yield (
+                    node.lineno, node.col_offset,
+                    "jax.jit inside a loop builds a fresh wrapper (and "
+                    "usually a fresh compile) every iteration — hoist it, or "
+                    "cache per static config",
+                )
+                break
+
+
+@register(
+    "recompile-fresh-callable",
+    "compute",
+    "jax.jit over a lambda/partial/grad built at call time (recompiles per call)",
+)
+def recompile_fresh_callable(module: ast.Module, src: str, path: str):
+    """``jax.jit(lambda ...)`` / ``jax.jit(functools.partial(...))`` /
+    ``jax.jit(jax.grad(...))`` inside a function body: the inner callable is
+    a NEW object on every call of the enclosing function, so jit's cache
+    never hits across calls.  Loop bodies are recompile-jit-in-loop's beat —
+    skipped here so one site yields one finding."""
+    parents = parent_map(module)
+    for node in ast.walk(module):
+        if not isinstance(node, ast.Call):
+            continue
+        target = jit_call_target(node)
+        if target is None or not isinstance(target, (ast.Lambda, ast.Call)):
+            continue
+        in_function = in_loop = False
+        for anc in ancestors(node, parents):
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                in_loop = True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_function = True
+                break
+        if in_function and not in_loop:
+            what = "a lambda" if isinstance(target, ast.Lambda) else (
+                f"`{dotted_name(target.func) or 'a fresh callable'}(...)`"
+            )
+            yield (
+                node.lineno, node.col_offset,
+                f"jax.jit over {what} built inside a function recompiles on "
+                "every call of the enclosing function — hoist the callable "
+                "or memoise the jitted fn",
+            )
+
+
+# ---------------------------------------------------------------------------
+# missing-donation
+# ---------------------------------------------------------------------------
+
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _looks_like_train_step(name: str) -> bool:
+    low = name.lower()
+    if "eval" in low:
+        return False
+    return ("step" in low or "state" in low) and ("train" in low or "update" in low)
+
+
+@register(
+    "missing-donation",
+    "compute",
+    "jitted train/update step without donate_argnums (state buffers double-allocate)",
+)
+def missing_donation(module: ast.Module, src: str, path: str):
+    # form 1: jax.jit(<train_step-ish>, ...) without a donate kwarg
+    for node in ast.walk(module):
+        if not isinstance(node, ast.Call):
+            continue
+        target = jit_call_target(node)
+        if target is None:
+            continue
+        name = terminal_name(target)
+        if not name or not _looks_like_train_step(name):
+            continue
+        if not any(kw.arg in _DONATE_KWARGS for kw in node.keywords):
+            yield (
+                node.lineno, node.col_offset,
+                f"jax.jit(`{name}`) without donate_argnums/donate_argnames: "
+                "the old state stays live across the step, doubling its HBM "
+                "footprint",
+            )
+    # form 2: @jax.jit-decorated train_step def whose decorator carries no
+    # donate kwarg (a bare @jax.jit cannot donate anything)
+    from ._astutil import is_jit_callable
+
+    for fn, how in jitted_functions(module).items():
+        if how != "decorated" or not _looks_like_train_step(fn.name):
+            continue
+        for dec in fn.decorator_list:
+            is_jit_dec = is_jit_callable(dec) or (
+                isinstance(dec, ast.Call) and (
+                    is_jit_callable(dec.func)
+                    or (dotted_name(dec.func) in ("partial", "functools.partial")
+                        and dec.args and is_jit_callable(dec.args[0]))
+                )
+            )
+            if not is_jit_dec:
+                continue
+            donated = isinstance(dec, ast.Call) and any(
+                kw.arg in _DONATE_KWARGS for kw in dec.keywords
+            )
+            if not donated:
+                yield (
+                    fn.lineno, fn.col_offset,
+                    f"jitted `{fn.name}` takes training state but the "
+                    "decorator donates nothing — pass donate_argnums",
+                )
+            break

@@ -1,0 +1,223 @@
+"""Controller-plane rules: the async/threaded control plane's failure modes.
+
+The control plane fails quietly: a broad ``except`` that logs nothing turns
+a dead reconciler into a job stuck QUEUED forever; a thread target mutating
+shared state without its lock turns a rare scheduler interleaving into a
+corrupted queue; a blocking read inside an ``async def`` stalls every other
+request on the event loop.  Each rule's escape hatch is the standard
+``# ftc: ignore[rule-id] -- reason`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ._astutil import ancestors, dotted_name, parent_map, terminal_name
+from .engine import register
+
+# ---------------------------------------------------------------------------
+# silent-except
+# ---------------------------------------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+#: method names whose call counts as "the handler reported the failure"
+_LOG_METHODS = {
+    "exception", "error", "warning", "warn", "info", "debug", "critical", "log",
+}
+#: plain-call names that count as reporting (CLI modules print, benches fail)
+_LOG_CALLS = {"print", "fail"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Tuple):
+        return any(terminal_name(e) in _BROAD for e in t.elts)
+    return terminal_name(t) in _BROAD
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            # attr check separately from dotted_name: the receiver may be a
+            # call chain (logging.getLogger(__name__).warning) it can't name
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _LOG_METHODS:
+                return True
+            if name in _LOG_CALLS:
+                return True
+            if name in ("traceback.print_exc", "traceback.print_exception",
+                        "warnings.warn", "sys.exit"):
+                return True
+    return False
+
+
+@register(
+    "silent-except",
+    "controller",
+    "broad except whose body neither logs, re-raises, nor narrows the type",
+)
+def silent_except(module: ast.Module, src: str, path: str):
+    for node in ast.walk(module):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node):
+            continue
+        if _handler_reports(node):
+            continue
+        caught = "bare except" if node.type is None else (
+            f"except {terminal_name(node.type) or '...'}"
+        )
+        yield (
+            node.lineno, node.col_offset,
+            f"{caught} swallows the failure silently — log it "
+            "(logger.exception), re-raise, or narrow the exception type",
+        )
+
+
+# ---------------------------------------------------------------------------
+# shared-mutable-without-lock
+# ---------------------------------------------------------------------------
+
+#: in-place mutators whose read-modify-write spans bytecodes
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "add", "discard", "setdefault",
+}
+
+
+def _thread_target_names(module: ast.Module) -> set[str]:
+    """Names passed as ``target=`` to ``threading.Thread`` (positional form
+    ``Thread(group, target)`` is not used in this codebase)."""
+    out: set[str] = set()
+    for node in ast.walk(module):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) not in ("threading.Thread", "Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                name = terminal_name(kw.value)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _under_lock(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> bool:
+    """Is this statement inside a ``with <something named *lock*>:`` block?"""
+    for anc in ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                target = expr.func if isinstance(expr, ast.Call) else expr
+                if "lock" in dotted_name(target).lower():
+                    return True
+    return False
+
+
+@register(
+    "shared-mutable-without-lock",
+    "controller",
+    "read-modify-write of shared state from a threading.Thread target without a lock",
+)
+def shared_mutable_without_lock(module: ast.Module, src: str, path: str):
+    """Inside a function used as a ``threading.Thread`` target, flag
+    augmented assignment to ``self.attr``/globals and in-place mutator calls
+    (``.append``/``.update``/...) on ``self.attr`` that are not under a
+    ``with <lock>`` block.  Plain rebinds (``self.x = v``) are a single
+    atomic bytecode and stay unflagged."""
+    targets = _thread_target_names(module)
+    if not targets:
+        return
+    parents = parent_map(module)
+    for fn in ast.walk(module):
+        if not isinstance(fn, ast.FunctionDef) or fn.name not in targets:
+            continue
+        for node in ast.walk(fn):
+            hit = None
+            if isinstance(node, ast.AugAssign) and isinstance(
+                node.target, (ast.Attribute, ast.Name, ast.Subscript)
+            ):
+                hit = (node, f"augmented assignment to "
+                             f"`{ast.unparse(node.target)}`")
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and isinstance(node.func.value, ast.Attribute)
+                and dotted_name(node.func.value).startswith("self.")
+            ):
+                hit = (node, f"`{ast.unparse(node.func)}(...)`")
+            if hit and not _under_lock(hit[0], parents):
+                yield (
+                    hit[0].lineno, hit[0].col_offset,
+                    f"thread target `{fn.name}` mutates shared state "
+                    f"({hit[1]}) without holding a lock",
+                )
+
+
+# ---------------------------------------------------------------------------
+# blocking-io-in-async
+# ---------------------------------------------------------------------------
+
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep blocks the event loop — use asyncio.sleep",
+    "open": "open() does blocking filesystem I/O on the event loop — "
+            "await asyncio.to_thread(open, ...) or move the I/O to a thread",
+    "socket.create_connection": "blocking socket connect on the event loop",
+    "urllib.request.urlopen": "blocking HTTP on the event loop — use the "
+                              "aiohttp session",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+_BLOCKING_SUBPROCESS = {"run", "check_output", "check_call", "call", "Popen"}
+#: pathlib's whole-file helpers (blocking reads/writes by construction)
+_PATHLIB_IO = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+
+@register(
+    "blocking-io-in-async",
+    "controller",
+    "blocking call (time.sleep/requests/open/subprocess.run) inside async def",
+)
+def blocking_io_in_async(module: ast.Module, src: str, path: str):
+    for fn in ast.walk(module):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        # a nested sync def is a deferral boundary: its body typically runs
+        # via asyncio.to_thread / an executor, off the loop
+        boundary = {
+            n for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.Lambda)) and n is not fn
+        }
+        skip: set[ast.AST] = set()
+        for b in boundary:
+            skip.update(ast.walk(b))
+        for node in ast.walk(fn):
+            if node in skip or not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            msg = _BLOCKING_EXACT.get(name)
+            if msg is None and name.startswith(_BLOCKING_PREFIXES):
+                msg = f"{name} is a blocking HTTP call on the event loop"
+            if msg is None and name.startswith("subprocess.") and (
+                name.split(".")[-1] in _BLOCKING_SUBPROCESS
+            ):
+                msg = f"{name} blocks the loop — use asyncio.create_subprocess_exec"
+            if msg is None and (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _PATHLIB_IO
+            ):
+                msg = (
+                    f".{node.func.attr}() is a blocking whole-file "
+                    "read/write — await asyncio.to_thread(...) it"
+                )
+            if msg:
+                yield (
+                    node.lineno, node.col_offset,
+                    f"in async `{fn.name}`: {msg}",
+                )

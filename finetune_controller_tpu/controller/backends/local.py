@@ -152,7 +152,9 @@ class LocalProcessBackend(TrainingBackend):
                 dataset_path=dataset_path,
                 mesh=mesh,
             )
-            handle.spec_path.write_text(json.dumps(trainer_spec, indent=2))
+            await asyncio.to_thread(
+                handle.spec_path.write_text, json.dumps(trainer_spec, indent=2)
+            )
 
             handle.env = self._runtime_env(flavor, job.num_slices)
 
@@ -224,7 +226,7 @@ class LocalProcessBackend(TrainingBackend):
             return
         # pre-claim output (JAX import warnings) goes to a pool log, not any
         # job's log; after the claim the worker re-points itself at the job
-        pool_log = open(self.root / "warm_workers.log", "ab")
+        pool_log = await asyncio.to_thread(open, self.root / "warm_workers.log", "ab")
         env = dict(env)
         ready_path = self.root / f".warm_ready_{time.time_ns()}"
         env["FTC_WARM_READY_FILE"] = str(ready_path)
@@ -392,7 +394,7 @@ class LocalProcessBackend(TrainingBackend):
                 "--spec", str(handle.spec_path),
             ]
             handle.event("Started", f"attempt {attempt}: {shlex.join(cmd)}")
-            log_f = open(handle.logs_path, "ab")
+            log_f = await asyncio.to_thread(open, handle.logs_path, "ab")
             try:
                 # the child inherits the fd; the parent's copy closes either way
                 proc = await asyncio.create_subprocess_exec(

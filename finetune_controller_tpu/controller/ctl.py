@@ -80,9 +80,22 @@ class Client:
         async with self._session.get(url) as r:
             if r.status >= 400:
                 raise ApiError(f"GET {path} -> {r.status}: {await r.text()}")
-            with open(dest, "wb") as f:
+            f = await asyncio.to_thread(open, dest, "wb")
+            try:
+                # batch small chunks into ~1 MiB flushes: one thread-pool
+                # round-trip per block, not per 64 KiB network read
+                buf: list[bytes] = []
+                buffered = 0
                 async for chunk in r.content.iter_chunked(1 << 16):
-                    f.write(chunk)
+                    buf.append(chunk)
+                    buffered += len(chunk)
+                    if buffered >= (1 << 20):
+                        await asyncio.to_thread(f.writelines, buf)
+                        buf, buffered = [], 0
+                if buf:
+                    await asyncio.to_thread(f.writelines, buf)
+            finally:
+                await asyncio.to_thread(f.close)
 
 
 def _parse_args_kv(pairs: list[str]) -> dict[str, Any]:
@@ -128,9 +141,12 @@ async def cmd_submit(client: Client, ns: argparse.Namespace) -> int:
         if ns.device:
             form.add_field("device", ns.device)
         form.add_field("arguments", json.dumps(arguments))
-        with open(ns.dataset_file, "rb") as f:
-            form.add_field("dataset_file", f.read(),
-                           filename=os.path.basename(ns.dataset_file))
+        def _read_dataset() -> bytes:
+            with open(ns.dataset_file, "rb") as f:
+                return f.read()
+
+        form.add_field("dataset_file", await asyncio.to_thread(_read_dataset),
+                       filename=os.path.basename(ns.dataset_file))
         result = await client.post("/jobs", data=form)
     else:
         body: dict[str, Any] = {"model_name": ns.model, "arguments": arguments}
@@ -294,8 +310,8 @@ def main(argv: list[str] | None = None) -> int:
         # downstream pipe closed early (| head ...) — the unix-polite exit
         try:
             sys.stdout.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # the close flushing into the same dead pipe — expected
         return 0
 
 

@@ -54,7 +54,7 @@ async def _token_from_service_account(path: str) -> tuple[str, float]:
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import padding
 
-    info = json.loads(Path(path).read_text())
+    info = json.loads(await asyncio.to_thread(Path(path).read_text))
     now = time.time()
     claims = {
         "iss": info["client_email"],

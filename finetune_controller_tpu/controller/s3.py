@@ -31,6 +31,7 @@ import base64
 import datetime
 import hashlib
 import hmac
+import logging
 import os
 import tempfile
 import urllib.parse
@@ -39,6 +40,8 @@ from pathlib import Path
 from typing import Any, AsyncIterator, Awaitable, Callable
 
 from .objectstore import HttpObjectStore, build_uri, parse_uri
+
+logger = logging.getLogger(__name__)
 
 EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 UNSIGNED = "UNSIGNED-PAYLOAD"
@@ -318,7 +321,10 @@ class S3ObjectStore(HttpObjectStore):
             try:
                 await self._call("DELETE", path, query=[("uploadId", upload_id)])
             except Exception:
-                pass
+                # the original upload failure is what propagates; the abort
+                # failure must not mask it, but it shouldn't vanish either
+                logger.warning("multipart abort failed for %s", path,
+                               exc_info=True)
             raise
 
     async def put_stream(self, uri: str, chunks: AsyncIterator[bytes]) -> int:

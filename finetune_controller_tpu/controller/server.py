@@ -465,6 +465,7 @@ async def get_job_artifacts(request: web.Request) -> web.Response:
             }
         )
         await resp.prepare(request)
+        # ftc: ignore[blocking-io-in-async] -- open() of a local tmp file is metadata-only; the reads below go through to_thread
         with open(tmp_path, "rb") as f:
             while chunk := await asyncio.to_thread(f.read, 1 << 20):
                 await resp.write(chunk)
@@ -690,6 +691,8 @@ async def get_job_logs(request: web.Request) -> web.Response:
     except Exception:
         # substrate cleaned up: serve the archived copy from the artifacts
         # (capability the reference lacks — pod logs die with the pods)
+        logger.debug("live log read failed for %s; trying archived copy",
+                     job.job_id, exc_info=True)
         archived = f"{job.artifacts_uri}/logs.txt" if job.artifacts_uri else None
         if not archived or not await rt.store.exists(archived):
             return _json_error(404, "logs unavailable")

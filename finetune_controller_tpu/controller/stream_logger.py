@@ -69,6 +69,9 @@ class LogStreamManager:
             await self.ws.send_str(text)
             return True
         except Exception:
+            # a dead peer ends the stream; the cause still goes somewhere
+            logger.debug("ws send to %s failed; ending stream", self.job_id,
+                         exc_info=True)
             return False
 
     def _filter(self, line: str) -> str | None:

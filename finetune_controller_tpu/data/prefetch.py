@@ -100,7 +100,7 @@ class PrefetchIterator:
                 transfer_s = time.perf_counter() - t1
                 if not self._put((batch, build_s, transfer_s)):
                     return  # closed while waiting for queue space
-        except BaseException as exc:  # noqa: BLE001 — must cross threads
+        except BaseException as exc:  # noqa: BLE001  # ftc: ignore[silent-except] -- not swallowed: carried across the thread boundary and re-raised on the consumer in __next__
             self._put(_Failure(exc))
 
     def _put(self, item: Any) -> bool:

@@ -83,7 +83,9 @@ def bench_attention(
 
     results: dict[str, float] = {}
     for name, attn in (("xla", xla_causal_attention), ("pallas", flash_attention)):
+        # ftc: ignore[recompile-jit-in-loop] -- one compile per impl IS the benchmark; each (impl, shape) runs once per process
         fwd = jax.jit(functools.partial(attn))
+        # ftc: ignore[recompile-jit-in-loop] -- same: the grad path compiles once per benched impl by design
         grad = jax.jit(jax.grad(functools.partial(loss, attn), argnums=(0, 1, 2)))
         results[f"{name}_fwd_s"] = _time_chained(fwd, q, k, v, chain_fwd, iters)
         results[f"{name}_grad_s"] = _time_chained(grad, q, k, v, _chain_grad, iters)
@@ -121,6 +123,7 @@ def bench_flash_variants(
                     q, k, v, block_q=blk, block_k=blk, exp_dtype=edt)
                 return (o.astype(jnp.float32) ** 2).mean()
 
+            # ftc: ignore[recompile-jit-in-loop] -- the sweep measures one compile per (exp_dtype, block) variant on purpose
             grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
             results[f"{edt}-b{blk}"] = _time_chained(
                 grad, q, k, v, _chain_grad, iters)

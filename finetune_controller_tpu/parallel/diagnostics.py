@@ -94,6 +94,7 @@ def collective_diagnostics(
             perm = [(i, (i + 1) % n) for i in range(n)]
             body = lambda x: jax.lax.ppermute(x, "x", perm)
             bus_factor = 1.0
+        # ftc: ignore[recompile-fresh-callable] -- compiled once per collective op (3 total) per diagnostics invocation; not a hot path
         fn = jax.jit(
             shard_map(
                 body, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
@@ -113,6 +114,7 @@ def collective_diagnostics(
             # materializing it on one device first would OOM the very slices
             # this tool targets (128 MB x 256 chips = 32 GB on device 0)
             elems = max(8, int(size_mb * (1 << 20) // 4))
+            # ftc: ignore[recompile-jit-in-loop] -- a fresh trivial fill compile per payload size is the only way to create the array ALREADY sharded; cost is noise next to the measured collective
             x = jax.jit(
                 lambda: jnp.ones((elems * n,), jnp.float32),
                 out_shardings=spec,

@@ -28,6 +28,7 @@ init); ``tests/test_aot_realscale.py`` asserts on the reports in CI.
 from __future__ import annotations
 
 import json
+import logging
 import math
 import re
 from typing import Any
@@ -231,7 +232,11 @@ def aot_report(name: str) -> dict[str, Any]:
                 "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
             }
     except Exception:
-        pass
+        # memory_analysis is best-effort (backend-dependent API surface);
+        # the report ships without it rather than failing the compile check
+        logging.getLogger(__name__).debug(
+            "compiled.memory_analysis() unavailable", exc_info=True
+        )
 
     pp = mesh_shape.get("pp", 1)
     pp_schedule = None

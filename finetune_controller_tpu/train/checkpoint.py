@@ -86,6 +86,7 @@ class CheckpointManager:
                 self._ckptr.wait_until_finished()
         except BaseException as exc:  # noqa: BLE001 — re-raised from wait()
             logger.exception("background checkpoint save to %s failed", path)
+            # ftc: ignore[shared-mutable-without-lock] -- single in-flight writer thread (save() waits before starting another); list.append is GIL-atomic and drained only after join() in wait()
             self._pending_error.append(exc)
 
     def save(self, step: int, tree: Any, force: bool = False, blocking: bool = False) -> None:

@@ -103,6 +103,14 @@ class TrainConfig:
     #: the prefetch thread (double-buffered host→HBM copy that overlaps the
     #: running step). Ignored when ``prefetch == 0``.
     prefetch_to_device: bool = True
+    #: recompilation guard (``analysis/recompile_guard.py``): budget of
+    #: distinct jit signatures the step/eval functions may compile over the
+    #: whole run (0 = off). A healthy run compiles once per batch structure;
+    #: a per-step-varying shape (or static Python value) blows straight
+    #: past this.
+    recompile_budget: int = 0
+    #: what to do past the budget: "warn" (log once) or "raise"
+    recompile_action: str = "warn"
 
 
 class PreemptionGuard:
@@ -364,6 +372,15 @@ class Trainer:
         # jitted steps are cached per batch structure (multimodal batches add
         # a rank-4 pixels leaf whose sharding differs from token arrays)
         self._step_jits: dict[tuple[str, ...], Any] = {}
+        self._recompile_guard = None
+        if self.cfg.recompile_budget > 0:
+            from ..analysis.recompile_guard import RecompileGuard
+
+            self._recompile_guard = RecompileGuard(
+                self.cfg.recompile_budget,
+                on_excess=self.cfg.recompile_action,
+                name="trainer-recompile-guard",
+            )
 
     def _batch_leaf_sharding(self, x: Any) -> NamedSharding:
         """Token-like (B, S) leaves shard batch+seq; higher-rank leaves (e.g.
@@ -384,6 +401,8 @@ class Trainer:
                 out_shardings=(self._state_shardings, None),
                 donate_argnums=(0,),
             )
+            if self._recompile_guard is not None:
+                fn = self._recompile_guard.wrap(fn, label=f"step:{','.join(key)}")
             self._step_jits[key] = fn
         return fn
 
@@ -548,6 +567,8 @@ class Trainer:
                 in_shardings=(self._state_shardings, batch_sh),
                 out_shardings=None,
             )
+            if self._recompile_guard is not None:
+                fn = self._recompile_guard.wrap(fn, label=f"eval:{','.join(key)}")
             self._step_jits[key] = fn
         return fn
 

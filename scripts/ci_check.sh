@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# The one-command CI gate: static analysis, then the tier-1 test suite.
+#
+#   scripts/ci_check.sh            # lint + tests
+#   scripts/ci_check.sh --lint-only
+#
+# Lint: `ftc-lint finetune_controller_tpu/` must exit 0 — every finding is
+# fixed or carries a justified `# ftc: ignore[rule-id] -- reason`
+# (docs/static_analysis.md). Tests: the tier-1 command from ROADMAP.md.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== ftc-lint ==" >&2
+python -m finetune_controller_tpu.analysis finetune_controller_tpu/
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    echo "ci_check: ftc-lint failed (exit $lint_rc)" >&2
+    exit "$lint_rc"
+fi
+
+if [ "${1:-}" = "--lint-only" ]; then
+    exit 0
+fi
+
+echo "== tier-1 tests ==" >&2
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"

@@ -1,0 +1,529 @@
+"""Per-rule fixture tests for ftc-lint (analysis/engine.py + rules).
+
+Each rule gets the same treatment: it fires on a known-bad snippet, stays
+quiet on the clean rewrite, and honors an inline suppression.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from finetune_controller_tpu.analysis import lint_source
+from finetune_controller_tpu.analysis.engine import all_rules, lint_paths, main
+
+
+def _lint(src: str, rule: str | None = None):
+    rules = all_rules()
+    if rule is not None:
+        rules = {rule: rules[rule]}
+    return lint_source(textwrap.dedent(src), "<fixture>", rules)
+
+
+def _active(src: str, rule: str | None = None):
+    return [f for f in _lint(src, rule) if not f.suppressed]
+
+
+def _ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_in_jit_fires_on_item_and_print():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(state, batch):
+            loss = compute(state, batch)
+            print(loss)
+            return loss.item()
+    """
+    found = _active(src, "host-sync-in-jit")
+    assert len(found) == 2
+    assert {"print", ".item()"} <= {
+        "print" if "print" in f.message else ".item()" for f in found
+    }
+
+
+def test_host_sync_detects_jit_by_reference_and_np_asarray():
+    src = """
+        import jax
+        import numpy as np
+
+        def train_step(state, batch):
+            return np.asarray(batch["x"])
+
+        fn = jax.jit(train_step, donate_argnums=(0,))
+    """
+    found = _active(src, "host-sync-in-jit")
+    assert len(found) == 1
+    assert "np.asarray" in found[0].message
+
+
+def test_host_sync_quiet_on_clean_jit_and_host_code():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(state, batch):
+            return state + batch["x"].sum()
+
+        def host_loop(metrics):
+            # host-side float()/print are fine — not a traced body
+            print(float(np.asarray(metrics)))
+    """
+    assert _active(src, "host-sync-in-jit") == []
+
+
+def test_host_sync_suppression_honored():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(state):
+            print(state)  # ftc: ignore[host-sync-in-jit] -- trace-time banner, prints once per compile
+            return state
+    """
+    findings = _lint(src, "host-sync-in-jit")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# prng-key-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_key_reuse_fires_on_double_consumption():
+    src = """
+        import jax
+
+        def sample(shape):
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, shape)
+            b = jax.random.uniform(key, shape)
+            return a, b
+    """
+    found = _active(src, "prng-key-reuse")
+    assert len(found) == 1
+    assert "`key`" in found[0].message
+
+
+def test_key_reuse_quiet_with_split_and_rebind():
+    src = """
+        import jax
+
+        def sample(shape):
+            key = jax.random.PRNGKey(0)
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, shape)
+            b = jax.random.uniform(k2, shape)
+            key = jax.random.fold_in(k1, 7)
+            c = jax.random.normal(key, shape)
+            return a, b, c
+    """
+    assert _active(src, "prng-key-reuse") == []
+
+
+def test_key_reuse_suppression_honored():
+    src = """
+        import jax
+
+        def sample(shape):
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, shape)
+            # ftc: ignore[prng-key-reuse] -- correlated draws are intentional here
+            b = jax.random.uniform(key, shape)
+            return a, b
+    """
+    findings = _lint(src, "prng-key-reuse")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# recompile-jit-in-loop / recompile-fresh-callable
+# ---------------------------------------------------------------------------
+
+
+def test_jit_in_loop_fires():
+    src = """
+        import jax
+
+        def run(fns, x):
+            for f in fns:
+                x = jax.jit(f)(x)
+            return x
+    """
+    assert len(_active(src, "recompile-jit-in-loop")) == 1
+
+
+def test_jit_in_loop_quiet_when_hoisted_or_deferred():
+    src = """
+        import jax
+
+        jitted = jax.jit(lambda x: x + 1)
+
+        def run(xs):
+            out = [jitted(x) for x in xs]
+            for x in xs:
+                # a def inside the loop defers the jit to call time
+                def make(f):
+                    return jax.jit(f)
+            return out
+    """
+    assert _active(src, "recompile-jit-in-loop") == []
+
+
+def test_fresh_callable_fires_inside_function_not_module_level():
+    src = """
+        import jax
+        import functools
+
+        module_level = jax.jit(functools.partial(max))  # once at import: fine
+
+        def bench(f, x):
+            g = jax.jit(jax.grad(f))
+            return g(x)
+    """
+    found = _active(src, "recompile-fresh-callable")
+    assert len(found) == 1
+    assert found[0].line > 6  # the one inside bench(), not the module-level one
+
+
+def test_recompile_suppressions_honored():
+    src = """
+        import jax
+
+        def run(fns, x):
+            for f in fns:
+                # ftc: ignore[recompile-jit-in-loop] -- one compile per impl is the point
+                x = jax.jit(f)(x)
+            return x
+    """
+    findings = _lint(src, "recompile-jit-in-loop")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# missing-donation
+# ---------------------------------------------------------------------------
+
+
+def test_missing_donation_fires_on_call_and_decorator_forms():
+    src = """
+        import jax
+        from functools import partial
+
+        def train_step(state, batch):
+            return state
+
+        fn = jax.jit(train_step)  # no donate_argnums
+
+        @partial(jax.jit)
+        def update_step(state, grads):
+            return state
+    """
+    found = _active(src, "missing-donation")
+    assert len(found) == 2
+
+
+def test_missing_donation_quiet_when_donated_or_eval():
+    src = """
+        import jax
+        from functools import partial
+
+        def train_step(state, batch):
+            return state
+
+        fn = jax.jit(train_step, donate_argnums=(0,))
+
+        @partial(jax.jit, donate_argnames=("state",))
+        def update_step(state, grads):
+            return state
+
+        def eval_step(state, batch):
+            return state
+
+        efn = jax.jit(eval_step)  # eval reuses state: donation would be wrong
+    """
+    assert _active(src, "missing-donation") == []
+
+
+def test_missing_donation_suppression_honored():
+    src = """
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        # ftc: ignore[missing-donation] -- state aliasing measured irrelevant here
+        fn = jax.jit(train_step)
+    """
+    findings = _lint(src, "missing-donation")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# silent-except
+# ---------------------------------------------------------------------------
+
+
+def test_silent_except_fires_on_broad_pass():
+    src = """
+        def tick():
+            try:
+                work()
+            except Exception:
+                pass
+    """
+    assert len(_active(src, "silent-except")) == 1
+
+
+def test_silent_except_fires_on_bare_except():
+    src = """
+        def tick():
+            try:
+                work()
+            except:
+                result = None
+    """
+    assert len(_active(src, "silent-except")) == 1
+
+
+def test_silent_except_quiet_when_logged_narrowed_or_reraised():
+    src = """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def tick():
+            try:
+                work()
+            except Exception:
+                logger.exception("tick failed")
+            try:
+                work()
+            except Exception as exc:
+                raise RuntimeError("wrapped") from exc
+            try:
+                work()
+            except (OSError, ValueError):
+                pass  # narrow types may stay silent
+    """
+    assert _active(src, "silent-except") == []
+
+
+def test_silent_except_suppression_honored():
+    src = """
+        def tick():
+            try:
+                work()
+            except Exception:  # ftc: ignore[silent-except] -- probe failure means feature off
+                pass
+    """
+    findings = _lint(src, "silent-except")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# shared-mutable-without-lock
+# ---------------------------------------------------------------------------
+
+
+def test_shared_mutable_fires_on_unlocked_thread_target():
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+                self.items = []
+                self._thread = threading.Thread(target=self._work)
+
+            def _work(self):
+                self.n += 1
+                self.items.append(1)
+    """
+    found = _active(src, "shared-mutable-without-lock")
+    assert len(found) == 2
+
+
+def test_shared_mutable_quiet_under_lock_and_off_thread():
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self._work)
+
+            def _work(self):
+                with self._lock:
+                    self.n += 1
+                self.done = True  # plain rebind: atomic, unflagged
+
+            def not_a_thread_target(self):
+                self.n += 1
+    """
+    assert _active(src, "shared-mutable-without-lock") == []
+
+
+def test_shared_mutable_suppression_honored():
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._work)
+
+            def _work(self):
+                # ftc: ignore[shared-mutable-without-lock] -- single writer; drained after join
+                self.errors.append(1)
+    """
+    findings = _lint(src, "shared-mutable-without-lock")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# blocking-io-in-async
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_io_fires_on_sleep_requests_open():
+    src = """
+        import time
+        import requests
+
+        async def handler(path):
+            time.sleep(1)
+            r = requests.get("http://x")
+            with open(path) as f:
+                return f, r
+    """
+    found = _active(src, "blocking-io-in-async")
+    assert len(found) == 3
+
+
+def test_blocking_io_quiet_on_async_idioms_and_sync_defs():
+    src = """
+        import asyncio
+        import time
+
+        async def handler(path):
+            await asyncio.sleep(1)
+            data = await asyncio.to_thread(_read, path)
+            return data
+
+        def _read(path):
+            # sync helper: runs via to_thread, off the loop
+            time.sleep(0.1)
+            with open(path) as f:
+                return f.read()
+    """
+    assert _active(src, "blocking-io-in-async") == []
+
+
+def test_blocking_io_suppression_honored():
+    src = """
+        async def handler(path):
+            with open(path) as f:  # ftc: ignore[blocking-io-in-async] -- local tmpfile, metadata-only open
+                return f.name
+    """
+    findings = _lint(src, "blocking-io-in-async")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# engine behavior
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_matches_line_above_and_multiple_ids():
+    src = """
+        import jax
+
+        def train_step(state):
+            return state
+
+        # ftc: ignore[missing-donation,recompile-jit-in-loop] -- fixture
+        fn = jax.jit(train_step)
+    """
+    findings = _lint(src, "missing-donation")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+def test_unrelated_suppression_does_not_silence():
+    src = """
+        def tick():
+            try:
+                work()
+            except Exception:  # ftc: ignore[host-sync-in-jit] -- wrong id
+                pass
+    """
+    found = _active(src, "silent-except")
+    assert len(found) == 1
+
+
+def test_rule_registry_has_both_planes():
+    rules = all_rules()
+    planes = {r.plane for r in rules.values()}
+    assert planes == {"compute", "controller"}
+    assert len(rules) >= 8
+
+
+def test_cli_json_format_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    rc = main([str(bad), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["counts"]["active"] == 1
+    assert out["findings"][0]["rule"] == "silent-except"
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean)]) == 0
+
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert main([str(broken)]) == 2
+
+
+def test_cli_select_and_ignore(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert main([str(bad), "--select", "host-sync-in-jit"]) == 0
+    capsys.readouterr()
+    assert main([str(bad), "--ignore", "silent-except"]) == 0
+    with pytest.raises(SystemExit):
+        main([str(bad), "--select", "no-such-rule"])
+
+
+def test_lint_paths_walks_directories(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("x = 1\n")
+    (pkg / "b.py").write_text(
+        "async def h():\n    import time\n    time.sleep(1)\n"
+    )
+    result = lint_paths([str(pkg)])
+    assert [f.rule for f in result.active] == ["blocking-io-in-async"]
+    assert result.exit_code == 1
