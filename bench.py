@@ -352,7 +352,157 @@ def measure_mm_prefetch_ab(
     return state, legs
 
 
+def _measure_chaos_recovery() -> dict:
+    """BENCH_MODE=chaos: time the supervised-retry loop end to end.
+
+    Runs a tiny job on the local backend, SIGTERM-kills it after its first
+    committed checkpoint (backend restart budget zeroed so the CONTROLLER
+    half — classify → backoff → resubmit-with-resume, docs/resilience.md —
+    does the recovery), and reports the operator-facing latencies:
+
+      detect_s    kill → the monitor classifies the failure (RETRYING)
+      requeue_s   kill → the supervisor's resubmission hits the backend
+      recover_s   kill → the respawned attempt reaches RUNNING
+      total_s     submit → SUCCEEDED, both attempts included
+
+    These are the production SLO numbers for a preemptible pool: how much
+    wall clock one revocation costs beyond the backoff delay itself.
+    """
+    import asyncio
+    import tempfile
+    import time as _time
+    from pathlib import Path
+
+    from finetune_controller_tpu.controller.backends.local import LocalProcessBackend
+    from finetune_controller_tpu.controller.examples import (
+        LoRASFTArguments, TinyTestLoRA,
+    )
+    from finetune_controller_tpu.controller.monitor import JobMonitor
+    from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+    from finetune_controller_tpu.controller.schemas import DatabaseStatus, JobInput
+    from finetune_controller_tpu.controller.statestore import StateStore
+    from finetune_controller_tpu.controller.task_builder import (
+        DatasetInput, task_builder,
+    )
+    from finetune_controller_tpu.controller.devices import (
+        DeviceCatalog, DeviceFlavor, FlavorQuota,
+    )
+    from finetune_controller_tpu.controller.registry import load_builtin_models
+    from finetune_controller_tpu.resilience.policy import RetryPolicy
+    from finetune_controller_tpu.resilience.supervisor import RetrySupervisor
+
+    load_builtin_models()  # the supervisor rebuilds the spec from the registry
+
+    steps = int(os.environ.get("BENCH_STEPS", "400"))
+    ckpt_every = int(os.environ.get("BENCH_CKPT_EVERY", "50"))
+    backoff_s = float(os.environ.get("BENCH_RETRY_BACKOFF", "0.2"))
+
+    async def run(tmp: Path) -> dict:
+        state = StateStore(tmp / "state")
+        store = LocalObjectStore(tmp / "objects")
+        catalog = DeviceCatalog(
+            flavors=[DeviceFlavor(name="chip-1", generation="cpu", hosts=1,
+                                  chips_per_host=1, runtime="cpu", queue="q")],
+            quotas=[FlavorQuota(flavor="chip-1", nominal_chips=2)],
+            default_flavor="chip-1",
+        )
+        backend = LocalProcessBackend(
+            tmp / "sandboxes", store, catalog,
+            sync_interval_s=0.2, backoff_limit=0,
+        )
+        supervisor = RetrySupervisor(
+            state, backend, catalog,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=backoff_s,
+                               max_delay_s=backoff_s, seed=0),
+        )
+        monitor = JobMonitor(state, store, backend, interval_s=0.1,
+                             supervisor=supervisor)
+        await state.connect()
+        spec = TinyTestLoRA(training_arguments=LoRASFTArguments(
+            total_steps=steps, warmup_steps=1, batch_size=2, seq_len=16,
+            lora_rank=2, log_every=ckpt_every, checkpoint_every=ckpt_every,
+        ))
+        job = JobInput(job_id="chaos-bench-1", user_id="bench",
+                       model_name="tiny-test-lora", device="chip-1",
+                       arguments=spec.training_arguments.model_dump())
+        t_submit = _time.perf_counter()
+        await task_builder(
+            job, spec, DatasetInput(),
+            state=state, store=store, backend=backend, catalog=catalog,
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        import re as _re
+
+        handle = backend._handles["chaos-bench-1"]
+        ckpt_dir = handle.artifacts_dir / "checkpoints"
+        deadline = _time.monotonic() + 300
+        committed = _re.compile(r"^step_\d+$")  # NOT in-flight *-tmp staging
+
+        def has_committed() -> bool:
+            return ckpt_dir.is_dir() and any(
+                committed.match(p.name) for p in ckpt_dir.iterdir()
+            )
+
+        while not has_committed():
+            if _time.monotonic() > deadline:
+                fail("chaos bench: no checkpoint appeared within 300s")
+            await asyncio.sleep(0.1)
+        assert await backend.inject_fault("chaos-bench-1", signum=15)
+        t_kill = _time.perf_counter()
+        t_detect = t_requeue = t_recover = None
+        while True:
+            await monitor.tick()
+            now = _time.perf_counter()
+            rec = await state.get_job("chaos-bench-1")
+            if t_detect is None and rec.status is DatabaseStatus.RETRYING:
+                t_detect = now
+            if t_requeue is None and supervisor.resubmits > 0:
+                t_requeue = now
+            report = await backend.get_job("chaos-bench-1")
+            if (t_recover is None and t_requeue is not None
+                    and report is not None and report.state.value == "Running"):
+                t_recover = now
+            if rec.status.is_final:
+                break
+            if _time.monotonic() > deadline:
+                fail("chaos bench: job not final within 300s", status=str(rec.status))
+            await asyncio.sleep(0.05)
+        t_done = _time.perf_counter()
+        attempts = rec.metadata.get("attempt_history") or []
+        if rec.status is not DatabaseStatus.SUCCEEDED:
+            fail("chaos bench: job did not recover to SUCCEEDED",
+                 status=str(rec.status), attempts=attempts)
+        if len(attempts) != 1:
+            fail("chaos bench: expected exactly one recorded kill",
+                 attempts=attempts)
+        out = {
+            "metric": f"chaos_recovery[tiny-test,steps{steps},ckpt{ckpt_every}]",
+            "value": round(t_recover - t_kill, 3) if t_recover else None,
+            "unit": "s (kill -> respawned attempt RUNNING)",
+            "detect_s": round(t_detect - t_kill, 3) if t_detect else None,
+            "requeue_s": round(t_requeue - t_kill, 3) if t_requeue else None,
+            "recover_s": round(t_recover - t_kill, 3) if t_recover else None,
+            "total_s": round(t_done - t_submit, 3),
+            "backoff_s": backoff_s,
+            "failure_class": attempts[0]["failure_class"],
+            "restored_checkpoints": (await state.get_job("chaos-bench-1"))
+                .metadata.get("restored_checkpoints"),
+        }
+        await backend.close()
+        await state.close()
+        return out
+
+    with tempfile.TemporaryDirectory(prefix="ftc_chaos_bench_") as d:
+        return asyncio.run(run(Path(d)))
+
+
 def main() -> None:
+    if os.environ.get("BENCH_MODE", "").strip().lower() == "chaos":
+        # controller-plane bench: the parent process needs no accelerator —
+        # the trainers run as subprocesses with their own JAX runtime
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(_measure_chaos_recovery()))
+        return
     _init_backend_with_fallback()
     import jax
 

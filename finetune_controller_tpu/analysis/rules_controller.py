@@ -221,3 +221,131 @@ def blocking_io_in_async(module: ast.Module, src: str, path: str):
                     node.lineno, node.col_offset,
                     f"in async `{fn.name}`: {msg}",
                 )
+
+
+# ---------------------------------------------------------------------------
+# unbounded-retry
+# ---------------------------------------------------------------------------
+
+_SLEEP_CALLS = {"time.sleep", "asyncio.sleep", "sleep", "anyio.sleep"}
+
+
+def _is_const_true(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value) is True
+
+
+def _own_body_walk(root: ast.AST):
+    """Walk a loop body without descending into nested function/class scopes
+    (a ``return`` inside a nested def does not exit the loop)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _own_breaks(loop: ast.While):
+    """``break`` statements belonging to THIS loop (not to a nested one)."""
+    stack = [(child, loop) for child in ast.iter_child_nodes(loop)]
+    while stack:
+        node, owner = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Break):
+            if owner is loop:
+                yield node
+            continue
+        next_owner = node if isinstance(node, (ast.For, ast.AsyncFor,
+                                               ast.While)) else owner
+        stack.extend((c, next_owner) for c in ast.iter_child_nodes(node))
+
+
+def _calls_sleep(root: ast.AST) -> ast.Call | None:
+    for node in _own_body_walk(root):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in _SLEEP_CALLS:
+            return node
+    return None
+
+
+@register(
+    "unbounded-retry",
+    "controller",
+    "while-True retry loop that sleeps with no max-attempt or deadline bound",
+)
+def unbounded_retry(module: ast.Module, src: str, path: str):
+    """Two shapes of the same bug — a failure loop that can spin forever:
+
+    1. a ``while True:`` loop that sleeps and has NO exit at all (no
+       ``break`` of its own, no ``return``, no ``raise`` in its body);
+    2. a ``while True:`` loop whose ``except`` handler sleeps (the classic
+       retry-after-failure) while that handler holds no ``raise``/
+       ``return``/``break`` — success exits the loop, but the FAILURE path
+       retries unboundedly, so a persistent error spins until an operator
+       notices.
+
+    The fix is a max-attempt counter or a deadline check that turns the last
+    failure into a raise (see ``resilience/policy.py:RetryPolicy`` for the
+    house pattern); intentional forever-loops (daemon reconcilers) carry a
+    ``# ftc: ignore[unbounded-retry] -- reason``.
+    """
+    for loop in ast.walk(module):
+        if not isinstance(loop, ast.While) or not _is_const_true(loop.test):
+            continue
+        sleep = _calls_sleep(loop)
+        if sleep is None:
+            continue
+        has_exit = (
+            next(_own_breaks(loop), None) is not None
+            or any(
+                isinstance(n, (ast.Return, ast.Raise))
+                for n in _own_body_walk(loop)
+            )
+        )
+        if not has_exit:
+            yield (
+                loop.lineno, loop.col_offset,
+                "while-True loop sleeps but has no break/return/raise — it "
+                "retries forever; bound it with a max-attempt counter or "
+                "deadline",
+            )
+            continue
+        for try_node in _own_body_walk(loop):
+            if not isinstance(try_node, ast.Try):
+                continue
+            for handler in try_node.handlers:
+                h_sleep = _calls_sleep(handler)
+                if h_sleep is None:
+                    continue
+                handler_exits = (
+                    any(
+                        isinstance(n, (ast.Return, ast.Raise))
+                        for n in _own_body_walk(handler)
+                    )
+                    or any(b for b in _own_breaks(loop)
+                           if _within(handler, b))
+                )
+                # a bound may also live in the loop body OUTSIDE this try —
+                # the deadline-check-then-raise shape (`if now > deadline:
+                # raise` before the try) is correctly bounded; exits INSIDE
+                # the try body (the success-path `return op()`) don't count,
+                # they are unreachable on the failure path
+                in_try = set(ast.walk(try_node))
+                body_bound = any(
+                    (isinstance(n, ast.Raise) and n not in in_try)
+                    for n in _own_body_walk(loop)
+                ) or any(b not in in_try for b in _own_breaks(loop))
+                if not handler_exits and not body_bound:
+                    yield (
+                        h_sleep.lineno, h_sleep.col_offset,
+                        "retry loop sleeps in an except handler with no "
+                        "bound — a persistent failure retries forever; count "
+                        "attempts or check a deadline and re-raise",
+                    )
+
+
+def _within(container: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(container))

@@ -41,7 +41,15 @@ class TrainingBackend(abc.ABC):
         Replaces ``PyTorchJobDeployer.create_pytorch_job``
         (``PyTorchJobDeployer.py:20-262``): the deployer renders whatever the
         substrate runs (subprocess spec / JobSet manifest) and enqueues it
-        suspended until the scheduler admits it."""
+        suspended until the scheduler admits it.
+
+        Resubmit contract (``resilience/supervisor.py``): a job may be
+        submitted again under the SAME ``job_id``/``artifacts_uri`` after its
+        backend half was deleted.  A backend that can should stage committed
+        checkpoints from ``{artifacts_uri}/checkpoints`` back into the fresh
+        substrate so the trainer's resume path continues the run rather than
+        restarting it (the local backend does; see
+        ``LocalProcessBackend._stage_resume_state``)."""
 
     @abc.abstractmethod
     async def list_jobs(self) -> list[BackendJobReport]:
@@ -80,6 +88,13 @@ class TrainingBackend(abc.ABC):
         """Debug event log for one job (reference: pod events digest,
         ``kube_helpers.py:26-95``). Optional; default empty."""
         return []
+
+    async def inject_fault(self, job_id: str, *, signum: int = 15) -> bool:
+        """Chaos seam (``resilience/faults.py``): deliver a signal to a
+        running job's process, exercising the preemption/recovery paths.
+        Optional; backends without process access report False (not
+        injected)."""
+        return False
 
     async def close(self) -> None:
         """Release resources (subprocesses, watch tasks)."""

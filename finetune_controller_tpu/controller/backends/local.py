@@ -64,6 +64,8 @@ class _JobHandle:
         self.run_task: asyncio.Task | None = None
         self.sync_task: asyncio.Task | None = None
         self.restarts = 0
+        self.exit_code: int | None = None  # last attempt's exit code
+        self.restored_checkpoints = 0  # files staged back from the store
         self.start_time: float | None = None
         self.completion_time: float | None = None
         self.events: list[dict[str, Any]] = []
@@ -86,6 +88,9 @@ class _JobHandle:
 
 class LocalProcessBackend(TrainingBackend):
     """Fake cluster: gang-scheduled subprocesses + artifact sync sidecars."""
+
+    #: SIGTERM → SIGKILL escalation grace in :meth:`delete_job`
+    term_grace_s: float = 5.0
 
     def __init__(
         self,
@@ -136,6 +141,12 @@ class LocalProcessBackend(TrainingBackend):
         try:
             handle.artifacts_dir.mkdir(parents=True, exist_ok=True)
 
+            # resume staging (resilience/supervisor.py resubmit contract):
+            # if a previous attempt committed checkpoints to the object store
+            # and this sandbox has none, pull them back down so the trainer's
+            # resume path continues the run instead of restarting it
+            await self._stage_resume_state(handle)
+
             # init-container equivalent: stage the dataset into the sandbox
             # (reference: aws s3 cp init container, PyTorchJobDeployer.py:70-91)
             dataset_path: str | None = None
@@ -168,6 +179,54 @@ class LocalProcessBackend(TrainingBackend):
             self._handles.pop(job.job_id, None)
             raise BackendError(f"submit failed: {exc}") from exc
         self._admit_pending()
+
+    async def _stage_resume_state(self, handle: _JobHandle) -> None:
+        """Pull committed checkpoints (and the metrics history) back from the
+        object store into a fresh sandbox — the controller half of elastic
+        recovery (SURVEY.md §5.4): a resubmitted job must resume from the
+        latest committed step even when its original sandbox is gone.
+
+        Deliberately skips ``heartbeat.json`` (a stale heartbeat restored
+        into a new attempt could trip the liveness lease) and ``done.txt``
+        (only a SUCCEEDED attempt writes it).  No-op when the sandbox already
+        has checkpoints (local restart — the fast path) or when the store has
+        none (first attempt).
+        """
+        ckpt_dir = handle.artifacts_dir / "checkpoints"
+        if ckpt_dir.is_dir() and any(ckpt_dir.iterdir()):
+            return  # the sandbox survived; the trainer resumes from it as-is
+        try:
+            objs = await self.store.list_prefix(handle.artifacts_uri)
+        except Exception:
+            logger.exception(
+                "resume staging: listing %s failed; job %s starts cold",
+                handle.artifacts_uri, handle.job_id,
+            )
+            return
+        prefix = handle.artifacts_uri.rstrip("/") + "/"
+        n = 0
+        for obj in objs:
+            uri = obj["uri"]
+            if not uri.startswith(prefix):
+                continue
+            rel = uri[len(prefix):]
+            if not (rel.startswith("checkpoints/") or rel == "metrics.csv"):
+                continue
+            dest = handle.artifacts_dir / rel
+            try:
+                await self.store.get_file(uri, dest)
+            except Exception:
+                logger.exception("resume staging: fetch of %s failed", uri)
+                continue
+            # seed the sync sidecar's change detection so the files we just
+            # pulled down are not immediately re-uploaded unchanged
+            st = dest.stat()
+            handle.synced[rel] = (st.st_mtime, st.st_size)
+            n += 1
+        if n:
+            handle.restored_checkpoints = n
+            handle.event("CheckpointsRestored",
+                         f"{n} files <- {handle.artifacts_uri}")
 
     def _runtime_env(self, flavor: DeviceFlavor, num_slices: int) -> dict[str, str]:
         """Runtime env for a job (or warm worker) on a flavor: CPU flavors get
@@ -326,6 +385,7 @@ class LocalProcessBackend(TrainingBackend):
             message = ""
             while True:
                 rc = await self._run_once(handle, attempt)
+                handle.exit_code = rc
                 if handle.cancelled:
                     return
                 if rc == 0:
@@ -470,13 +530,21 @@ class LocalProcessBackend(TrainingBackend):
     # ----------------------------------------------------------- introspection
 
     def _report(self, handle: _JobHandle) -> BackendJobReport:
+        # exit_code rides the report metadata so the monitor persists it and
+        # the retry supervisor can classify the failure (resilience/policy.py)
+        metadata: dict[str, Any] = {
+            "restarts": handle.restarts,
+            "exit_code": handle.exit_code,
+        }
+        if handle.restored_checkpoints:
+            metadata["restored_checkpoints"] = handle.restored_checkpoints
         return BackendJobReport(
             job_id=handle.job_id,
             state=handle.state,
             start_time=handle.start_time,
             completion_time=handle.completion_time,
             message=handle.message,
-            metadata={"restarts": handle.restarts},
+            metadata=metadata,
         )
 
     async def list_jobs(self) -> list[BackendJobReport]:
@@ -496,19 +564,38 @@ class LocalProcessBackend(TrainingBackend):
     # ---------------------------------------------------------------- control
 
     async def delete_job(self, job_id: str) -> bool:
-        """Kill + forget (cluster-delete equivalent; DB record survives)."""
+        """Kill + forget (cluster-delete equivalent; DB record survives).
+
+        Escalates SIGTERM → SIGKILL: a trainer hung hard enough to trip the
+        liveness lease may ignore SIGTERM, and the supervisor resubmits into
+        the SAME sandbox — two writers on one artifacts dir would corrupt
+        the checkpoints the resumed attempt depends on, so the old process
+        must be dead before this returns."""
         handle = self._handles.pop(job_id, None)
         if handle is None:
             return False
         handle.cancelled = True
-        if handle.proc is not None:
+        proc = handle.proc
+        if proc is not None:
             with contextlib.suppress(ProcessLookupError):
-                handle.proc.terminate()
+                proc.terminate()
         for task in (handle.run_task, handle.sync_task):
             if task is not None and not task.done():
                 task.cancel()
                 with contextlib.suppress(asyncio.CancelledError, Exception):
                     await task
+        if proc is not None and proc.returncode is None:
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=self.term_grace_s)
+            except asyncio.TimeoutError:
+                logger.warning(
+                    "job %s ignored SIGTERM for %.1fs; escalating to SIGKILL",
+                    job_id, self.term_grace_s,
+                )
+                with contextlib.suppress(ProcessLookupError):
+                    proc.kill()
+                with contextlib.suppress(Exception):
+                    await proc.wait()
         self.scheduler.release(job_id)
         self._admit_pending()
         return True

@@ -104,6 +104,25 @@ class Settings(BaseModel):
     rate_limit_read_per_min: int = 50
     rate_limit_promote_per_min: int = 2
 
+    # --- Resilience (finetune_controller_tpu/resilience/, docs/resilience.md) ---
+    #: total run attempts per job before a retryable failure becomes terminal
+    #: (0 disables the retry supervisor entirely — reference-parity behavior:
+    #: FAILED jobs stay in place for forensics and nothing is resubmitted)
+    retry_max_attempts: int = 3
+    #: backoff floor/ceiling for the decorrelated-jitter schedule
+    retry_base_delay_s: float = 2.0
+    retry_max_delay_s: float = 60.0
+    #: liveness lease: a RUNNING job whose newest heartbeat is older than
+    #: this is declared stuck, killed, and handed to the supervisor (0 = off).
+    #: Must comfortably exceed artifact_sync_interval_s + the trainer's
+    #: heartbeat_interval_s — the runtime enforces a floor of 3x the sync
+    #: cadence so a slow sync can never masquerade as a dead trainer.
+    #: It must ALSO exceed the worst-case single-step time including the
+    #: first step's XLA compile (minutes on large configs): heartbeats land
+    #: between steps, so a lease tighter than one step phase kills healthy
+    #: jobs mid-compile (docs/resilience.md).
+    liveness_lease_s: float = 300.0
+
     @property
     def state_path(self) -> Path:
         return Path(self.state_dir).expanduser()

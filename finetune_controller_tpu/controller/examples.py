@@ -57,6 +57,14 @@ class LoRASFTArguments(TrainingArguments):
                     "must divide by it) — for batches whose activations "
                     "exceed HBM",
     )
+    log_every: int = Field(
+        10, ge=1, description="Metrics-row cadence (optimizer steps)"
+    )
+    checkpoint_every: int = Field(
+        100, ge=1,
+        description="Checkpoint cadence (optimizer steps) — also the resume "
+                    "granularity after preemption or a supervised retry",
+    )
 
 
 class TinyLlamaLoRA(BaseFineTuneJob):

@@ -12,6 +12,14 @@ Reference warts fixed (SURVEY.md §7 step 3): the backend snapshot is async
 (the reference makes a blocking SDK call inside the loop,
 ``app/core/monitor.py:131``) and DB lookups are batched instead of N+1
 (``app/core/monitor.py:151-158``).
+
+Beyond parity, the tick is also the attachment point for the resilience
+subsystem (``finetune_controller_tpu/resilience/``): FAILED and swept-lost
+jobs are handed to the :class:`~..resilience.supervisor.RetrySupervisor`
+(classify → backoff → resubmit-with-resume), RUNNING jobs are checked
+against their liveness lease (``resilience/heartbeat.py``), and due retries
+are resubmitted — closing the failure loop the reference leaves to an
+operator runbook.
 """
 
 from __future__ import annotations
@@ -22,6 +30,7 @@ import logging
 import time
 from typing import Any
 
+from ..resilience.policy import classify_failure
 from .backends.base import TrainingBackend
 from .objectstore import ObjectStore
 from .schemas import (
@@ -47,14 +56,21 @@ class JobMonitor:
         backend: TrainingBackend,
         *,
         interval_s: float = 2.0,
+        supervisor=None,
+        lease=None,
     ):
         self.state = state
         self.store = store
         self.backend = backend
         self.interval_s = interval_s
+        #: resilience attachments (None = reference-parity behavior: FAILED
+        #: jobs are logged and left in place, no liveness enforcement)
+        self.supervisor = supervisor  # resilience.supervisor.RetrySupervisor
+        self.lease = lease  # resilience.heartbeat.LeaseChecker
         self._task: asyncio.Task | None = None
         self._stop = asyncio.Event()
         self.ticks = 0  # observability: total reconcile passes
+        self.lease_kills = 0  # jobs declared stuck by the liveness lease
 
     # -- lifecycle (reference: core/monitor.py:207-224) ----------------------
 
@@ -92,6 +108,10 @@ class JobMonitor:
         self.ticks += 1
         reports = await self.backend.list_jobs()
         await self._sweep_lost_jobs({r.job_id for r in reports})
+        if self.supervisor is not None:
+            # resubmit retries whose backoff expired — runs even on an empty
+            # snapshot (a RETRYING job has, by design, no backend half)
+            await self.supervisor.tick()
         if not reports:
             return
         pending = await self.backend.queue_snapshot()  # queue order (kueue_helpers.py:19-46)
@@ -108,7 +128,24 @@ class JobMonitor:
                 if job.status is DatabaseStatus.CANCELLED:
                     await self.backend.delete_job(report.job_id)
                 continue
-            await self._update_job_status(job, report, pending)
+            if job.status is DatabaseStatus.RETRYING:
+                # waiting out its backoff: the supervisor owns this job and
+                # already tore the backend half down — a report that lingers
+                # (delete raced/failed) is stale and must not re-enter the
+                # failure path (it would burn an attempt per tick)
+                continue
+            # for a FAILED report the supervisor owns the status transition
+            # (RETRYING or terminal FAILED) — persisting FAILED here first
+            # would open a crash window in which a retryable job is stuck
+            # terminally FAILED with no attempt recorded; persist the timing
+            # fields/metadata under the CURRENT status instead
+            keep_status = (
+                report.state is BackendJobState.FAILED
+                and self.supervisor is not None
+            )
+            await self._update_job_status(
+                job, report, pending, keep_status=keep_status
+            )
             status = map_backend_state(report.state)
             if status in (DatabaseStatus.RUNNING,) or status.is_final:
                 await self._process_job_metrics(job)
@@ -117,26 +154,96 @@ class JobMonitor:
                 # (core/monitor.py:182-186)
                 await self.backend.delete_job(report.job_id)
             elif report.state is BackendJobState.FAILED:
-                # keep for inspection (core/monitor.py:187-191)
-                logger.warning("job %s failed: %s", report.job_id, report.message)
+                await self._handle_failed(job, report)
+            elif report.state is BackendJobState.RUNNING:
+                await self._check_lease(job, report)
+
+    async def _handle_failed(self, job: JobRecord, report: BackendJobReport) -> None:
+        """Failure intake: classify + persist forensics, then either hand the
+        job to the retry supervisor or (reference behavior) leave it FAILED
+        in place for inspection (core/monitor.py:187-191)."""
+        exit_code = report.metadata.get("exit_code")
+        if self.supervisor is not None:
+            await self.supervisor.on_job_failed(
+                job, exit_code=exit_code, message=report.message
+            )
+            return
+        # no supervisor: still persist the failure class so users (and a
+        # later-enabled supervisor) can tell an OOM from bad hyperparameters
+        failure = classify_failure(exit_code, report.message)
+        await self.state.update_job_status(
+            job.job_id,
+            DatabaseStatus.FAILED,
+            metadata={"failure_class": failure.value},
+        )
+        logger.warning(
+            "job %s failed (class=%s): %s",
+            report.job_id, failure.value, report.message,
+        )
+
+    async def _check_lease(self, job: JobRecord, report: BackendJobReport) -> None:
+        """Liveness lease (resilience/heartbeat.py): a RUNNING job whose
+        heartbeat went stale is stuck — kill it and route it through the
+        failure path like any infra failure."""
+        if self.lease is None:
+            return
+        if not await self.lease.expired(job, report):
+            return
+        self.lease_kills += 1
+        message = (
+            f"liveness lease expired: no heartbeat for >{self.lease.lease_s:.0f}s"
+        )
+        logger.warning("job %s declared stuck (%s); killing", job.job_id, message)
+        await self.backend.delete_job(job.job_id)
+        if self.supervisor is not None:
+            await self.supervisor.on_job_failed(job, exit_code=None, message=message)
+        else:
+            await self.state.update_job_status(
+                job.job_id,
+                DatabaseStatus.FAILED,
+                metadata={
+                    "backend_message": message,
+                    "failure_class": classify_failure(None, message).value,
+                },
+                end_time=time.time(),
+                queue_position=None,
+            )
 
     async def _sweep_lost_jobs(self, backend_ids: set[str]) -> None:
-        """Mark non-final DB jobs the backend has forgotten as UNKNOWN.
+        """Mark non-final DB jobs the backend has forgotten as UNKNOWN (or
+        hand them straight to the retry supervisor).
 
         The reference never needed this — its substrate (the cluster) is
         durable. An in-memory backend forgets everything on process restart,
         so without the sweep a QUEUED/RUNNING record would stay live forever.
+        RETRYING jobs are exempt: their backend half was deliberately torn
+        down while they wait out a backoff window.
         """
         for job in await self.state.get_active_jobs():
-            if job.job_id in backend_ids or job.status is DatabaseStatus.UNKNOWN:
+            if job.job_id in backend_ids or job.status in (
+                DatabaseStatus.UNKNOWN, DatabaseStatus.RETRYING,
+            ):
                 continue
             if time.time() - job.submitted_at < self.lost_job_grace_s:
                 continue  # may still be inside the submit path
+            message = "job no longer tracked by the backend"
+            if self.supervisor is not None:
+                # a vanished job is an infra failure (substrate restart, node
+                # loss): hand it straight to the supervisor, which CAS-es
+                # from the CURRENT status to RETRYING/FAILED in one write —
+                # an UNKNOWN stopover would open a crash window in which the
+                # job parks in UNKNOWN forever (the sweep skips UNKNOWN)
+                logger.warning("job %s vanished from backend; supervising",
+                               job.job_id)
+                await self.supervisor.on_job_failed(
+                    job, exit_code=None, message=message
+                )
+                continue
             logger.warning("job %s vanished from backend; marking unknown", job.job_id)
             await self.state.update_job_status(
                 job.job_id,
                 DatabaseStatus.UNKNOWN,
-                metadata={"backend_message": "job no longer tracked by the backend"},
+                metadata={"backend_message": message},
                 queue_position=None,
             )
 
@@ -145,9 +252,15 @@ class JobMonitor:
         job: JobRecord,
         report: BackendJobReport,
         pending: list[str],
+        *,
+        keep_status: bool = False,
     ) -> None:
-        """Map + persist one job's state (reference: ``core/monitor.py:97-122``)."""
-        status = map_backend_state(report.state)
+        """Map + persist one job's state (reference: ``core/monitor.py:97-122``).
+
+        ``keep_status`` persists the fields/metadata but leaves the status
+        untouched — used when a downstream owner (the retry supervisor) will
+        write the real transition atomically."""
+        status = job.status if keep_status else map_backend_state(report.state)
         fields: dict[str, Any] = {}
         if report.start_time is not None:
             fields["start_time"] = report.start_time

@@ -12,6 +12,9 @@ from __future__ import annotations
 import dataclasses
 import logging
 
+from ..resilience.heartbeat import LeaseChecker
+from ..resilience.policy import RetryPolicy
+from ..resilience.supervisor import RetrySupervisor
 from .backends.base import TrainingBackend
 from .backends.local import LocalProcessBackend
 from .config import Settings, get_settings
@@ -91,8 +94,35 @@ def build_runtime(
         backend = K8sJobSetBackend(catalog, settings)
     else:
         raise ValueError(f"unknown backend {settings.backend!r}")
+    # resilience attachments (docs/resilience.md): the retry supervisor
+    # closes the failure loop the reference leaves to operators, the lease
+    # checker catches silently-stuck jobs. Either can be disabled via
+    # settings (reference-parity behavior).
+    supervisor = None
+    if settings.retry_max_attempts > 0:
+        supervisor = RetrySupervisor(
+            state, backend, catalog,
+            policy=RetryPolicy(
+                max_attempts=settings.retry_max_attempts,
+                base_delay_s=settings.retry_base_delay_s,
+                max_delay_s=settings.retry_max_delay_s,
+            ),
+        )
+    lease = None
+    if settings.liveness_lease_s > 0:
+        # floor: heartbeat freshness through the store is bounded by the
+        # artifact sync cadence — a lease tighter than that would kill
+        # healthy jobs between syncs
+        lease = LeaseChecker(
+            store,
+            lease_s=max(
+                settings.liveness_lease_s, 3 * settings.artifact_sync_interval_s
+            ),
+        )
     monitor = JobMonitor(
-        state, store, backend, interval_s=settings.job_monitor_interval_s
+        state, store, backend,
+        interval_s=settings.job_monitor_interval_s,
+        supervisor=supervisor, lease=lease,
     )
     presigner = Presigner(settings.presign_secret, settings.presign_expiry_s)
     return Runtime(

@@ -28,6 +28,10 @@ class DatabaseStatus(str, enum.Enum):
     CREATED = "created"
     RUNNING = "running"
     RESTARTING = "restarting"
+    #: failed, classified retryable, waiting out its backoff before the
+    #: supervisor resubmits it (``resilience/supervisor.py``); deliberately
+    #: NON-final — the job is still the control plane's responsibility
+    RETRYING = "retrying"
     SUCCEEDED = "succeeded"
     FAILED = "failed"
     CANCELLED = "cancelled"

@@ -763,6 +763,35 @@ async def admin_backend_jobs(request: web.Request) -> web.Response:
     )
 
 
+async def admin_resilience(request: web.Request) -> web.Response:
+    """Retry-supervisor + liveness-lease state (docs/resilience.md): the
+    active policy, jobs waiting out a backoff, and lease-kill counters."""
+    rt = request.app[RUNTIME_KEY]
+    _admin(request)
+    supervisor = rt.monitor.supervisor
+    lease = rt.monitor.lease
+    body: dict[str, Any] = {
+        "enabled": supervisor is not None,
+        "lease_enabled": lease is not None,
+        "lease_kills": rt.monitor.lease_kills,
+    }
+    if supervisor is not None:
+        body["policy"] = {
+            "max_attempts": supervisor.policy.max_attempts,
+            "base_delay_s": supervisor.policy.base_delay_s,
+            "max_delay_s": supervisor.policy.max_delay_s,
+        }
+        body["counters"] = {
+            "retries_scheduled": supervisor.retries_scheduled,
+            "resubmits": supervisor.resubmits,
+            "terminal_failures": supervisor.terminal_failures,
+        }
+        body["pending_retries"] = await supervisor.pending_retries()
+    if lease is not None:
+        body["lease_s"] = lease.lease_s
+    return web.json_response(body)
+
+
 # ---------------------------------------------------------------------------
 # Handlers — auth + observability
 # ---------------------------------------------------------------------------
@@ -932,6 +961,7 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
     app.router.add_get(f"{p}/admin/queue", admin_queue)
     app.router.add_get(f"{p}/admin/jobs/{{job_id}}/events", admin_job_events)
     app.router.add_get(f"{p}/admin/backend/jobs", admin_backend_jobs)
+    app.router.add_get(f"{p}/admin/resilience", admin_resilience)
     app.router.add_post(f"{p}/auth/dev-token", mint_dev_token)
     app.router.add_get(f"{p}/openapi.json", openapi_json)
     app.router.add_get("/metrics", prometheus_metrics)

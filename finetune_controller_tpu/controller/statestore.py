@@ -262,13 +262,27 @@ class _SqliteDB:
                 # (re)connect lazily; a forked child must not reuse the
                 # parent's connection (sqlite documents this as corruption)
                 self._path.parent.mkdir(parents=True, exist_ok=True)
-                conn = sqlite3.connect(
-                    self._path, timeout=30.0, check_same_thread=False,
-                    isolation_level=None,  # autocommit; RMW uses BEGIN IMMEDIATE
-                )
-                conn.execute("PRAGMA journal_mode=WAL")
-                conn.execute("PRAGMA synchronous=NORMAL")
-                conn.execute("PRAGMA busy_timeout=30000")
+                # The WAL switch on a brand-new database can report "database
+                # is locked" when sibling worker processes race it at boot
+                # (observed killing a --workers fork under load); it succeeds
+                # on the sibling's heels, so retry the CONNECT PHASE only —
+                # a locked error out of fn() itself propagates as before.
+                for attempt in range(5):
+                    conn = sqlite3.connect(
+                        self._path, timeout=30.0, check_same_thread=False,
+                        isolation_level=None,  # autocommit; RMW uses BEGIN IMMEDIATE
+                    )
+                    try:
+                        conn.execute("PRAGMA journal_mode=WAL")
+                        conn.execute("PRAGMA synchronous=NORMAL")
+                        conn.execute("PRAGMA busy_timeout=30000")
+                    except sqlite3.OperationalError:
+                        conn.close()
+                        if attempt == 4:
+                            raise
+                        time.sleep(0.05 * (2 ** attempt))
+                        continue
+                    break
                 self._conn, self._pid = conn, os.getpid()
             return fn(self._conn)
 
@@ -588,6 +602,12 @@ class StateStore:
         docs = await self.jobs.find(lambda d: d["status"] not in final)
         return [JobRecord(**d) for d in docs]
 
+    async def get_jobs_by_status(self, status: DatabaseStatus) -> list[JobRecord]:
+        """Indexed status lookup — the retry supervisor polls for RETRYING
+        jobs every monitor tick, which must not scan the whole collection."""
+        docs = await self.jobs.find(eq={"status": DatabaseStatus(status).value})
+        return [JobRecord(**d) for d in docs]
+
     async def update_job_status(
         self,
         job_id: str,
@@ -600,6 +620,28 @@ class StateStore:
         ok = await self.jobs.update(
             job_id,
             {"status": DatabaseStatus(status).value, **_jsonify(fields)},
+        )
+        if ok and metadata:
+            await self.jobs.merge_subdoc(job_id, "metadata", _jsonify(metadata))
+        return ok
+
+    async def transition_job_status(
+        self,
+        job_id: str,
+        expect: DatabaseStatus,
+        status: DatabaseStatus,
+        *,
+        metadata: dict[str, Any] | None = None,
+        **fields: Any,
+    ) -> bool:
+        """Compare-and-set status transition: applies only while the job is
+        still in ``expect``.  The retry supervisor's resubmit path needs this
+        — a user cancel landing inside the resubmit's await window must not
+        be silently overwritten back to QUEUED."""
+        ok = await self.jobs.update_if(
+            job_id,
+            {"status": DatabaseStatus(status).value, **_jsonify(fields)},
+            lambda doc: doc.get("status") == DatabaseStatus(expect).value,
         )
         if ok and metadata:
             await self.jobs.merge_subdoc(job_id, "metadata", _jsonify(metadata))

@@ -72,10 +72,24 @@ async def _get_active_jobs(store, p):
     return [_dump(j) for j in await store.get_active_jobs()]
 
 
+@_rpc("get_jobs_by_status")
+async def _get_jobs_by_status(store, p):
+    jobs = await store.get_jobs_by_status(DatabaseStatus(p["status"]))
+    return [_dump(j) for j in jobs]
+
+
 @_rpc("update_job_status")
 async def _update_job_status(store, p):
     return await store.update_job_status(
         p["job_id"], DatabaseStatus(p["status"]),
+        metadata=p.get("metadata"), **(p.get("fields") or {}),
+    )
+
+
+@_rpc("transition_job_status")
+async def _transition_job_status(store, p):
+    return await store.transition_job_status(
+        p["job_id"], DatabaseStatus(p["expect"]), DatabaseStatus(p["status"]),
         metadata=p.get("metadata"), **(p.get("fields") or {}),
     )
 
@@ -308,6 +322,13 @@ class RemoteStateStore:
         docs = await self._call("get_active_jobs", retry_reads=True)
         return [JobRecord(**d) for d in docs]
 
+    async def get_jobs_by_status(self, status) -> list[JobRecord]:
+        docs = await self._call(
+            "get_jobs_by_status", retry_reads=True,
+            status=DatabaseStatus(status).value,
+        )
+        return [JobRecord(**d) for d in docs]
+
     async def update_job_status(
         self,
         job_id: str,
@@ -320,6 +341,22 @@ class RemoteStateStore:
             "update_job_status", job_id=job_id,
             status=DatabaseStatus(status).value, metadata=metadata,
             fields=fields,
+        )
+
+    async def transition_job_status(
+        self,
+        job_id: str,
+        expect,
+        status,
+        *,
+        metadata: dict[str, Any] | None = None,
+        **fields: Any,
+    ) -> bool:
+        return await self._call(
+            "transition_job_status", job_id=job_id,
+            expect=DatabaseStatus(expect).value,
+            status=DatabaseStatus(status).value,
+            metadata=metadata, fields=fields,
         )
 
     async def update_job_promotion(

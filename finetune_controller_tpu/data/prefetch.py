@@ -122,6 +122,10 @@ class PrefetchIterator:
     def __next__(self) -> Any:
         if self._exhausted:
             raise StopIteration
+        if self._stop.is_set():
+            # closed: the producer exited without posting _DONE and the
+            # queue was drained — a blocking get() here would hang forever
+            raise StopIteration
         t0 = time.perf_counter()
         item = self._queue.get()
         self.last_wait_s = time.perf_counter() - t0

@@ -1,0 +1,35 @@
+"""Resilience subsystem: supervised retry, liveness leases, fault injection.
+
+Four parts (see docs/resilience.md):
+
+- :mod:`.policy` — failure classification + capped decorrelated-jitter
+  backoff (stdlib-only, deterministic under a seed);
+- :mod:`.supervisor` — the reconciler that turns FAILED/UNKNOWN/stuck jobs
+  into classified, backoff-scheduled, resume-from-checkpoint resubmissions;
+- :mod:`.heartbeat` — trainer-side heartbeat emission through the artifact
+  channel + the monitor-side lease check that catches silently-stuck jobs;
+- :mod:`.faults` — seeded kill-at-step / store-fault injection driving the
+  chaos tests (tests/test_chaos.py).
+
+This ``__init__`` re-exports only the controller-free pieces: the trainer
+imports :class:`HeartbeatWriter`/:class:`StepFaultInjector` inside pods that
+carry no controller extras.  Import :class:`.supervisor.RetrySupervisor`
+directly from its module (it pulls in controller schemas/registry).
+"""
+
+from .faults import FaultyObjectStore, StepFault, StepFaultInjector
+from .heartbeat import HEARTBEAT_FILENAME, HeartbeatWriter, LeaseChecker
+from .policy import RETRYABLE, FailureClass, RetryPolicy, classify_failure
+
+__all__ = [
+    "FailureClass",
+    "RetryPolicy",
+    "classify_failure",
+    "RETRYABLE",
+    "HeartbeatWriter",
+    "LeaseChecker",
+    "HEARTBEAT_FILENAME",
+    "StepFault",
+    "StepFaultInjector",
+    "FaultyObjectStore",
+]

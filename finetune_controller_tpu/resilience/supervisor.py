@@ -1,0 +1,257 @@
+"""The retry supervisor: closes the controller half of the failure loop.
+
+The trainer half of elastic recovery already exists (save-on-SIGTERM, atomic
+Orbax checkpoints, ``restore_latest``); what was missing is the reconciler
+that USES it: the reference monitor logs a warning on FAILED and walks away
+(``app/core/monitor.py:187-191``), so no job is ever retried.
+
+On a FAILED/UNKNOWN/stuck job the supervisor:
+
+1. **classifies** the failure (``resilience/policy.py``) — infra/preemption
+   is retryable, a deterministic user error is terminal;
+2. **records the attempt** in the state store: the job moves to the new
+   ``RETRYING`` status and its ``metadata.attempt_history`` gains an entry
+   (attempt number, exit code, failure class, backoff delay) — the API
+   serves this with the job document, so users see *why* their job is
+   respawning;
+3. **resubmits with resume**: after the backoff expires, the job is handed
+   back to the backend with its original spec/flavor/dataset/artifacts URIs.
+   The backend stages committed checkpoints back into the fresh substrate
+   (``backends/local.py``), and the trainer's ``resume=True`` path continues
+   from the latest committed step instead of restarting.
+
+Crash-safety: the schedule lives in the job document (``retry_next_at``),
+not in supervisor memory — a restarted control plane re-adopts every
+RETRYING job on its first tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from ..controller import registry
+from ..controller.schemas import DatabaseStatus, JobInput, JobRecord
+from .policy import FailureClass, RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+
+class RetrySupervisor:
+    """Reconciler woven into ``JobMonitor.tick`` (see controller/monitor.py)."""
+
+    def __init__(
+        self,
+        state,
+        backend,
+        catalog,
+        *,
+        policy: RetryPolicy | None = None,
+        _clock=time.time,
+    ):
+        self.state = state
+        self.backend = backend
+        self.catalog = catalog
+        self.policy = policy or RetryPolicy()
+        self._clock = _clock
+        # observability (admin/resilience route)
+        self.retries_scheduled = 0
+        self.resubmits = 0
+        self.terminal_failures = 0
+
+    # -- failure intake -------------------------------------------------------
+
+    async def on_job_failed(
+        self,
+        job: JobRecord,
+        *,
+        exit_code: int | None = None,
+        message: str = "",
+    ) -> bool:
+        """Classify one failed attempt; schedule a retry or record the
+        terminal failure.  Returns True when a retry was scheduled."""
+        failure = self.policy.classify(exit_code, message)
+        history = list(job.metadata.get("attempt_history") or [])
+        attempt = len(history) + 1
+        prev_delay = history[-1].get("delay_s") if history else None
+        entry: dict[str, Any] = {
+            "attempt": attempt,
+            "ended_at": self._clock(),
+            "exit_code": exit_code,
+            "failure_class": failure.value,
+            "message": message,
+        }
+        if not self.policy.should_retry(failure, attempt):
+            entry["delay_s"] = None
+            history.append(entry)
+            # compare-and-set from the status the caller snapshotted: a user
+            # cancel interleaving inside the monitor tick's await windows
+            # must win, not be overwritten by the failure transition
+            ok = await self.state.transition_job_status(
+                job.job_id,
+                job.status,
+                DatabaseStatus.FAILED,
+                metadata={
+                    "attempt_history": history,
+                    "failure_class": failure.value,
+                    "retry_next_at": None,
+                },
+                queue_position=None,
+            )
+            if not ok:
+                logger.warning(
+                    "job %s moved on during failure intake (user cancel?); "
+                    "leaving it be", job.job_id,
+                )
+                return False
+            self.terminal_failures += 1
+            logger.warning(
+                "job %s failed terminally (class=%s attempt=%d/%d): %s",
+                job.job_id, failure.value, attempt,
+                self.policy.max_attempts, message,
+            )
+            return False
+        delay = self.policy.next_delay(prev_delay)
+        entry["delay_s"] = delay
+        history.append(entry)
+        ok = await self.state.transition_job_status(
+            job.job_id,
+            job.status,
+            DatabaseStatus.RETRYING,
+            metadata={
+                "attempt_history": history,
+                "failure_class": failure.value,
+                "retry_next_at": self._clock() + delay,
+            },
+            queue_position=None,
+        )
+        if not ok:
+            logger.warning(
+                "job %s moved on during failure intake (user cancel?); "
+                "not scheduling a retry", job.job_id,
+            )
+            return False
+        self.retries_scheduled += 1
+        # clear the substrate half now so the backoff window starts from a
+        # clean slate (artifacts — including checkpoints — are already in
+        # the object store; the final sync ran before FAILED became visible)
+        try:
+            await self.backend.delete_job(job.job_id)
+        except Exception:
+            logger.exception("substrate cleanup failed for %s", job.job_id)
+        logger.warning(
+            "job %s failed (class=%s, attempt %d/%d): retrying in %.1fs",
+            job.job_id, failure.value, attempt, self.policy.max_attempts, delay,
+        )
+        return True
+
+    # -- resubmission ---------------------------------------------------------
+
+    async def tick(self) -> int:
+        """Resubmit every RETRYING job whose backoff has expired; returns the
+        number resubmitted.  Called from the monitor's reconcile pass."""
+        now = self._clock()
+        n = 0
+        for job in await self.state.get_jobs_by_status(DatabaseStatus.RETRYING):
+            due = job.metadata.get("retry_next_at")
+            # a missing due time means a crash landed between the status
+            # write and the metadata merge — treat as due NOW so the job
+            # self-heals instead of sitting RETRYING forever
+            if due is not None and due > now:
+                continue
+            if await self._resubmit(job):
+                n += 1
+        return n
+
+    async def pending_retries(self) -> list[dict[str, Any]]:
+        """Snapshot for the admin surface: jobs waiting out their backoff."""
+        out = []
+        for job in await self.state.get_jobs_by_status(DatabaseStatus.RETRYING):
+            history = job.metadata.get("attempt_history") or []
+            out.append({
+                "job_id": job.job_id,
+                "attempts": len(history),
+                "failure_class": job.metadata.get("failure_class"),
+                "retry_next_at": job.metadata.get("retry_next_at"),
+            })
+        return out
+
+    async def _resubmit(self, job: JobRecord) -> bool:
+        cls = registry.get_spec(job.model_name)
+        if cls is None:
+            # the model's spec class is gone (unloaded plugin): terminal —
+            # there is nothing to render a submission from
+            await self.state.update_job_status(
+                job.job_id,
+                DatabaseStatus.FAILED,
+                metadata={
+                    "failure_class": FailureClass.USER.value,
+                    "retry_next_at": None,
+                    "backend_message": (
+                        f"model {job.model_name!r} is no longer registered"
+                    ),
+                },
+                queue_position=None,
+            )
+            return False
+        current = await self.state.get_job(job.job_id)
+        if current is None or current.status is not DatabaseStatus.RETRYING:
+            # cancelled (or otherwise moved on) while waiting out the backoff
+            return False
+        try:
+            spec = cls(training_arguments=job.arguments)
+            flavor = self.catalog.get_worker(job.device)
+            await self.backend.submit(
+                JobInput(
+                    job_id=job.job_id,
+                    user_id=job.user_id,
+                    model_name=job.model_name,
+                    device=job.device,
+                    num_slices=job.num_slices,
+                    arguments=job.arguments,
+                ),
+                spec,
+                flavor,
+                dataset_uri=job.dataset_uri,
+                artifacts_uri=job.artifacts_uri,
+            )
+        except Exception as exc:
+            logger.exception("resubmit of %s failed", job.job_id)
+            # a failed resubmit is itself an infra failure: burn an attempt,
+            # back off again (or land terminally once the budget is spent)
+            await self.on_job_failed(
+                job, exit_code=None, message=f"resubmit failed: {exc}"
+            )
+            return False
+        # compare-and-set: a user cancel can land inside submit's await
+        # window, and resurrecting a job the user was told is cancelled
+        # would be a silent override — on a lost race, roll the fresh
+        # backend half back instead
+        ok = await self.state.transition_job_status(
+            job.job_id,
+            DatabaseStatus.RETRYING,
+            DatabaseStatus.QUEUED,
+            metadata={"retry_next_at": None},
+            submitted_at=self._clock(),
+            start_time=None,
+            end_time=None,
+            training_duration=None,
+            queue_position=None,
+        )
+        if not ok:
+            logger.warning(
+                "job %s left RETRYING during resubmit (user cancel?); "
+                "rolling the respawn back", job.job_id,
+            )
+            try:
+                await self.backend.delete_job(job.job_id)
+            except Exception:
+                logger.exception("rollback of %s failed", job.job_id)
+            return False
+        self.resubmits += 1
+        logger.info(
+            "job %s resubmitted (attempt %d)", job.job_id,
+            len(job.metadata.get("attempt_history") or []) + 1,
+        )
+        return True

@@ -21,6 +21,11 @@ import orbax.checkpoint as ocp
 logger = logging.getLogger(__name__)
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+#: uncommitted save staging: ``_save_msgpack`` writes ``step_N.tmp`` then
+#: renames; Orbax stages ``step_N.orbax-checkpoint-tmp-<ts>`` — a SIGKILL
+#: mid-save strands either shape (observed in the chaos tests), and the
+#: strays match the artifact-sync globs, shipping garbage with every sync
+_TMP_RE = re.compile(r"^step_\d+(\.tmp|\.orbax-checkpoint-tmp-.*)$")
 
 
 class CheckpointManager:
@@ -35,9 +40,29 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         self.keep = keep
         os.makedirs(self.directory, exist_ok=True)
+        self._sweep_stale_tmp()
         self._ckptr = ocp.StandardCheckpointer()
         self._pending: threading.Thread | None = None
         self._pending_error: list[BaseException] = []
+
+    def _sweep_stale_tmp(self) -> None:
+        """Remove uncommitted ``step_N.tmp`` staging dirs left by a crash.
+
+        A kill between ``_save_msgpack``'s makedirs and its atomic
+        ``os.replace`` strands the staging dir forever: it is never a
+        committed step (``_committed_steps`` ignores it) but it shadows the
+        path of a FUTURE save of the same step — and it silently leaks disk
+        on every crash.  Init is the safe sweep point: this manager is the
+        directory's single writer and no save is in flight yet.
+        """
+        import shutil
+
+        for name in os.listdir(self.directory):
+            if not _TMP_RE.match(name):
+                continue
+            path = os.path.join(self.directory, name)
+            shutil.rmtree(path, ignore_errors=True)
+            logger.warning("swept stale uncommitted checkpoint staging %s", name)
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")

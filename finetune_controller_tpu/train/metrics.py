@@ -32,11 +32,18 @@ class MetricsWriter:
         filename: str = "metrics.csv",
         append: bool = False,
         extra_fields: tuple[str, ...] = (),
+        resume_step: int | None = None,
     ):
         """``extra_fields`` declares columns that may appear only on LATER
         rows (e.g. eval metrics written on their own cadence): the header is
         pinned at the first write, so anything not present in the first row
-        must be declared up front or it would be silently dropped."""
+        must be declared up front or it would be silently dropped.
+
+        ``resume_step`` (with ``append``) is the step the run resumed FROM:
+        rows past it are dropped before appending.  A crash between a logged
+        row and its checkpoint's commit (SIGKILL mid-save — the chaos tests
+        hit exactly this) makes the resumed run REPLAY those steps; without
+        the truncation each replayed row would appear twice."""
         os.makedirs(artifacts_dir, exist_ok=True)
         self.path = os.path.join(artifacts_dir, filename)
         self._file: IO[str] | None = None
@@ -48,6 +55,25 @@ class MetricsWriter:
                 header = f.readline().strip()
             if header:
                 self._resume_fields = header.split(",")
+                if resume_step is not None and "step" in self._resume_fields:
+                    self._truncate_past(resume_step)
+
+    def _truncate_past(self, resume_step: int) -> None:
+        """Drop rows whose step exceeds the resume point (atomic rewrite)."""
+        with open(self.path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        kept = [
+            r for r in rows
+            if not r.get("step") or float(r["step"]) <= resume_step
+        ]
+        if len(kept) == len(rows):
+            return
+        tmp_path = self.path + ".tmp"
+        with open(tmp_path, "w", newline="") as f:
+            rewriter = csv.DictWriter(f, fieldnames=self._resume_fields)
+            rewriter.writeheader()
+            rewriter.writerows(kept)
+        os.replace(tmp_path, self.path)
 
     def write(self, row: Mapping[str, Any]) -> None:
         row = {"timestamp": round(time.time(), 3), **row}

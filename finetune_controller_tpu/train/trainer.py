@@ -111,6 +111,11 @@ class TrainConfig:
     recompile_budget: int = 0
     #: what to do past the budget: "warn" (log once) or "raise"
     recompile_action: str = "warn"
+    #: liveness heartbeat cadence (``resilience/heartbeat.py``): rank 0
+    #: writes ``heartbeat.json`` (step + wall clock) into the artifacts dir
+    #: at most every N seconds; the artifact sync ships it and the monitor's
+    #: lease check uses it to catch silently-stuck jobs. 0 disables.
+    heartbeat_interval_s: float = 10.0
 
 
 class PreemptionGuard:
@@ -614,6 +619,11 @@ class Trainer:
                 for k, v in metrics.items():
                     sums[k] = sums.get(k, 0.0) + float(v)
                 n += 1
+            hb = getattr(self, "_heartbeat", None)
+            if hb is not None:
+                # liveness through a long eval pass (the per-batch float()
+                # above already synced the device, so this reads step cheaply)
+                hb.beat(int(state.step))
         # target_tokens is a per-batch count — averaging it is meaningless,
         # and only declared columns survive the CSV header
         out = {
@@ -930,6 +940,26 @@ class Trainer:
                 start_step = int(host["step"])
                 logger.info("resumed from checkpoint step %d", start_step)
 
+        # liveness heartbeat (resilience/heartbeat.py): rank 0 proves forward
+        # progress through the artifact channel; the monitor's lease check
+        # kills + requeues a job whose heartbeat goes stale
+        heartbeat = None
+        if self.cfg.heartbeat_interval_s > 0 and jax.process_index() == 0:
+            from ..resilience.heartbeat import HeartbeatWriter
+
+            heartbeat = HeartbeatWriter(
+                artifacts_dir, interval_s=self.cfg.heartbeat_interval_s
+            )
+            heartbeat.beat(start_step, force=True)
+        # evaluate() beats through this handle — an eval pass over many
+        # batches must not look like a stall to the liveness lease
+        self._heartbeat = heartbeat
+        # chaos hook (resilience/faults.py): a seeded kill-at-step armed via
+        # FTC_FAULT_* env vars — None outside fault-injection runs
+        from ..resilience.faults import StepFaultInjector
+
+        fault = StepFaultInjector.from_env()
+
         eval_it: Iterator[dict] | None = (
             iter(eval_batches) if eval_batches is not None else None
         )
@@ -946,6 +976,10 @@ class Trainer:
                 ("eval_loss", "eval_accuracy", "eval_input_ms")
                 if eval_it is not None else ()
             ),
+            # a crash AFTER a logged row but BEFORE its checkpoint committed
+            # makes this run replay those steps — drop their rows so the
+            # replay doesn't duplicate them
+            resume_step=start_step,
         )
         it: Iterator[dict] = iter(batches)
         # Fast-forward past already-consumed batches so a resumed run sees the
@@ -1013,6 +1047,11 @@ class Trainer:
                 window_steps += 1
                 state, metrics = self.step(state, batch)
                 window_tokens += tokens_per_batch
+                if heartbeat is not None:
+                    heartbeat.beat(step_idx + 1)
+                if fault is not None:
+                    # after the step so a SIGTERM's save reflects real progress
+                    fault.maybe_fire(step_idx + 1)
                 if profiling and step_idx + 1 >= prof_last:
                     jax.block_until_ready(state)
                     jax.profiler.stop_trace()
@@ -1091,6 +1130,7 @@ class Trainer:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
                     raise SystemExit(143)
         finally:
+            self._heartbeat = None  # evaluate() outside fit must not beat
             # stop the prefetch producers FIRST: a producer mid-build must
             # not keep decoding images while teardown waits on checkpoints
             for p in prefetch_its:

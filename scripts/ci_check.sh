@@ -1,12 +1,19 @@
 #!/usr/bin/env bash
-# The one-command CI gate: static analysis, then the tier-1 test suite.
+# The one-command CI gate: static analysis, the fast chaos suite, then the
+# tier-1 test suite.
 #
-#   scripts/ci_check.sh            # lint + tests
+#   scripts/ci_check.sh            # lint + chaos-fast + tests
 #   scripts/ci_check.sh --lint-only
 #
 # Lint: `ftc-lint finetune_controller_tpu/` must exit 0 — every finding is
 # fixed or carries a justified `# ftc: ignore[rule-id] -- reason`
-# (docs/static_analysis.md). Tests: the tier-1 command from ROADMAP.md.
+# (docs/static_analysis.md).
+# Chaos-fast: the resilience/fault-injection suite (docs/resilience.md)
+# runs first and alone — a broken recovery path should fail in seconds,
+# before the full tier-1 wall-clock is spent.  The full kill→resume loss-
+# trajectory proof is marked `slow` and excluded here (run it with
+# `pytest tests/test_chaos.py -m slow`).
+# Tests: the tier-1 command from ROADMAP.md.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -21,6 +28,16 @@ fi
 
 if [ "${1:-}" = "--lint-only" ]; then
     exit 0
+fi
+
+echo "== chaos-fast (resilience) ==" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_resilience.py tests/test_chaos.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+chaos_rc=$?
+if [ "$chaos_rc" -ne 0 ]; then
+    echo "ci_check: chaos-fast failed (exit $chaos_rc)" >&2
+    exit "$chaos_rc"
 fi
 
 echo "== tier-1 tests ==" >&2

@@ -425,6 +425,33 @@ def test_default_jwt_secret_refused_outside_local(tmp_path):
     build_app(rt2)
 
 
+def test_admin_resilience_route_reports_policy_and_pending(tmp_path):
+    async def main():
+        from finetune_controller_tpu.resilience.heartbeat import LeaseChecker
+        from finetune_controller_tpu.resilience.policy import RetryPolicy
+        from finetune_controller_tpu.resilience.supervisor import RetrySupervisor
+
+        rt = _runtime(tmp_path)
+        rt.monitor.supervisor = RetrySupervisor(
+            rt.state, rt.backend, rt.catalog,
+            policy=RetryPolicy(max_attempts=4, base_delay_s=1.0,
+                               max_delay_s=9.0, seed=0),
+        )
+        rt.monitor.lease = LeaseChecker(rt.store, lease_s=123.0)
+        client = await _client(rt, with_monitor=False)
+        body = await (await client.get("/api/v1/admin/resilience")).json()
+        assert body["enabled"] is True and body["lease_enabled"] is True
+        assert body["policy"] == {
+            "max_attempts": 4, "base_delay_s": 1.0, "max_delay_s": 9.0,
+        }
+        assert body["pending_retries"] == []
+        assert body["lease_s"] == 123.0
+        assert body["counters"]["resubmits"] == 0
+        await client.close()
+
+    run_async(main())
+
+
 def test_api_job_isolation_between_users(tmp_path):
     async def main():
         rt = _runtime(tmp_path, auth_enabled=True)
@@ -448,6 +475,14 @@ def test_api_job_isolation_between_users(tmp_path):
         assert (await client.get("/api/v1/admin/jobs", headers=ha)).status == 403
         r = await client.get("/api/v1/admin/jobs", headers=hadm)
         assert r.status == 200 and (await r.json())["total"] == 1
+        # resilience surface (docs/resilience.md): admin-only, and this
+        # runtime wires no supervisor/lease -> reports disabled
+        assert (await client.get("/api/v1/admin/resilience",
+                                 headers=ha)).status == 403
+        r = await client.get("/api/v1/admin/resilience", headers=hadm)
+        assert r.status == 200
+        body = await r.json()
+        assert body["enabled"] is False and body["lease_enabled"] is False
         await client.close()
 
     run_async(main())

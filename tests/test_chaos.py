@@ -1,0 +1,284 @@
+"""End-to-end chaos tests: deterministic fault injection against the REAL
+local backend + monitor + retry supervisor (docs/resilience.md).
+
+The acceptance loop the reference never had (SURVEY.md §5.4): a job killed
+mid-training is automatically classified, requeued with backoff, and its
+respawned attempt RESUMES from the latest committed checkpoint; a
+deterministic user error is NOT retried and lands FAILED with its failure
+class in metadata.
+
+Fast tests here run in CI's chaos-fast stage (scripts/ci_check.sh) and in
+tier-1; the full kill→resume loss-trajectory identity proof and the
+SIGKILL (crash-without-save) variant are marked ``slow``:
+
+    pytest tests/test_chaos.py -m slow
+"""
+
+import asyncio
+import csv
+import signal
+import time
+
+import pytest
+
+from conftest import one_chip_catalog
+from conftest import run_async as run
+
+from finetune_controller_tpu.controller import registry
+from finetune_controller_tpu.controller.backends.local import LocalProcessBackend
+from finetune_controller_tpu.controller.examples import LoRASFTArguments, TinyTestLoRA
+from finetune_controller_tpu.controller.monitor import JobMonitor
+from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+from finetune_controller_tpu.controller.schemas import DatabaseStatus, JobInput
+from finetune_controller_tpu.controller.statestore import StateStore
+from finetune_controller_tpu.controller.task_builder import DatasetInput, task_builder
+from finetune_controller_tpu.resilience import StepFault
+from finetune_controller_tpu.resilience.policy import RetryPolicy
+from finetune_controller_tpu.resilience.supervisor import RetrySupervisor
+
+
+def _arguments(total_steps=60, cadence=10):
+    return LoRASFTArguments(
+        total_steps=total_steps, warmup_steps=1, batch_size=2, seq_len=16,
+        lora_rank=2, log_every=cadence, checkpoint_every=cadence,
+    )
+
+
+def _plane(tmp_path, *, fault: StepFault | None = None, subdir="plane"):
+    """Real control plane with the backend's own restart budget ZEROED so
+    recovery must flow through the supervisor (the controller half under
+    test), and a fast seeded backoff."""
+    registry.reset()
+    registry.load_builtin_models()  # the supervisor rebuilds specs from here
+    root = tmp_path / subdir
+    state = StateStore(root / "state")
+    store = LocalObjectStore(root / "objects")
+    catalog = one_chip_catalog()
+    backend = LocalProcessBackend(
+        root / "sandboxes", store, catalog,
+        sync_interval_s=0.2, backoff_limit=0,
+        extra_env=fault.to_env() if fault else None,
+    )
+    supervisor = RetrySupervisor(
+        state, backend, catalog,
+        policy=RetryPolicy(
+            max_attempts=3, base_delay_s=0.2, max_delay_s=0.5, seed=0
+        ),
+    )
+    monitor = JobMonitor(state, store, backend, interval_s=0.1,
+                         supervisor=supervisor)
+    return state, store, catalog, backend, supervisor, monitor
+
+
+async def _submit(state, store, backend, catalog, arguments, job_id):
+    spec = TinyTestLoRA(training_arguments=arguments)
+    await task_builder(
+        JobInput(job_id=job_id, user_id="u", model_name="tiny-test-lora",
+                 device="chip-1", arguments=arguments.model_dump()),
+        spec, DatasetInput(),
+        state=state, store=store, backend=backend, catalog=catalog,
+        datasets_bucket="datasets", artifacts_bucket="artifacts",
+    )
+
+
+async def _drive_to_final(state, monitor, job_id, timeout_s=300):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        await monitor.tick()
+        rec = await state.get_job(job_id)
+        if rec.status.is_final:
+            return rec
+        assert time.monotonic() < deadline, (rec.status, rec.metadata)
+        await asyncio.sleep(0.1)
+
+
+def _metric_steps(sandbox_artifacts, column="step"):
+    with open(sandbox_artifacts / "metrics.csv", newline="") as f:
+        rows = list(csv.DictReader(f))
+    return [int(float(r[column])) for r in rows], rows
+
+
+def test_chaos_kill_mid_training_requeues_with_backoff_and_resumes(tmp_path):
+    """The headline loop: SIGTERM at step 25 (spot-reclaim shape) → backend
+    reports FAILED (restart budget 0) → supervisor classifies `preemption`,
+    schedules a backoff, resubmits → respawned attempt RESUMES from the
+    committed checkpoint and finishes SUCCEEDED with step-continuous
+    metrics."""
+
+    async def main():
+        total, cadence = 60, 10
+        fault = StepFault(
+            kill_at_step=25, signum=signal.SIGTERM,
+            once_file=str(tmp_path / "fault_fired"),
+        )
+        state, store, catalog, backend, sup, monitor = _plane(
+            tmp_path, fault=fault
+        )
+        await state.connect()
+        await _submit(state, store, backend, catalog,
+                      _arguments(total, cadence), "chaos-1")
+        handle = backend._handles["chaos-1"]
+        rec = await _drive_to_final(state, monitor, "chaos-1")
+
+        assert rec.status is DatabaseStatus.SUCCEEDED, rec.metadata
+        # exactly one injected failure, classified as preemption (exit 143)
+        history = rec.metadata["attempt_history"]
+        assert len(history) == 1, history
+        assert history[0]["failure_class"] == "preemption"
+        assert history[0]["exit_code"] == 143
+        assert history[0]["delay_s"] >= 0.2  # the backoff actually applied
+        assert sup.retries_scheduled == 1 and sup.resubmits == 1
+        assert (tmp_path / "fault_fired").exists()
+
+        # resume proof: the respawned attempt continued, not restarted
+        log_text = (handle.sandbox / "logs.txt").read_text()
+        assert "resumed from checkpoint step" in log_text
+        steps, _ = _metric_steps(handle.artifacts_dir)
+        assert steps == sorted(set(steps)), "duplicate/out-of-order rows"
+        assert steps[-1] == total
+        assert steps == list(range(cadence, total + 1, cadence))
+
+        # artifacts + liveness heartbeat shipped to the store
+        assert await store.exists(rec.artifacts_uri + "/done.txt")
+        assert await store.exists(rec.artifacts_uri + "/heartbeat.json")
+        await backend.close()
+        await state.close()
+
+    run(main())
+
+
+def test_chaos_user_error_is_terminal_with_failure_class(tmp_path):
+    """A deterministic user error (batch_size not divisible by
+    grad_accum_steps — the trainer constructor raises) must NOT be retried:
+    one attempt, FAILED, ``failure_class: user`` in metadata."""
+
+    async def main():
+        args = LoRASFTArguments(
+            total_steps=5, warmup_steps=1, batch_size=3, seq_len=16,
+            lora_rank=2, grad_accum_steps=2,  # 3 % 2 != 0 -> ValueError
+        )
+        state, store, catalog, backend, sup, monitor = _plane(tmp_path)
+        await state.connect()
+        await _submit(state, store, backend, catalog, args, "chaos-user-1")
+        rec = await _drive_to_final(state, monitor, "chaos-user-1",
+                                    timeout_s=180)
+        assert rec.status is DatabaseStatus.FAILED
+        assert rec.metadata["failure_class"] == "user"
+        history = rec.metadata["attempt_history"]
+        assert len(history) == 1
+        assert history[0]["exit_code"] == 1
+        assert history[0]["delay_s"] is None  # terminal: no backoff scheduled
+        assert sup.resubmits == 0 and sup.terminal_failures == 1
+        # stays terminal on further reconcile passes
+        await monitor.tick()
+        rec = await state.get_job("chaos-user-1")
+        assert rec.status is DatabaseStatus.FAILED
+        assert len(rec.metadata["attempt_history"]) == 1
+        await backend.close()
+        await state.close()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_resumes_from_last_committed_checkpoint(tmp_path):
+    """SIGKILL (exit −9, no chance to save): classified `infra`, and the
+    respawn resumes from the last checkpoint COMMITTED BEFORE the kill —
+    the crash-without-save path."""
+
+    async def main():
+        total, cadence = 60, 10
+        fault = StepFault(
+            kill_at_step=25, signum=signal.SIGKILL,
+            once_file=str(tmp_path / "fault_fired"),
+        )
+        state, store, catalog, backend, sup, monitor = _plane(
+            tmp_path, fault=fault
+        )
+        await state.connect()
+        await _submit(state, store, backend, catalog,
+                      _arguments(total, cadence), "chaos-kill-1")
+        handle = backend._handles["chaos-kill-1"]
+        rec = await _drive_to_final(state, monitor, "chaos-kill-1")
+
+        assert rec.status is DatabaseStatus.SUCCEEDED, rec.metadata
+        history = rec.metadata["attempt_history"]
+        assert len(history) == 1
+        assert history[0]["failure_class"] == "infra"
+        assert history[0]["exit_code"] == -9
+        log_text = (handle.sandbox / "logs.txt").read_text()
+        # killed at 25: the newest committed checkpoint is 20 — or 10 when
+        # the SIGKILL also caught step 20's ASYNC save mid-commit (the
+        # kill-without-save path this test exists to cover)
+        import re
+
+        m = re.search(r"resumed from checkpoint step (\d+)", log_text)
+        assert m, "respawned attempt did not resume"
+        assert int(m.group(1)) in (10, 20), m.group(0)
+        # replayed rows are truncated on resume: no duplicates, full coverage
+        steps, _ = _metric_steps(handle.artifacts_dir)
+        assert steps == list(range(cadence, total + 1, cadence))
+        # a SIGKILL mid-save strands an orbax tmp dir; the respawn sweeps it
+        strays = [
+            p.name for p in (handle.artifacts_dir / "checkpoints").iterdir()
+            if ".tmp" in p.name or "orbax-checkpoint-tmp" in p.name
+        ]
+        assert strays == [], strays
+        await backend.close()
+        await state.close()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_chaos_resumed_loss_trajectory_matches_uninterrupted_run(tmp_path):
+    """The full acceptance proof: after a mid-training kill + supervised
+    requeue, the resumed run's metrics rows (loss AND accuracy, every
+    logged step) are IDENTICAL to an uninterrupted twin run with the same
+    seed — resume loses nothing and replays nothing."""
+
+    async def main():
+        total, cadence = 60, 10
+        args = _arguments(total, cadence)
+
+        # leg A: killed at step 25, recovered by the supervisor
+        fault = StepFault(
+            kill_at_step=25, signum=signal.SIGTERM,
+            once_file=str(tmp_path / "fault_fired"),
+        )
+        state_a, store_a, cat_a, backend_a, _, monitor_a = _plane(
+            tmp_path, fault=fault, subdir="plane_a"
+        )
+        await state_a.connect()
+        await _submit(state_a, store_a, backend_a, cat_a, args, "traj-a")
+        handle_a = backend_a._handles["traj-a"]
+        rec_a = await _drive_to_final(state_a, monitor_a, "traj-a")
+        assert rec_a.status is DatabaseStatus.SUCCEEDED, rec_a.metadata
+        assert len(rec_a.metadata["attempt_history"]) == 1
+
+        # leg B: uninterrupted twin (separate plane, same spec + seed)
+        state_b, store_b, cat_b, backend_b, _, monitor_b = _plane(
+            tmp_path, subdir="plane_b"
+        )
+        await state_b.connect()
+        await _submit(state_b, store_b, backend_b, cat_b, args, "traj-b")
+        handle_b = backend_b._handles["traj-b"]
+        rec_b = await _drive_to_final(state_b, monitor_b, "traj-b")
+        assert rec_b.status is DatabaseStatus.SUCCEEDED, rec_b.metadata
+        assert rec_b.metadata.get("attempt_history") in (None, [])
+
+        steps_a, rows_a = _metric_steps(handle_a.artifacts_dir)
+        steps_b, rows_b = _metric_steps(handle_b.artifacts_dir)
+        assert steps_a == steps_b == list(range(cadence, total + 1, cadence))
+        for row_a, row_b in zip(rows_a, rows_b):
+            for col in ("loss", "accuracy"):
+                assert float(row_a[col]) == float(row_b[col]), (
+                    f"step {row_a['step']}: {col} diverged after resume "
+                    f"({row_a[col]} != {row_b[col]})"
+                )
+        await backend_a.close()
+        await backend_b.close()
+        await state_a.close()
+        await state_b.close()
+
+    run(main())

@@ -440,6 +440,123 @@ def test_blocking_io_suppression_honored():
 
 
 # ---------------------------------------------------------------------------
+# unbounded-retry
+# ---------------------------------------------------------------------------
+
+
+def test_unbounded_retry_fires_on_exitless_sleep_loop():
+    src = """
+        import time
+
+        def poll_forever():
+            while True:
+                check()
+                time.sleep(5)
+    """
+    found = _active(src, "unbounded-retry")
+    assert len(found) == 1
+    assert "no break/return/raise" in found[0].message
+
+
+def test_unbounded_retry_fires_on_unbounded_except_sleep():
+    src = """
+        import time
+
+        def fetch(url):
+            while True:
+                try:
+                    return request(url)
+                except Exception:
+                    log_failure()
+                    time.sleep(1)
+    """
+    found = _active(src, "unbounded-retry")
+    assert len(found) == 1
+    assert "except handler" in found[0].message
+
+
+def test_unbounded_retry_quiet_on_bounded_and_conditioned_loops():
+    src = """
+        import asyncio
+        import time
+
+        def bounded(url):
+            # for-range with a final raise: the house pattern
+            for attempt in range(5):
+                try:
+                    return request(url)
+                except Exception:
+                    time.sleep(1)
+            raise RuntimeError("exhausted")
+
+        def counted(url):
+            attempt = 0
+            while True:
+                try:
+                    return request(url)
+                except Exception:
+                    attempt += 1
+                    if attempt >= 5:
+                        raise
+                    time.sleep(1)
+
+        async def daemon(self):
+            # condition-tested loop (reconciler shape): not while-True
+            while not self.stop.is_set():
+                await self.tick()
+                await asyncio.sleep(2)
+
+        def tail(f):
+            # while True WITH an exit and no except-sleep: fine
+            while True:
+                line = f.readline()
+                if not line:
+                    return
+                time.sleep(0.1)
+
+        def deadline_bounded(url):
+            # the bound lives in the loop body, outside the try: still bounded
+            deadline = time.monotonic() + 60
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("gave up")
+                try:
+                    return request(url)
+                except Exception:
+                    time.sleep(1)
+    """
+    assert _active(src, "unbounded-retry") == []
+
+
+def test_unbounded_retry_ignores_nested_def_return():
+    # a return inside a nested def does NOT exit the outer loop
+    src = """
+        import time
+
+        def outer():
+            while True:
+                def cb():
+                    return 1
+                time.sleep(5)
+    """
+    found = _active(src, "unbounded-retry")
+    assert len(found) == 1
+
+
+def test_unbounded_retry_suppression_honored():
+    src = """
+        import time
+
+        def daemon():
+            while True:  # ftc: ignore[unbounded-retry] -- intentional forever daemon
+                work()
+                time.sleep(5)
+    """
+    findings = _lint(src, "unbounded-retry")
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
 # engine behavior
 # ---------------------------------------------------------------------------
 

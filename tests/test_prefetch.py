@@ -87,6 +87,16 @@ def test_close_unblocks_producer_stuck_on_full_queue():
     it.close()  # idempotent
 
 
+def test_next_after_close_raises_instead_of_hanging():
+    """close() drains the queue and the producer exits without posting the
+    done sentinel — a later next() must StopIteration, not block forever."""
+    it = PrefetchIterator(iter(range(1000)), depth=1)
+    next(it)
+    it.close()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
 def test_transfer_stage_runs_on_producer_thread():
     seen_threads = []
 

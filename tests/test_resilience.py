@@ -1,0 +1,902 @@
+"""Unit + integration tests for the resilience subsystem
+(``finetune_controller_tpu/resilience/`` — docs/resilience.md).
+
+Layers covered here (the end-to-end chaos runs live in tests/test_chaos.py):
+
+* policy: exit classification, the attempt budget, decorrelated-jitter
+  backoff bounds and seeded determinism;
+* supervisor: schedule-on-failure, terminal user errors, attempt
+  exhaustion, due-time resubmission, crash-safe re-adoption;
+* monitor integration: FAILED routing, lost-job hand-off, the lease kill,
+  plus the previously-untested ``_sweep_lost_jobs`` grace window and
+  CANCELLED-cleanup paths;
+* heartbeat: writer throttle/atomicity, lease-expiry decision table;
+* faults: seeded store-fault determinism, kill-at-step once-file
+  semantics;
+* checkpoint hygiene: the ``step_N.tmp`` sweep regression test.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from conftest import one_chip_catalog as _catalog
+from conftest import run_async as run
+from conftest import tiny_job_spec as _spec
+from test_lifecycle import ScriptedBackend
+
+from finetune_controller_tpu.controller import registry
+from finetune_controller_tpu.controller.monitor import JobMonitor
+from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+from finetune_controller_tpu.controller.schemas import (
+    BackendJobReport,
+    BackendJobState,
+    DatabaseStatus,
+    JobInput,
+    JobRecord,
+)
+from finetune_controller_tpu.controller.statestore import StateStore
+from finetune_controller_tpu.controller.task_builder import DatasetInput, task_builder
+from finetune_controller_tpu.resilience import (
+    FailureClass,
+    HeartbeatWriter,
+    LeaseChecker,
+    RetryPolicy,
+    StepFault,
+    StepFaultInjector,
+    classify_failure,
+)
+from finetune_controller_tpu.resilience.faults import (
+    FaultInjectionError,
+    FaultyObjectStore,
+)
+from finetune_controller_tpu.resilience.heartbeat import (
+    HEARTBEAT_FILENAME,
+    parse_heartbeat,
+)
+from finetune_controller_tpu.resilience.supervisor import RetrySupervisor
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+def test_classify_failure_table():
+    assert classify_failure(143) is FailureClass.PREEMPTION
+    assert classify_failure(-15) is FailureClass.PREEMPTION
+    assert classify_failure(137) is FailureClass.INFRA
+    assert classify_failure(-9) is FailureClass.INFRA
+    assert classify_failure(1) is FailureClass.USER
+    assert classify_failure(2, "traceback follows") is FailureClass.USER
+    # >128 is some other fatal signal: infrastructure, not the user's code
+    assert classify_failure(139) is FailureClass.INFRA
+    # message hints when the backend has no exit code
+    assert classify_failure(None, "liveness lease expired") is FailureClass.INFRA
+    assert classify_failure(None, "job no longer tracked by the backend") \
+        is FailureClass.INFRA
+    assert classify_failure(None, "resubmit failed: quota") is FailureClass.INFRA
+    assert classify_failure(None, "") is FailureClass.UNKNOWN
+
+
+def test_retry_policy_budget_and_terminal_classes():
+    p = RetryPolicy(max_attempts=3, seed=0)
+    assert p.should_retry(FailureClass.PREEMPTION, 1)
+    assert p.should_retry(FailureClass.INFRA, 2)
+    assert not p.should_retry(FailureClass.INFRA, 3)  # 3rd attempt was the last
+    assert not p.should_retry(FailureClass.USER, 1)   # deterministic: terminal
+
+
+def test_backoff_decorrelated_jitter_bounds_and_determinism():
+    p = RetryPolicy(base_delay_s=1.0, max_delay_s=10.0, seed=42)
+    delays = []
+    prev = None
+    for _ in range(50):
+        d = p.next_delay(prev)
+        hi = max(1.0, min(10.0, 3.0 * (prev or 1.0)))
+        assert 1.0 <= d <= hi
+        delays.append(d)
+        prev = d
+    assert all(d <= 10.0 for d in delays)  # cap holds even after growth
+    # same seed, same schedule — the chaos harness depends on this
+    p2 = RetryPolicy(base_delay_s=1.0, max_delay_s=10.0, seed=42)
+    replay = []
+    prev = None
+    for _ in range(50):
+        prev = p2.next_delay(prev)
+        replay.append(prev)
+    assert replay == delays
+
+
+# ---------------------------------------------------------------------------
+# checkpoint startup hygiene (satellite: stale step_N.tmp sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_manager_sweeps_stale_tmp_dirs(tmp_path):
+    from finetune_controller_tpu.train.checkpoint import CheckpointManager
+
+    d = tmp_path / "ckpts"
+    mgr = CheckpointManager(str(d), keep=3)
+    mgr.save(1, {"w": [1.0, 2.0]}, blocking=True)
+    # simulate a crash between makedirs(tmp) and os.replace in _save_msgpack
+    stale = d / "step_9.tmp"
+    stale.mkdir()
+    (stale / "state.msgpack").write_bytes(b"partial")
+    # ...and a SIGKILL mid-Orbax-save (observed shape in the chaos tests)
+    stale_orbax = d / "step_7.orbax-checkpoint-tmp-1234567"
+    stale_orbax.mkdir()
+    mgr2 = CheckpointManager(str(d), keep=3)
+    assert not stale.exists(), "stale .tmp staging dir must be swept on init"
+    assert not stale_orbax.exists(), "stale orbax staging dir must be swept"
+    assert mgr2.latest_step() == 1  # committed steps untouched
+    # a future save of the swept step is not shadowed
+    mgr2.save(9, {"w": [3.0, 4.0]}, blocking=True)
+    assert mgr2.latest_step() == 9
+
+
+def test_metrics_writer_truncates_replayed_rows_on_resume(tmp_path):
+    """A crash after a logged row but before its checkpoint committed makes
+    the resumed run replay those steps — the writer must drop the orphaned
+    rows instead of duplicating them."""
+    from finetune_controller_tpu.train.metrics import MetricsWriter
+
+    w = MetricsWriter(str(tmp_path))
+    for s in (10, 20, 30):
+        w.write({"step": s, "loss": 1.0 / s})
+    w.close()
+    # resumed from the step-10 checkpoint: rows 20/30 were never committed
+    w2 = MetricsWriter(str(tmp_path), append=True, resume_step=10)
+    w2.write({"step": 20, "loss": 0.05})
+    w2.close()
+    with open(tmp_path / "metrics.csv", newline="") as f:
+        import csv as _csv
+
+        rows = list(_csv.DictReader(f))
+    assert [int(float(r["step"])) for r in rows] == [10, 20]
+    assert float(rows[1]["loss"]) == 0.05  # the replayed value, once
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+
+async def _plane(tmp_path, *, clock, max_attempts=3):
+    registry.reset()
+    registry.load_builtin_models()
+    state = StateStore(tmp_path / "state")
+    store = LocalObjectStore(tmp_path / "objects")
+    backend = ScriptedBackend()
+    catalog = _catalog()
+    supervisor = RetrySupervisor(
+        state, backend, catalog,
+        policy=RetryPolicy(
+            max_attempts=max_attempts, base_delay_s=5.0, max_delay_s=5.0, seed=0,
+        ),
+        _clock=clock,
+    )
+    await state.connect()
+    return state, store, backend, catalog, supervisor
+
+
+async def _submit(state, store, backend, catalog, job_id="r-1"):
+    spec = _spec()
+    job = JobInput(
+        job_id=job_id, user_id="u", model_name="tiny-test-lora",
+        device="chip-1", arguments=spec.training_arguments.model_dump(),
+    )
+    await task_builder(
+        job, spec, DatasetInput(),
+        state=state, store=store, backend=backend, catalog=catalog,
+        datasets_bucket="datasets", artifacts_bucket="artifacts",
+    )
+    return job
+
+
+def test_supervisor_schedules_retry_then_resubmits_when_due(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        await _submit(state, store, backend, catalog)
+
+        job = await state.get_job("r-1")
+        retried = await sup.on_job_failed(job, exit_code=137, message="exit code 137")
+        assert retried
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.RETRYING
+        history = rec.metadata["attempt_history"]
+        assert len(history) == 1
+        assert history[0]["failure_class"] == "infra"
+        assert history[0]["exit_code"] == 137
+        assert rec.metadata["retry_next_at"] == pytest.approx(
+            clock.t + history[0]["delay_s"]
+        )
+        assert "r-1" in backend.deleted  # substrate half cleared immediately
+
+        # before the backoff expires nothing happens
+        assert await sup.tick() == 0
+        assert (await state.get_job("r-1")).status is DatabaseStatus.RETRYING
+
+        clock.advance(history[0]["delay_s"] + 0.1)
+        assert await sup.tick() == 1
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.QUEUED
+        assert rec.metadata["retry_next_at"] is None
+        assert rec.start_time is None and rec.end_time is None
+        assert rec.submitted_at == clock.t  # grace window restarted
+        assert "r-1" in backend.reports  # really resubmitted to the backend
+        assert sup.resubmits == 1
+
+    run(main())
+
+
+def test_supervisor_user_error_is_terminal(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        await _submit(state, store, backend, catalog)
+        job = await state.get_job("r-1")
+        retried = await sup.on_job_failed(
+            job, exit_code=1, message="exit code 1 after 1 attempts"
+        )
+        assert not retried
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.FAILED
+        assert rec.metadata["failure_class"] == "user"
+        assert rec.metadata["attempt_history"][0]["delay_s"] is None
+        clock.advance(1e6)
+        assert await sup.tick() == 0  # nothing to resubmit, ever
+        assert sup.terminal_failures == 1
+
+    run(main())
+
+
+def test_supervisor_exhausts_attempt_budget(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(
+            tmp_path, clock=clock, max_attempts=2
+        )
+        await _submit(state, store, backend, catalog)
+        job = await state.get_job("r-1")
+        assert await sup.on_job_failed(job, exit_code=143, message="preempted")
+        clock.advance(10)
+        assert await sup.tick() == 1
+        job = await state.get_job("r-1")
+        assert job.status is DatabaseStatus.QUEUED
+        # second (and per the budget: last) attempt dies too
+        assert not await sup.on_job_failed(job, exit_code=143, message="preempted")
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.FAILED
+        assert len(rec.metadata["attempt_history"]) == 2
+        assert rec.metadata["failure_class"] == "preemption"
+
+    run(main())
+
+
+def test_supervisor_failed_resubmit_burns_an_attempt(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(
+            tmp_path, clock=clock, max_attempts=2
+        )
+        await _submit(state, store, backend, catalog)
+
+        async def exploding_submit(*a, **k):
+            raise RuntimeError("no quota")
+
+        job = await state.get_job("r-1")
+        await sup.on_job_failed(job, exit_code=137, message="exit code 137")
+        backend.submit = exploding_submit
+        clock.advance(10)
+        assert await sup.tick() == 0
+        rec = await state.get_job("r-1")
+        # attempt 2 of 2 spent on the failed resubmit -> terminal
+        assert rec.status is DatabaseStatus.FAILED
+        assert len(rec.metadata["attempt_history"]) == 2
+        assert "resubmit failed" in rec.metadata["attempt_history"][1]["message"]
+
+    run(main())
+
+
+def test_resubmit_lost_race_to_cancel_rolls_back(tmp_path):
+    """A user cancel landing inside the resubmit's await window must win:
+    the CAS transition fails and the freshly-spawned backend half is rolled
+    back instead of resurrecting a cancelled job."""
+
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        await _submit(state, store, backend, catalog)
+        job = await state.get_job("r-1")
+        await sup.on_job_failed(job, exit_code=137, message="exit code 137")
+
+        orig_submit = backend.submit
+
+        async def submit_then_cancel(*a, **k):
+            await orig_submit(*a, **k)
+            # the interleaved cancel (server handler on the same loop)
+            await state.update_job_status("r-1", DatabaseStatus.CANCELLED)
+
+        backend.submit = submit_then_cancel
+        clock.advance(100)
+        assert await sup.tick() == 0
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.CANCELLED  # the cancel stuck
+        assert backend.deleted.count("r-1") == 2  # schedule-time + rollback
+        assert sup.resubmits == 0
+
+        # a cancel BEFORE the due time is caught by the pre-submit recheck
+        await _submit(state, store, backend, catalog, job_id="r-2")
+        job2 = await state.get_job("r-2")
+        await sup.on_job_failed(job2, exit_code=137, message="exit code 137")
+        await state.update_job_status("r-2", DatabaseStatus.CANCELLED)
+        clock.advance(100)
+        assert await sup.tick() == 0
+        assert (await state.get_job("r-2")).status is DatabaseStatus.CANCELLED
+
+    run(main())
+
+
+def test_failure_intake_lost_race_to_cancel_leaves_job_alone(tmp_path):
+    """on_job_failed CAS-es from the caller's status snapshot: a cancel that
+    interleaved since the snapshot wins — no RETRYING overwrite, no attempt
+    recorded, no later resubmission of a cancelled job."""
+
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        await _submit(state, store, backend, catalog)
+        stale = await state.get_job("r-1")  # snapshot: QUEUED
+        await state.update_job_status("r-1", DatabaseStatus.CANCELLED)
+        assert not await sup.on_job_failed(
+            stale, exit_code=137, message="exit code 137"
+        )
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.CANCELLED
+        assert rec.metadata.get("attempt_history") in (None, [])
+        assert sup.retries_scheduled == 0
+        clock.advance(1e6)
+        assert await sup.tick() == 0
+
+    run(main())
+
+
+def test_retrying_job_with_missing_due_time_self_heals(tmp_path):
+    """A crash between the RETRYING status write and the metadata merge
+    leaves retry_next_at unset — tick must treat that as due NOW, not skip
+    the job forever."""
+
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        await _submit(state, store, backend, catalog)
+        # simulate the torn write: status flipped, metadata merge lost
+        await state.update_job_status("r-1", DatabaseStatus.RETRYING)
+        assert await sup.tick() == 1
+        assert (await state.get_job("r-1")).status is DatabaseStatus.QUEUED
+
+    run(main())
+
+
+def test_heartbeat_writer_swallows_write_failures(tmp_path):
+    clock = FakeClock()
+    hb = HeartbeatWriter(
+        str(tmp_path / "missing" / "dir"), interval_s=1.0, _clock=clock
+    )
+    assert hb.beat(1, force=True) is False  # failed, but did NOT raise
+    assert hb.write_failures == 1 and hb.beats == 0
+
+
+def test_delete_job_escalates_to_sigkill_for_sigterm_ignorers(tmp_path):
+    """A trainer hung hard enough to trip the lease may ignore SIGTERM; the
+    substrate half must still be DEAD before delete_job returns, or the
+    respawn shares the sandbox with the old writer."""
+    import sys
+
+    from finetune_controller_tpu.controller.backends.local import (
+        LocalProcessBackend,
+        _JobHandle,
+    )
+
+    async def main():
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = LocalProcessBackend(tmp_path / "sandboxes", store, _catalog())
+        backend.term_grace_s = 0.5
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-c",
+            "import signal, time; signal.signal(signal.SIGTERM, signal.SIG_IGN);"
+            "print('armed', flush=True); time.sleep(120)",
+            stdout=asyncio.subprocess.PIPE,
+        )
+        await proc.stdout.readline()  # SIG_IGN installed
+        handle = _JobHandle("stuck-1", tmp_path / "sandboxes" / "stuck-1",
+                            "obj://artifacts/x", [])
+        handle.proc = proc
+        backend._handles["stuck-1"] = handle
+        backend.scheduler.submit("stuck-1", "chip-1", 1)
+        assert await backend.delete_job("stuck-1")
+        assert proc.returncode == -9  # SIGKILL landed; process is gone
+
+    run(main())
+
+
+def test_statestore_transition_job_status_cas(tmp_path):
+    async def main():
+        state = StateStore(tmp_path / "state")
+        await state.connect()
+        await state.create_job(JobRecord(job_id="t-1", user_id="u", model_name="m"))
+        # expect mismatch: no write
+        ok = await state.transition_job_status(
+            "t-1", DatabaseStatus.RETRYING, DatabaseStatus.QUEUED
+        )
+        assert not ok
+        assert (await state.get_job("t-1")).status is DatabaseStatus.QUEUED
+        # expect match: transition + metadata merge + fields
+        ok = await state.transition_job_status(
+            "t-1", DatabaseStatus.QUEUED, DatabaseStatus.RUNNING,
+            metadata={"note": "cas"}, start_time=5.0,
+        )
+        assert ok
+        rec = await state.get_job("t-1")
+        assert rec.status is DatabaseStatus.RUNNING
+        assert rec.metadata["note"] == "cas" and rec.start_time == 5.0
+
+    run(main())
+
+
+def test_supervisor_readopts_retrying_jobs_across_restart(tmp_path):
+    """Crash-safety: the schedule lives in the job document, so a brand-new
+    supervisor (fresh process) resubmits a due RETRYING job it never saw."""
+
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        await _submit(state, store, backend, catalog)
+        job = await state.get_job("r-1")
+        await sup.on_job_failed(job, exit_code=137, message="exit code 137")
+        clock.advance(100)
+        # "restart": a different supervisor instance over the same store
+        sup2 = RetrySupervisor(
+            state, backend, catalog, policy=RetryPolicy(seed=1), _clock=clock
+        )
+        assert await sup2.tick() == 1
+        assert (await state.get_job("r-1")).status is DatabaseStatus.QUEUED
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# monitor integration
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_routes_failed_report_to_supervisor(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        monitor = JobMonitor(state, store, backend, interval_s=0.1, supervisor=sup)
+        await _submit(state, store, backend, catalog)
+        backend.reports["r-1"] = BackendJobReport(
+            job_id="r-1", state=BackendJobState.FAILED,
+            start_time=1.0, completion_time=2.0,
+            message="exit code 137 after 1 attempts",
+            metadata={"exit_code": 137, "restarts": 0},
+        )
+        await monitor.tick()
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.RETRYING
+        assert rec.metadata["exit_code"] == 137  # forensics persisted
+        assert rec.metadata["failure_class"] == "infra"
+        # report was cleared with the substrate; further ticks must not burn
+        # more attempts while the job waits out its backoff
+        await monitor.tick()
+        rec = await state.get_job("r-1")
+        assert len(rec.metadata["attempt_history"]) == 1
+
+        clock.advance(100)
+        await monitor.tick()  # monitor drives supervisor.tick -> resubmit
+        assert (await state.get_job("r-1")).status is DatabaseStatus.QUEUED
+
+    run(main())
+
+
+def test_monitor_retrying_job_ignores_stale_backend_report(tmp_path):
+    """A FAILED report that lingers after the supervisor scheduled a retry
+    (delete raced) must not re-fail the RETRYING job or burn attempts."""
+
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        monitor = JobMonitor(state, store, backend, interval_s=0.1, supervisor=sup)
+        await _submit(state, store, backend, catalog)
+        job = await state.get_job("r-1")
+        await sup.on_job_failed(job, exit_code=137, message="exit code 137")
+        # resurrect a stale report the delete should have removed
+        backend.reports["r-1"] = BackendJobReport(
+            job_id="r-1", state=BackendJobState.FAILED,
+            message="exit code 137 after 1 attempts",
+            metadata={"exit_code": 137},
+        )
+        await monitor.tick()
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.RETRYING
+        assert len(rec.metadata["attempt_history"]) == 1
+
+    run(main())
+
+
+def test_monitor_without_supervisor_persists_failure_class(tmp_path):
+    """Satellite: even with retries disabled, FAILED jobs carry exit_code +
+    failure_class in metadata so users can tell OOM from bad hyperparams."""
+
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        await state.connect()
+        await _submit(state, store, backend, _catalog(), job_id="nf-1")
+        backend.reports["nf-1"] = BackendJobReport(
+            job_id="nf-1", state=BackendJobState.FAILED,
+            message="exit code 137 after 3 attempts",
+            metadata={"exit_code": 137, "restarts": 2},
+        )
+        await monitor.tick()
+        rec = await state.get_job("nf-1")
+        assert rec.status is DatabaseStatus.FAILED
+        assert rec.metadata["exit_code"] == 137
+        assert rec.metadata["failure_class"] == "infra"
+        assert backend.deleted == []  # forensics behavior unchanged
+
+    run(main())
+
+
+def test_monitor_routes_lost_job_to_supervisor(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        monitor = JobMonitor(state, store, backend, interval_s=0.1, supervisor=sup)
+        monitor.lost_job_grace_s = 0.0
+        await _submit(state, store, backend, catalog)
+        backend.reports.clear()  # substrate restart: the backend forgot it
+        await monitor.tick()
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.RETRYING
+        assert rec.metadata["failure_class"] == "infra"
+        clock.advance(100)
+        await monitor.tick()
+        assert (await state.get_job("r-1")).status is DatabaseStatus.QUEUED
+
+    run(main())
+
+
+def test_sweep_grace_window_spares_fresh_jobs(tmp_path):
+    """Satellite: a job inside the lost-job grace window (just submitted,
+    maybe still in the submit path) must NOT be declared lost."""
+
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        assert monitor.lost_job_grace_s == 30.0  # the documented default
+        await state.connect()
+        await _submit(state, store, backend, _catalog(), job_id="g-1")
+        backend.reports.clear()
+        await monitor.tick()  # submitted_at is ~now -> inside the window
+        assert (await state.get_job("g-1")).status is DatabaseStatus.QUEUED
+
+        # age the record past the window -> swept
+        await state.update_job_fields("g-1", submitted_at=time.time() - 60)
+        await monitor.tick()
+        assert (await state.get_job("g-1")).status is DatabaseStatus.UNKNOWN
+        # already-UNKNOWN jobs are not re-swept (no duplicate updates)
+        await monitor.tick()
+        assert (await state.get_job("g-1")).status is DatabaseStatus.UNKNOWN
+
+    run(main())
+
+
+def test_sweep_exempts_retrying_jobs(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        monitor = JobMonitor(state, store, backend, interval_s=0.1, supervisor=sup)
+        monitor.lost_job_grace_s = 0.0
+        await _submit(state, store, backend, catalog)
+        job = await state.get_job("r-1")
+        await sup.on_job_failed(job, exit_code=137, message="exit code 137")
+        # RETRYING by design has no backend half; the sweep must leave it be
+        await monitor.tick()
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.RETRYING
+        assert len(rec.metadata["attempt_history"]) == 1
+
+    run(main())
+
+
+def test_cancelled_job_cleanup_with_and_without_backend_half(tmp_path):
+    """Satellite: the CANCELLED branch — backend half present (cleaned on
+    every tick until gone) and absent (tick is a no-op, no crash)."""
+
+    async def main():
+        state = StateStore(tmp_path / "state")
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = ScriptedBackend()
+        monitor = JobMonitor(state, store, backend, interval_s=0.1)
+        await state.connect()
+        await _submit(state, store, backend, _catalog(), job_id="c-1")
+        await state.update_job_status("c-1", DatabaseStatus.CANCELLED)
+        await monitor.tick()
+        assert backend.deleted == ["c-1"]
+        assert "c-1" not in backend.reports
+        # backend half is gone now: ticking again must neither crash nor
+        # re-delete (CANCELLED is final, the sweep exempts final states)
+        await monitor.tick()
+        assert backend.deleted == ["c-1"]
+        assert (await state.get_job("c-1")).status is DatabaseStatus.CANCELLED
+
+    run(main())
+
+
+def test_monitor_lease_kill_requeues_stuck_job(tmp_path):
+    """A RUNNING job with a stale heartbeat is killed and requeued."""
+
+    async def main():
+        clock = FakeClock(t=10_000.0)
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        lease = LeaseChecker(store, lease_s=120.0, _clock=clock)
+        monitor = JobMonitor(
+            state, store, backend, interval_s=0.1, supervisor=sup, lease=lease
+        )
+        await _submit(state, store, backend, catalog)
+        rec = await state.get_job("r-1")
+        backend.reports["r-1"] = BackendJobReport(
+            job_id="r-1", state=BackendJobState.RUNNING, start_time=clock.t - 500,
+        )
+        # fresh heartbeat: healthy
+        await store.put_bytes(
+            f"{rec.artifacts_uri}/{HEARTBEAT_FILENAME}",
+            json.dumps({"step": 10, "ts": clock.t - 30}).encode(),
+        )
+        await monitor.tick()
+        assert (await state.get_job("r-1")).status is DatabaseStatus.RUNNING
+        assert monitor.lease_kills == 0
+
+        # heartbeat goes stale past the lease: stuck -> killed -> RETRYING
+        clock.advance(200)
+        await monitor.tick()
+        assert monitor.lease_kills == 1
+        assert "r-1" in backend.deleted
+        rec = await state.get_job("r-1")
+        assert rec.status is DatabaseStatus.RETRYING
+        assert rec.metadata["failure_class"] == "infra"
+        assert "lease expired" in rec.metadata["attempt_history"][0]["message"]
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# heartbeat writer + lease decision table
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_writer_throttles_and_writes_atomically(tmp_path):
+    clock = FakeClock(t=100.0)
+    hb = HeartbeatWriter(str(tmp_path), interval_s=10.0, _clock=clock)
+    assert hb.beat(1)  # first beat always writes
+    assert not hb.beat(2)  # throttled
+    clock.advance(5)
+    assert not hb.beat(3)
+    clock.advance(6)
+    assert hb.beat(4)
+    assert hb.beat(5, force=True)  # force bypasses the throttle
+    doc = parse_heartbeat((tmp_path / HEARTBEAT_FILENAME).read_bytes())
+    assert doc["step"] == 5 and doc["ts"] == clock.t
+    assert hb.beats == 3
+    assert not (tmp_path / f"{HEARTBEAT_FILENAME}.tmp").exists()
+
+
+def test_parse_heartbeat_rejects_torn_or_alien_payloads():
+    assert parse_heartbeat(b"{ torn") is None
+    assert parse_heartbeat(b"[1, 2]") is None
+    assert parse_heartbeat(b'{"step": 1}') is None  # no ts
+    assert parse_heartbeat(b'{"ts": "soon"}') is None
+    assert parse_heartbeat(b'{"ts": 5.0, "step": 1}')["ts"] == 5.0
+
+
+def test_lease_checker_decision_table(tmp_path):
+    async def main():
+        clock = FakeClock(t=10_000.0)
+        store = LocalObjectStore(tmp_path / "objects")
+        lease = LeaseChecker(store, lease_s=100.0, _clock=clock)
+        job = JobRecord(
+            job_id="l-1", user_id="u", model_name="m",
+            artifacts_uri="obj://artifacts/finetune_jobs/u/l-1/artifacts",
+        )
+        report = BackendJobReport(
+            job_id="l-1", state=BackendJobState.RUNNING, start_time=9_000.0
+        )
+        uri = f"{job.artifacts_uri}/{HEARTBEAT_FILENAME}"
+
+        # 1. no heartbeat ever -> the lease does not bind
+        assert not await lease.expired(job, report)
+        # 2. fresh heartbeat -> healthy
+        await store.put_bytes(uri, json.dumps({"step": 5, "ts": 9_950.0}).encode())
+        assert not await lease.expired(job, report)
+        # 3. stale heartbeat -> expired
+        await store.put_bytes(uri, json.dumps({"step": 5, "ts": 9_800.0}).encode())
+        assert await lease.expired(job, report)
+        # 4. heartbeat older than the CURRENT attempt's start -> previous
+        #    attempt's dying breath; the new attempt gets grace
+        report2 = BackendJobReport(
+            job_id="l-1", state=BackendJobState.RUNNING, start_time=9_900.0
+        )
+        assert not await lease.expired(job, report2)
+        # 5. torn file -> never kills
+        await store.put_bytes(uri, b"{ torn")
+        assert not await lease.expired(job, report)
+        # 6. lease disabled
+        off = LeaseChecker(store, lease_s=0.0, _clock=clock)
+        assert not await off.expired(job, report)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_step_fault_env_roundtrip_and_once_file(tmp_path):
+    once = str(tmp_path / "fired")
+    fault = StepFault(kill_at_step=7, signum=15, once_file=once)
+    env = fault.to_env()
+    assert StepFault.from_env(env) == fault
+    assert StepFault.from_env({}) is None
+    assert StepFault.from_env({"FTC_FAULT_KILL_AT_STEP": "nope"}) is None
+
+    # signum 0 is the no-op "liveness probe" signal: safe to send to self
+    inj = StepFaultInjector(StepFault(kill_at_step=3, signum=0, once_file=once))
+    assert not inj.maybe_fire(1)
+    assert not inj.maybe_fire(2)
+    assert inj.maybe_fire(3)
+    assert os.path.exists(once)
+    assert not inj.maybe_fire(4)  # fired flag
+    # a respawned attempt (fresh injector, same once file) stays clean
+    inj2 = StepFaultInjector(StepFault(kill_at_step=3, signum=0, once_file=once))
+    assert not inj2.maybe_fire(3)
+    # past-the-step arming still fires (cadence may skip the exact step)
+    inj3 = StepFaultInjector(StepFault(kill_at_step=3, signum=0))
+    assert inj3.maybe_fire(5)
+
+
+def test_faulty_object_store_is_seed_deterministic(tmp_path):
+    async def main():
+        async def failure_mask(seed):
+            inner = LocalObjectStore(tmp_path / f"objects_{seed}")
+            store = FaultyObjectStore(inner, write_error_rate=0.5, seed=seed)
+            mask = []
+            for i in range(20):
+                try:
+                    await store.put_bytes(f"obj://b/k{i}", b"x")
+                    mask.append(False)
+                except FaultInjectionError:
+                    mask.append(True)
+            return mask, store
+
+        mask_a, store_a = await failure_mask(7)
+        mask_b, _ = await failure_mask(7)
+        mask_c, _ = await failure_mask(8)
+        assert mask_a == mask_b  # same seed, same schedule
+        assert mask_a != mask_c  # different seed, different schedule
+        assert any(mask_a) and not all(mask_a)
+        assert store_a.injected_errors == sum(mask_a)
+        # reads pass through untouched (and succeed for committed writes)
+        ok = [i for i, failed in enumerate(mask_a) if not failed]
+        assert await store_a.get_bytes(f"obj://b/k{ok[0]}") == b"x"
+
+    run(main())
+
+
+def test_faulty_object_store_slow_io_delays_writes(tmp_path):
+    async def main():
+        inner = LocalObjectStore(tmp_path / "objects")
+        store = FaultyObjectStore(inner, slow_io_s=0.05, seed=0)
+        t0 = time.perf_counter()
+        await store.put_bytes("obj://b/slow", b"x")
+        assert time.perf_counter() - t0 >= 0.05
+        assert await store.get_bytes("obj://b/slow") == b"x"
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# resume staging (the backend half of the resubmit contract)
+# ---------------------------------------------------------------------------
+
+
+def test_local_backend_stages_checkpoints_into_fresh_sandbox(tmp_path):
+    """Resubmit onto a LOST sandbox: committed checkpoints and the metrics
+    history come back from the object store; stale heartbeat and done.txt
+    deliberately do not."""
+    from finetune_controller_tpu.controller.backends.local import (
+        LocalProcessBackend,
+        _JobHandle,
+    )
+
+    async def main():
+        store = LocalObjectStore(tmp_path / "objects")
+        backend = LocalProcessBackend(
+            tmp_path / "sandboxes", store, _catalog(), sync_interval_s=60
+        )
+        uri = "obj://artifacts/finetune_jobs/u/s-1/artifacts"
+        await store.put_bytes(f"{uri}/checkpoints/step_20/state.msgpack", b"ck20")
+        await store.put_bytes(f"{uri}/checkpoints/step_10/state.msgpack", b"ck10")
+        await store.put_bytes(f"{uri}/metrics.csv", b"step,loss\n10,2.0\n")
+        await store.put_bytes(f"{uri}/{HEARTBEAT_FILENAME}", b'{"ts": 1.0}')
+        await store.put_bytes(f"{uri}/resolved_config.json", b"{}")
+
+        sandbox = tmp_path / "sandboxes" / "s-1"
+        handle = _JobHandle("s-1", sandbox, uri, ["*.csv", "checkpoints/**/*"])
+        handle.artifacts_dir.mkdir(parents=True)
+        await backend._stage_resume_state(handle)
+
+        art = handle.artifacts_dir
+        assert (art / "checkpoints/step_20/state.msgpack").read_bytes() == b"ck20"
+        assert (art / "checkpoints/step_10/state.msgpack").read_bytes() == b"ck10"
+        assert (art / "metrics.csv").exists()
+        assert not (art / HEARTBEAT_FILENAME).exists()
+        assert not (art / "resolved_config.json").exists()
+        assert handle.restored_checkpoints == 3
+        # the sync sidecar must not re-upload what was just pulled down
+        assert set(handle.synced) == {
+            "checkpoints/step_20/state.msgpack",
+            "checkpoints/step_10/state.msgpack",
+            "metrics.csv",
+        }
+
+        # a sandbox that SURVIVED is left untouched (no redundant downloads)
+        handle2 = _JobHandle("s-1", sandbox, uri, [])
+        await backend._stage_resume_state(handle2)
+        assert handle2.restored_checkpoints == 0
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# surfacing: the admin route's data source
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_pending_retries_snapshot(tmp_path):
+    async def main():
+        clock = FakeClock()
+        state, store, backend, catalog, sup = await _plane(tmp_path, clock=clock)
+        await _submit(state, store, backend, catalog)
+        job = await state.get_job("r-1")
+        await sup.on_job_failed(job, exit_code=137, message="exit code 137")
+        pending = await sup.pending_retries()
+        assert len(pending) == 1
+        assert pending[0]["job_id"] == "r-1"
+        assert pending[0]["attempts"] == 1
+        assert pending[0]["failure_class"] == "infra"
+        assert pending[0]["retry_next_at"] > clock.t
+
+    run(main())
