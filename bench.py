@@ -496,6 +496,117 @@ def _measure_chaos_recovery() -> dict:
         return asyncio.run(run(Path(d)))
 
 
+def _measure_serve() -> dict:
+    """BENCH_MODE=serve: continuous-batching engine vs sequential decode.
+
+    The serving headline: aggregate tokens/s of ``serve.engine.BatchEngine``
+    over N concurrent requests against the same requests run one at a time
+    through ``cached_generate`` (the pre-serve path), plus per-request
+    completion latency p50/p95 measured from a common start — the number a
+    queued client actually experiences.  Both legs are warmed first (compiles
+    excluded; steady-state serving is what is measured), and the engine's
+    recompile guard is armed with on_excess="raise": a decode step compiling
+    mid-window is a measurement bug, not a slow number.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from finetune_controller_tpu.models.generate import cached_generate
+    from finetune_controller_tpu.models.llama import PRESETS, LlamaForCausalLM
+    from finetune_controller_tpu.models.lora import LoRAConfig
+    from finetune_controller_tpu.serve.engine import (
+        BatchEngine,
+        EngineConfig,
+        GenRequest,
+    )
+
+    preset = os.environ.get("BENCH_PRESET", "tiny-test")
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "8"))
+    max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "32"))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", str(n_requests)))
+
+    cfg = PRESETS[preset].replace(lora=LoRAConfig(rank=8))
+    model = LlamaForCausalLM(cfg)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32)
+    )
+    rng = np.random.default_rng(0)
+    # mixed prompt lengths across two buckets — the shape serving traffic has
+    prompts = [
+        list(rng.integers(1, cfg.vocab_size - 1, size=int(n)))
+        for n in rng.integers(4, 24, size=n_requests)
+    ]
+
+    def reqs():
+        return [
+            GenRequest(request_id=f"r{i}", tokens=p, max_new_tokens=max_new)
+            for i, p in enumerate(prompts)
+        ]
+
+    # --- sequential baseline: one request at a time through cached_generate
+    def run_sequential() -> list[float]:
+        done_at, t0 = [], time.perf_counter()
+        for p in prompts:
+            out = cached_generate(
+                model, variables, jnp.asarray([p], jnp.int32),
+                max_new_tokens=max_new,
+            )
+            jax.block_until_ready(out)
+            done_at.append(time.perf_counter() - t0)
+        return done_at
+
+    run_sequential()  # warm: per-prompt-length decode fns compile here
+    seq_done = run_sequential()
+    seq_window = seq_done[-1]
+
+    engine = BatchEngine(
+        model, variables,
+        EngineConfig(slots=slots, prompt_buckets=(32, 128),
+                     max_new_tokens=max_new + 8),
+    )
+    engine.run(reqs())  # warm: fill buckets + the decode step compile here
+    t0 = time.perf_counter()
+    results = engine.run(reqs())
+    engine_window = time.perf_counter() - t0
+    # finished_at is monotonic-clock; re-zero against the earliest admission
+    base = min(r.admitted_at for r in results.values())
+    engine_done = sorted(r.finished_at - base for r in results.values())
+
+    total_tokens = sum(len(r.generated) for r in results.values())
+    if total_tokens != n_requests * max_new:
+        fail(
+            "serve bench generated an unexpected token count",
+            total_tokens=total_tokens, expected=n_requests * max_new,
+        )
+    engine_tps = total_tokens / engine_window
+    seq_tps = total_tokens / seq_window
+    speedup = engine_tps / seq_tps
+
+    def pct(xs: list[float], p: float) -> float:
+        return float(np.percentile(np.asarray(xs), p))
+
+    return {
+        "metric": f"serve_tokens_per_sec[{preset},req{n_requests},"
+                  f"new{max_new},slots{slots}]",
+        "value": round(engine_tps, 1),
+        "unit": "tokens/sec",
+        "speedup_vs_sequential": round(speedup, 2),
+        "sequential_tokens_per_sec": round(seq_tps, 1),
+        "p50_latency_s": round(pct(engine_done, 50), 4),
+        "p95_latency_s": round(pct(engine_done, 95), 4),
+        "sequential_p50_latency_s": round(pct(seq_done, 50), 4),
+        "sequential_p95_latency_s": round(pct(seq_done, 95), 4),
+        "n_requests": n_requests,
+        "max_new_tokens": max_new,
+        "slots": slots,
+        "compilations": engine.compilations,
+        "recompile_budget": engine.guard.budget,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def main() -> None:
     if os.environ.get("BENCH_MODE", "").strip().lower() == "chaos":
         # controller-plane bench: the parent process needs no accelerator —
@@ -509,6 +620,13 @@ def main() -> None:
     from finetune_controller_tpu.platform import assert_platform_env, env_flag
 
     assert_platform_env()
+
+    if os.environ.get("BENCH_MODE", "").strip().lower() == "serve":
+        result = _measure_serve()
+        if jax.devices()[0].platform == "tpu":
+            _session_log_append(result)
+        print(json.dumps(result))
+        return
 
     import numpy as np
 

@@ -103,6 +103,34 @@ class Settings(BaseModel):
     rate_limit_submit_per_min: int = 10
     rate_limit_read_per_min: int = 50
     rate_limit_promote_per_min: int = 2
+    rate_limit_generate_per_min: int = 120
+
+    # --- Serving (finetune_controller_tpu/serve/, docs/serving.md) ---
+    #: decode lanes per served model — the compiled batch; traffic above this
+    #: queues (continuous batching refills lanes between steps)
+    serve_slots: int = 8
+    #: prefill pad targets (ascending); one prefill compile per bucket — the
+    #: compile-count dial (docs/serving.md)
+    serve_prompt_buckets: list[int] = Field(default_factory=lambda: [32, 128, 512])
+    #: hard per-request generation cap; also sizes the KV cache
+    #: (max(buckets) + this = cache slots per lane)
+    serve_max_new_tokens: int = 128
+    #: default when a request omits max_new_tokens
+    serve_default_max_new_tokens: int = 32
+    #: admission queue depth — past it requests get 429 (backpressure)
+    serve_max_queue: int = 64
+    #: idle poll interval of the drive loop (first-token latency floor when
+    #: lanes are free)
+    serve_max_wait_ms: float = 5.0
+    #: default per-request deadline: queued-past-it → dropped, decoding-past-it
+    #: → evicted mid-flight (0 = no deadline)
+    serve_request_timeout_s: float = 60.0
+    #: load a promoted job's checkpoint on its first generate request (off =
+    #: only explicit POST /admin/serve/{job}/load serves traffic)
+    serve_autoload: bool = True
+    #: fold LoRA deltas into the base kernels at load (dense-model matmul
+    #: count; int4-quantized bases always serve unmerged)
+    serve_merge_lora: bool = True
 
     # --- Resilience (finetune_controller_tpu/resilience/, docs/resilience.md) ---
     #: total run attempts per job before a retryable failure becomes terminal
@@ -152,6 +180,12 @@ def _from_env() -> Settings:
             raw[name] = (
                 json.loads(env_val) if env_val.startswith("[") else env_val.split(",")
             )
+        elif ann == list[int]:
+            parts = (
+                json.loads(env_val) if env_val.startswith("[")
+                else env_val.split(",")
+            )
+            raw[name] = [int(p) for p in parts]
         else:
             raw[name] = env_val
     return Settings(**raw)

@@ -17,6 +17,7 @@ Commands:
     artifacts JOB_ID [-o out.zip]       artifact inventory (or zip download)
     promote JOB_ID / unpromote JOB_ID
     cancel JOB_ID
+    generate JOB_ID --tokens 1,2,3      decode from a promoted job's checkpoint
     dev-token [USER_ID]                 mint a dev token (local envs only)
 
 Auth: ``--token`` or the FTC_CTL_TOKEN env var; the API URL defaults to
@@ -216,6 +217,30 @@ async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:
     return 0
 
 
+async def cmd_generate(client: Client, ns: argparse.Namespace) -> int:
+    """Hit the serving endpoint of a promoted job: token ids in, tokens out
+    (docs/serving.md; the server refuses non-COMPLETED promotions)."""
+    try:
+        tokens = [int(t) for t in ns.tokens.replace(" ", "").split(",") if t]
+    except ValueError:
+        raise SystemExit(f"--tokens expects comma-separated ids, got {ns.tokens!r}")
+    if not tokens:
+        raise SystemExit("--tokens must name at least one token id")
+    body: dict[str, Any] = {"tokens": tokens}
+    if ns.max_new_tokens is not None:
+        body["max_new_tokens"] = ns.max_new_tokens
+    if ns.temperature is not None:
+        body["temperature"] = ns.temperature
+    if ns.top_k is not None:
+        body["top_k"] = ns.top_k
+    if ns.eos_id is not None:
+        body["eos_id"] = ns.eos_id
+    if ns.seed is not None:
+        body["seed"] = ns.seed
+    _print_json(await client.post(f"/jobs/{ns.job_id}/generate", json=body))
+    return 0
+
+
 async def cmd_artifacts(client: Client, ns: argparse.Namespace) -> int:
     if ns.output:
         await client.download(f"/jobs/{ns.job_id}/artifacts", ns.output)
@@ -247,6 +272,8 @@ async def amain(ns: argparse.Namespace) -> int:
         if ns.cmd in ("promote", "unpromote", "cancel"):
             _print_json(await client.post(f"/jobs/{ns.job_id}/{ns.cmd}"))
             return 0
+        if ns.cmd == "generate":
+            return await cmd_generate(client, ns)
         if ns.cmd == "dev-token":
             body = await client.post("/auth/dev-token",
                                      json={"user_id": ns.user_id})
@@ -285,6 +312,15 @@ def build_parser() -> argparse.ArgumentParser:
             s.add_argument("--output", "-o",
                            help="download the artifact zip to this path "
                                 "(default: list the inventory)")
+    s = sub.add_parser("generate")
+    s.add_argument("job_id")
+    s.add_argument("--tokens", required=True,
+                   help="comma-separated prompt token ids (e.g. 1,2,3)")
+    s.add_argument("--max-new-tokens", type=int, default=None)
+    s.add_argument("--temperature", type=float, default=None)
+    s.add_argument("--top-k", type=int, default=None)
+    s.add_argument("--eos-id", type=int, default=None)
+    s.add_argument("--seed", type=int, default=None)
     s = sub.add_parser("dev-token")
     s.add_argument("user_id", nargs="?", default="dev")
     return p

@@ -32,45 +32,69 @@ class PromotionTask:
         self.state = state
         self.store = store
 
+    async def _settle(
+        self, job_id: str, expect: PromotionStatus, to: PromotionStatus,
+        uri: str | None = None,
+    ) -> None:
+        """CAS the task's completion write: applies only while the job is
+        still in the state THIS task claimed.  A blind write here could stomp
+        a crash-recovery sweep (another process already marked FAILED and the
+        user re-promoted) — the stale task must lose, not the fresh one."""
+        if not await self.state.transition_job_promotion(
+            job_id, [expect], to, uri
+        ):
+            logger.warning(
+                "promotion state for %s moved concurrently (expected %s); "
+                "leaving the newer transition in place", job_id, expect.value,
+            )
+
     async def promote_job_task(
         self, job_id: str, artifacts_uri: str, destination_uri: str
     ) -> None:
-        """Reference: ``promotion.py:11-36``."""
-        await self.state.update_job_promotion(
-            job_id, PromotionStatus.IN_PROGRESS, destination_uri
-        )
+        """Reference: ``promotion.py:11-36``.  The caller already claimed
+        IN_PROGRESS via ``begin_promotion``; every write here is a CAS from
+        that state so concurrent transitions are never overwritten."""
         try:
             n = await self.store.copy_prefix(artifacts_uri, destination_uri)
             if n == 0:
                 raise FileNotFoundError(f"no artifacts under {artifacts_uri}")
-            await self.state.update_job_promotion(
-                job_id, PromotionStatus.COMPLETED, destination_uri
+            await self._settle(
+                job_id, PromotionStatus.IN_PROGRESS, PromotionStatus.COMPLETED,
+                destination_uri,
             )
             logger.info("promoted %s: %d objects -> %s", job_id, n, destination_uri)
         except asyncio.CancelledError:
             # shutdown mid-copy: record FAILED so the job isn't stuck
             # IN_PROGRESS forever (the promote guard refuses retries otherwise)
-            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+            await self._settle(
+                job_id, PromotionStatus.IN_PROGRESS, PromotionStatus.FAILED
+            )
             raise
         except Exception:
             logger.exception("promotion failed for %s", job_id)
-            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+            await self._settle(
+                job_id, PromotionStatus.IN_PROGRESS, PromotionStatus.FAILED
+            )
 
     async def unpromote_job_task(self, job_id: str, destination_uri: str) -> None:
-        """Reference: ``unpromote_job_task``, ``promotion.py:38-62``."""
-        await self.state.update_job_promotion(
-            job_id, PromotionStatus.DELETING, destination_uri
-        )
+        """Reference: ``unpromote_job_task``, ``promotion.py:38-62``; DELETING
+        was claimed by the caller's ``begin_promotion`` CAS."""
         try:
             await self.store.delete_prefix(destination_uri)
-            await self.state.update_job_promotion(job_id, PromotionStatus.NOT_PROMOTED)
+            await self._settle(
+                job_id, PromotionStatus.DELETING, PromotionStatus.NOT_PROMOTED
+            )
             logger.info("unpromoted %s (removed %s)", job_id, destination_uri)
         except asyncio.CancelledError:
-            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+            await self._settle(
+                job_id, PromotionStatus.DELETING, PromotionStatus.FAILED
+            )
             raise
         except Exception:
             logger.exception("unpromotion failed for %s", job_id)
-            await self.state.update_job_promotion(job_id, PromotionStatus.FAILED)
+            await self._settle(
+                job_id, PromotionStatus.DELETING, PromotionStatus.FAILED
+            )
 
     async def recover_interrupted(self) -> int:
         """Crash recovery at startup: anything still IN_PROGRESS/DELETING has
@@ -79,10 +103,15 @@ class PromotionTask:
         for job in await self.state.find_jobs_with_promotion_in(
             [PromotionStatus.IN_PROGRESS, PromotionStatus.DELETING]
         ):
-            await self.state.update_job_promotion(
-                job.job_id, PromotionStatus.FAILED
-            )
-            n += 1
+            # CAS from the observed in-flight state: with a shared remote
+            # store, another replica's LIVE task may settle between our read
+            # and this write — its fresher transition must win
+            if await self.state.transition_job_promotion(
+                job.job_id,
+                [PromotionStatus.IN_PROGRESS, PromotionStatus.DELETING],
+                PromotionStatus.FAILED,
+            ):
+                n += 1
         if n:
             logger.warning("marked %d interrupted promotion(s) as failed", n)
         return n

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+from typing import Any
 
 from ..resilience.heartbeat import LeaseChecker
 from ..resilience.policy import RetryPolicy
@@ -38,6 +39,9 @@ class Runtime:
     backend: TrainingBackend
     monitor: JobMonitor
     presigner: Presigner
+    #: inference sessions over promoted checkpoints (serve/service.py);
+    #: lazily populated — nothing loads until a generate/load request
+    serve: Any = None
 
     async def start(self, *, with_monitor: bool | None = None) -> None:
         await self.state.connect()
@@ -53,6 +57,8 @@ class Runtime:
             await prewarm()
 
     async def close(self) -> None:
+        if self.serve is not None:
+            await self.serve.close()
         await self.monitor.stop()
         await self.backend.close()
         await self.state.close()
@@ -125,6 +131,8 @@ def build_runtime(
         supervisor=supervisor, lease=lease,
     )
     presigner = Presigner(settings.presign_secret, settings.presign_expiry_s)
+    from ..serve.service import ServeManager
+
     return Runtime(
         settings=settings,
         state=state,
@@ -133,4 +141,5 @@ def build_runtime(
         backend=backend,
         monitor=monitor,
         presigner=presigner,
+        serve=ServeManager(state, store, settings),
     )

@@ -524,8 +524,16 @@ async def promote_job(request: web.Request) -> web.Response:
     # Compare-and-set claim: concurrent promote requests race on the awaits
     # between the guard above and here, so the IN_PROGRESS transition itself
     # must be atomic — only the request that wins the CAS spawns the copy.
+    # expect_from pins the legal sources: a promote landing while an
+    # unpromote is DELETING (or any state the guards above didn't see) loses
+    # in the store, not in these stale-read guards.
     if not await rt.state.begin_promotion(
-        job.job_id, PromotionStatus.IN_PROGRESS, destination
+        job.job_id, PromotionStatus.IN_PROGRESS, destination,
+        expect_from=[
+            PromotionStatus.NOT_PROMOTED,
+            PromotionStatus.FAILED,
+            PromotionStatus.COMPLETED,  # re-promote refreshes the deploy copy
+        ],
     ):
         return web.json_response(
             {"detail": "promotion already in progress"}, status=202
@@ -549,9 +557,11 @@ async def unpromote_job(request: web.Request) -> web.Response:
     if not job.promotion_uri:
         return _json_error(404, "no promotion destination recorded")
     promo = request.app[PROMOTION_KEY]
-    # Same CAS claim as promote: only the winning request spawns the cleanup.
+    # Same CAS claim as promote: only the winning request spawns the cleanup,
+    # and only from a settled promoted/failed state (never mid-promote).
     if not await rt.state.begin_promotion(
-        job.job_id, PromotionStatus.DELETING, job.promotion_uri
+        job.job_id, PromotionStatus.DELETING, job.promotion_uri,
+        expect_from=[PromotionStatus.COMPLETED, PromotionStatus.FAILED],
     ):
         return web.json_response(
             {"detail": "unpromotion already in progress"}, status=202
@@ -817,6 +827,23 @@ async def mint_dev_token(request: web.Request) -> web.Response:
     return web.json_response({"access_token": token, "token_type": "bearer"})
 
 
+#: the Prometheus text exposition content type (version 0.0.4) — scrapers
+#: key parsing off it; a bare text/plain is accepted but ambiguous
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def prom_escape(value: str) -> str:
+    """Escape a label VALUE per the exposition format: backslash, double
+    quote, and newline must be escaped or a hostile job_id/flavor name breaks
+    the whole scrape."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 async def prometheus_metrics(request: web.Request) -> web.Response:
     """Controller self-metrics in Prometheus text format — a gap in the
     reference (SURVEY.md §5.5: 'No Prometheus/metrics endpoint')."""
@@ -830,18 +857,46 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
         counts[job.status.value] = counts.get(job.status.value, 0) + 1
     lines.append("# TYPE ftc_jobs_active gauge")
     for status, n in sorted(counts.items()):
-        lines.append(f'ftc_jobs_active{{status="{status}"}} {n}')
+        lines.append(f'ftc_jobs_active{{status="{prom_escape(status)}"}} {n}')
     scheduler = getattr(rt.backend, "scheduler", None)
     if scheduler is not None:
         lines.append("# TYPE ftc_quota_chips gauge")
         for flavor, u in scheduler.usage().items():
+            f = prom_escape(flavor)
             lines.append(
-                f'ftc_quota_chips{{flavor="{flavor}",kind="used"}} {u["used_chips"]}'
+                f'ftc_quota_chips{{flavor="{f}",kind="used"}} {u["used_chips"]}'
             )
             lines.append(
-                f'ftc_quota_chips{{flavor="{flavor}",kind="nominal"}} {u["nominal_chips"]}'
+                f'ftc_quota_chips{{flavor="{f}",kind="nominal"}} {u["nominal_chips"]}'
             )
-    return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+    if rt.serve is not None:
+        sessions = rt.serve.stats()
+        serve_gauges = (
+            ("ftc_serve_queue_depth", "gauge", "queue_depth"),
+            ("ftc_serve_slots_busy", "gauge", "slots_busy"),
+            ("ftc_serve_slots_total", "gauge", "slots_total"),
+            ("ftc_serve_tokens_generated_total", "counter",
+             "tokens_generated_total"),
+            ("ftc_serve_requests_completed_total", "counter",
+             "requests_completed_total"),
+            ("ftc_serve_requests_rejected_total", "counter",
+             "requests_rejected_total"),
+            ("ftc_serve_decode_steps_total", "counter", "steps_total"),
+            ("ftc_serve_compilations", "gauge", "compilations"),
+        )
+        lines.append("# TYPE ftc_serve_models_loaded gauge")
+        lines.append(f"ftc_serve_models_loaded {len(sessions)}")
+        for metric, kind, stat_key in serve_gauges:
+            lines.append(f"# TYPE {metric} {kind}")
+            for job_id, stats in sorted(sessions.items()):
+                lines.append(
+                    f'{metric}{{job_id="{prom_escape(job_id)}"}} '
+                    f"{stats[stat_key]}"
+                )
+    return web.Response(
+        body=("\n".join(lines) + "\n").encode("utf-8"),
+        headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+    )
 
 
 def _openapi_schema(app: web.Application, settings: Settings) -> dict[str, Any]:
@@ -933,9 +988,17 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
             "submit": settings.rate_limit_submit_per_min,
             "read": settings.rate_limit_read_per_min,
             "promote": settings.rate_limit_promote_per_min,
+            "generate": settings.rate_limit_generate_per_min,
         },
     )
     app[BG_TASKS_KEY] = set()
+    # inference over promoted checkpoints (serve/service.py); runtimes built
+    # outside build_runtime (tests) get a manager here so the routes work
+    from ..serve.service import SERVE_KEY, ServeManager, add_serve_routes
+
+    if runtime.serve is None:
+        runtime.serve = ServeManager(runtime.state, runtime.store, settings)
+    app[SERVE_KEY] = runtime.serve
 
     p = settings.api_prefix
     app.router.add_get(f"{p}/health", health)
@@ -965,6 +1028,7 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
     app.router.add_post(f"{p}/auth/dev-token", mint_dev_token)
     app.router.add_get(f"{p}/openapi.json", openapi_json)
     app.router.add_get("/metrics", prometheus_metrics)
+    add_serve_routes(app, p)
 
     async def on_startup(app: web.Application) -> None:
         await runtime.start(with_monitor=with_monitor)
@@ -1092,4 +1156,11 @@ def main(argv: list[str] | None = None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    # `python -m ...controller.server` loads this file as `__main__`, a
+    # SECOND module instance with its own AppKey objects. Handlers that
+    # import the module by its canonical name (serve/service.py) would then
+    # look up different keys than build_app stored and 500. Delegate to the
+    # canonical instance so there is exactly one set of keys.
+    from finetune_controller_tpu.controller.server import main as _main
+
+    raise SystemExit(_main())

@@ -666,20 +666,59 @@ class StateStore:
         job_id: str,
         promotion_status: PromotionStatus,
         promotion_uri: str,
+        expect_from: list[PromotionStatus | str] | None = None,
     ) -> bool:
         """Atomically claim a promote/unpromote transition: succeeds only if no
-        transition is already in flight. Returns False when another request won."""
+        transition is already in flight AND (when ``expect_from`` is given) the
+        current state is one of the expected sources. Returns False when
+        another request won or the state moved underneath the caller —
+        promote-while-DELETING and unpromote-while-IN_PROGRESS lose here, in
+        the store's consistency domain, not in handler guards racing on
+        awaits."""
         in_flight = {
             PromotionStatus.IN_PROGRESS.value,
             PromotionStatus.DELETING.value,
         }
+        expect = (
+            None if expect_from is None
+            else {PromotionStatus(s).value for s in expect_from}
+        )
+
+        def ok(doc: dict) -> bool:
+            cur = doc.get("promotion_status")
+            if cur in in_flight:
+                return False
+            return expect is None or cur in expect
+
         return await self.jobs.update_if(
             job_id,
             {
                 "promotion_status": PromotionStatus(promotion_status).value,
                 "promotion_uri": promotion_uri,
             },
-            lambda doc: doc.get("promotion_status") not in in_flight,
+            ok,
+        )
+
+    async def transition_job_promotion(
+        self,
+        job_id: str,
+        expect: list[PromotionStatus | str],
+        promotion_status: PromotionStatus,
+        promotion_uri: str | None = None,
+    ) -> bool:
+        """Compare-and-set promotion transition (the job-status CAS shape):
+        applies only while the job is still in one of ``expect``.  The
+        promotion task's completion writes need this — a crash-recovery sweep
+        or a concurrent unpromote landing mid-copy must not be stomped by the
+        stale task's final blind write."""
+        vals = {PromotionStatus(s).value for s in expect}
+        fields: dict[str, Any] = {
+            "promotion_status": PromotionStatus(promotion_status).value
+        }
+        if promotion_uri is not None:
+            fields["promotion_uri"] = promotion_uri
+        return await self.jobs.update_if(
+            job_id, fields, lambda doc: doc.get("promotion_status") in vals
         )
 
     async def update_job_fields(self, job_id: str, **fields: Any) -> bool:

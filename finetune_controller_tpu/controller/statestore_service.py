@@ -104,7 +104,16 @@ async def _update_job_promotion(store, p):
 @_rpc("begin_promotion")
 async def _begin_promotion(store, p):
     return await store.begin_promotion(
-        p["job_id"], p["promotion_status"], p["promotion_uri"]
+        p["job_id"], p["promotion_status"], p["promotion_uri"],
+        expect_from=p.get("expect_from"),
+    )
+
+
+@_rpc("transition_job_promotion")
+async def _transition_job_promotion(store, p):
+    return await store.transition_job_promotion(
+        p["job_id"], p["expect"], p["promotion_status"],
+        p.get("promotion_uri"),
     )
 
 
@@ -370,11 +379,29 @@ class RemoteStateStore:
             promotion_uri=promotion_uri,
         )
 
-    async def begin_promotion(self, job_id, promotion_status, promotion_uri) -> bool:
+    async def begin_promotion(
+        self, job_id, promotion_status, promotion_uri, expect_from=None
+    ) -> bool:
         from .schemas import PromotionStatus
 
         return await self._call(
             "begin_promotion", job_id=job_id,
+            promotion_status=PromotionStatus(promotion_status).value,
+            promotion_uri=promotion_uri,
+            expect_from=(
+                None if expect_from is None
+                else [PromotionStatus(s).value for s in expect_from]
+            ),
+        )
+
+    async def transition_job_promotion(
+        self, job_id, expect, promotion_status, promotion_uri=None
+    ) -> bool:
+        from .schemas import PromotionStatus
+
+        return await self._call(
+            "transition_job_promotion", job_id=job_id,
+            expect=[PromotionStatus(s).value for s in expect],
             promotion_status=PromotionStatus(promotion_status).value,
             promotion_uri=promotion_uri,
         )

@@ -23,6 +23,7 @@ trainer's assembled variables::
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any
 
 import jax
@@ -97,14 +98,20 @@ def greedy_generate(model, variables, prompt_tokens, *, max_new_tokens=32,
 #: jitted (fill, decode_step) pairs keyed by (model class, decode config) —
 #: defined at module level so REPEATED cached_generate calls (the whole point
 #: of a usable 7B sanity loop) reuse compilations instead of re-tracing.
-#: Configs are frozen dataclasses, hence hashable; bounded to stay tiny.
-_DECODE_FNS_CACHE: dict = {}
+#: Configs are frozen dataclasses, hence hashable.  A true bounded LRU (the
+#: ``PixelCache`` shape from ``data/mm_loader.py``): evicting only the
+#: least-recently-used entry means N+1 alternating configs thrash exactly one
+#: slot, where the old clear-everything-at-capacity behavior re-traced ALL of
+#: them forever.
+_DECODE_FNS_MAX = 8
+_DECODE_FNS_CACHE: OrderedDict = OrderedDict()
 
 
 def _decode_fns(model_type, dcfg):
     key = (model_type, dcfg)
     cached = _DECODE_FNS_CACHE.get(key)
     if cached is not None:
+        _DECODE_FNS_CACHE.move_to_end(key)
         return cached
     dmodel = model_type(cfg=dcfg)
     mutable = ("cache", "moe_aux") if dcfg.n_experts else ("cache",)
@@ -130,8 +137,8 @@ def _decode_fns(model_type, dcfg):
         )
         return logits[:, -1].astype(jnp.float32), updated["cache"]
 
-    if len(_DECODE_FNS_CACHE) >= 8:
-        _DECODE_FNS_CACHE.clear()
+    if len(_DECODE_FNS_CACHE) >= _DECODE_FNS_MAX:
+        _DECODE_FNS_CACHE.popitem(last=False)
     _DECODE_FNS_CACHE[key] = (fill, decode_step)
     return fill, decode_step
 

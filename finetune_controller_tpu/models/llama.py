@@ -447,6 +447,11 @@ class Attention(nn.Module):
         single-token call appends at the cache index and attends over the
         valid prefix.  Closes the round-2 gap of the uncached O(n²)-per-token
         sampler being impractical at 7B (VERDICT r2 weak #7).
+
+        The cache index is a PER-ROW ``(B,)`` vector: ``cached_generate``
+        keeps every row in lockstep (all entries equal), while the serving
+        engine (``serve/engine.py``) decodes each batch slot at its own
+        position so requests can join mid-flight.
         """
         from ..ops.attention import single_token_attention
 
@@ -460,21 +465,21 @@ class Attention(nn.Module):
         cv = self.variable(
             "cache", "v",
             lambda: jnp.zeros((b, m, cfg.n_kv_heads, hd), cfg.dtype))
-        ci = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        ci = self.variable("cache", "index",
+                           lambda: jnp.zeros((b,), jnp.int32))
         if s > 1 or fresh:
             # prefill: write the prompt's K/V and run the normal causal kernel
             ck.value = jax.lax.dynamic_update_slice(
                 ck.value, k.astype(cfg.dtype), (0, 0, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (0, 0, 0, 0))
-            ci.value = jnp.asarray(s, jnp.int32)
+            ci.value = jnp.full((b,), s, jnp.int32)
             out = causal_attention(q, k, v, impl="xla")
         else:
-            idx = ci.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            idx = ci.value  # (B,) — rows may sit at different positions
+            rows = jnp.arange(b)
+            ck.value = ck.value.at[rows, idx].set(k[:, 0].astype(cfg.dtype))
+            cv.value = cv.value.at[rows, idx].set(v[:, 0].astype(cfg.dtype))
             ci.value = idx + 1
             out = single_token_attention(q, ck.value, cv.value, idx)
         return _proj(cfg, "o_proj", cfg.d_model)(

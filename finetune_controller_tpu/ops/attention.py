@@ -71,16 +71,24 @@ def single_token_attention(
 ) -> jax.Array:
     """One decode step against a static-length KV cache.
 
-    q: (B, 1, H, D); caches (B, M, Hkv, D); ``idx`` is the scalar position of
-    the query token — cache slots > idx are masked out.  Same f32-softmax and
+    q: (B, 1, H, D); caches (B, M, Hkv, D); ``idx`` is the position of the
+    query token — scalar (whole batch in lockstep, the ``cached_generate``
+    path) or (B,) per-row (the serving engine, where each slot decodes at its
+    own position) — cache slots > idx are masked out.  Same f32-softmax and
     1/sqrt(D) conventions as :func:`xla_causal_attention`, so a cached decode
-    matches the uncached oracle bit-for-bit up to dtype rounding.
+    matches the uncached oracle bit-for-bit up to dtype rounding.  Masked
+    slots contribute exactly 0 to the softmax (the f32-min fill underflows
+    exp to 0.0), so per-row results are independent of the cache length and
+    of whatever other rows hold.
     """
     b, s, h, d = q.shape
     hkv = k_cache.shape[2]
     g = h // hkv
     qh = (q * d ** -0.5).reshape(b, s, hkv, g, d)
     scores = jnp.einsum("bskgd,btkd->bkgst", qh, k_cache).astype(jnp.float32)
+    idx = jnp.asarray(idx)
+    if idx.ndim:  # (B,) per-row positions -> broadcast over (b, k, g, s, t)
+        idx = idx.reshape(b, 1, 1, 1, 1)
     valid = jnp.arange(k_cache.shape[1])[None, None, None, None, :] <= idx
     scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)

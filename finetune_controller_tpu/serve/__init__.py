@@ -1,0 +1,15 @@
+"""Continuous-batching inference serving (docs/serving.md).
+
+The lifecycle closer: the reference hands promoted artifacts to an unnamed
+external inference stack (SURVEY.md §3.4); this package serves them.
+
+* :mod:`engine`  — slot-based batch decode over the flax ``cache`` collection
+  (fixed decode slots, bucketed prefill, bounded compile count);
+* :mod:`batcher` — asyncio admission queue with backpressure + deadlines;
+* :mod:`loader`  — promoted-checkpoint resolution/loading + LoRA merge;
+* :mod:`service` — aiohttp routes mounted on the controller server.
+"""
+
+from .engine import BatchEngine, EngineConfig, GenRequest, GenResult
+
+__all__ = ["BatchEngine", "EngineConfig", "GenRequest", "GenResult"]

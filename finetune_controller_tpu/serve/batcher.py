@@ -1,0 +1,243 @@
+"""Asyncio admission layer over :class:`~finetune_controller_tpu.serve.engine.BatchEngine`.
+
+The engine is host-driven and synchronous; this wraps it in the control
+plane's event loop:
+
+* requests enter a bounded queue — **backpressure**: past ``max_queue`` the
+  caller gets :class:`QueueFull` (the service maps it to HTTP 429) instead of
+  unbounded memory growth;
+* a single drive task admits queued requests into free lanes between decode
+  steps (``max_batch`` lanes; a request joins mid-flight, never waits for the
+  batch to drain) and runs the jitted step in a worker thread so the loop
+  stays responsive;
+* **deadlines**: a request that waited in the queue past its deadline is
+  dropped with :class:`DeadlineExceeded` before ever touching the engine; an
+  admitted request past its deadline is evicted between steps;
+* ``max_wait_ms`` trades first-token latency for fill: with lanes free and
+  nothing queued the driver sleeps that long before re-checking rather than
+  spinning.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Any
+
+from .engine import BatchEngine, GenRequest, GenResult
+
+logger = logging.getLogger(__name__)
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity — shed load (HTTP 429)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it finished."""
+
+
+@dataclasses.dataclass
+class _Pending:
+    req: GenRequest
+    future: asyncio.Future
+    enqueued_at: float
+    deadline: float | None  # monotonic instant, None = no deadline
+
+
+class Batcher:
+    """One drive loop per served model; owns the engine between steps."""
+
+    def __init__(
+        self,
+        engine: BatchEngine,
+        *,
+        max_queue: int = 64,
+        max_wait_ms: float = 5.0,
+        default_timeout_s: float = 60.0,
+    ):
+        self.engine = engine
+        self.max_queue = max_queue
+        self.max_wait_ms = max_wait_ms
+        self.default_timeout_s = default_timeout_s
+        self._queue: list[_Pending] = []
+        self._inflight: dict[str, _Pending] = {}
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        # counters surfaced by /metrics
+        self.rejected_total = 0
+        self.deadline_drops_total = 0
+        self.completed_total = 0
+
+    # ---- public surface ---------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def slots_busy(self) -> int:
+        return self.engine.active_requests
+
+    def start(self) -> None:
+        # restart a dead drive task too: a crashed loop (engine fault) must
+        # not leave the batcher permanently accepting-but-never-serving
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._drive())
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        for p in self._queue + list(self._inflight.values()):
+            if not p.future.done():
+                p.future.set_exception(DeadlineExceeded("server shutting down"))
+        self._queue.clear()
+        self._inflight.clear()
+
+    async def submit(
+        self, req: GenRequest, *, timeout_s: float | None = None
+    ) -> GenResult:
+        """Queue a request and await its result (raises :class:`QueueFull`
+        immediately at capacity)."""
+        if self._closed:
+            raise QueueFull("batcher is closed")
+        if len(self._queue) >= self.max_queue:
+            self.rejected_total += 1
+            raise QueueFull(
+                f"admission queue at capacity ({self.max_queue}); retry later"
+            )
+        timeout = self.default_timeout_s if timeout_s is None else timeout_s
+        now = time.monotonic()
+        pending = _Pending(
+            req=req,
+            future=asyncio.get_running_loop().create_future(),
+            enqueued_at=now,
+            deadline=None if timeout <= 0 else now + timeout,
+        )
+        self._queue.append(pending)
+        self.start()
+        self._wake.set()
+        return await pending.future
+
+    # ---- drive loop -------------------------------------------------------
+
+    def _drop_expired(self) -> None:
+        now = time.monotonic()
+        keep: list[_Pending] = []
+        for p in self._queue:
+            if p.deadline is not None and now > p.deadline:
+                self.deadline_drops_total += 1
+                if not p.future.done():
+                    p.future.set_exception(DeadlineExceeded(
+                        f"request {p.req.request_id} spent its deadline queued"
+                    ))
+            else:
+                keep.append(p)
+        self._queue = keep
+        for rid, p in list(self._inflight.items()):
+            if p.deadline is not None and now > p.deadline:
+                result = self.engine.evict(rid)
+                self._inflight.pop(rid, None)
+                self.deadline_drops_total += 1
+                if not p.future.done():
+                    p.future.set_exception(DeadlineExceeded(
+                        f"request {rid} exceeded its deadline mid-decode"
+                    ))
+                if result is not None:
+                    logger.info("evicted %s after %d tokens", rid, result.steps)
+
+    def _admit_and_step(self, to_admit: list[_Pending]):
+        """Worker-thread body: admissions (prefill — a first-use XLA compile
+        plus a device forward, far too heavy for the event loop) and one
+        decode step.  Exceptions are RETURNED, never raised: the drive loop
+        must outlive any engine fault."""
+        admitted: list[tuple[_Pending, Any, BaseException | None]] = []
+        for p in to_admit:
+            try:
+                admitted.append((p, self.engine.admit(p.req), None))
+            # ftc: ignore[silent-except] -- not swallowed: the failure is forwarded to the submitting caller via future.set_exception
+            except Exception as e:  # PromptTooLong / bad request params
+                admitted.append((p, None, e))
+        step_err: BaseException | None = None
+        finished: list[GenResult] = []
+        if self.engine.active_requests:
+            try:
+                finished = self.engine.step()
+            # ftc: ignore[silent-except] -- not swallowed: returned to the drive loop, which fails every in-flight future with it and logs
+            except Exception as e:
+                step_err = e
+        return admitted, finished, step_err
+
+    async def _drive(self) -> None:
+        """Admit → step → resolve, forever; parks when fully idle.  All
+        engine work (prefill admissions AND the decode step) runs in a
+        worker thread so the control plane's event loop stays responsive."""
+        while not self._closed:
+            self._drop_expired()
+            to_admit: list[_Pending] = []
+            while self._queue and self.engine.free_slots > len(to_admit):
+                to_admit.append(self._queue.pop(0))
+            if not to_admit and not self._inflight:
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._wake.wait(), timeout=1.0
+                    )
+                except asyncio.TimeoutError:
+                    continue
+                continue
+            admitted, finished, step_err = await asyncio.to_thread(
+                self._admit_and_step, to_admit
+            )
+            for p, done, exc in admitted:
+                if exc is not None:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+                elif done is not None:  # finished on admission (eos/max_new=1)
+                    self.completed_total += 1
+                    if not p.future.done():
+                        p.future.set_result(done)
+                else:
+                    self._inflight[p.req.request_id] = p
+            for result in finished:
+                p = self._inflight.pop(result.request_id, None)
+                self.completed_total += 1
+                if p is not None and not p.future.done():
+                    p.future.set_result(result)
+            if step_err is not None:
+                # the decode step died (OOM, XLA fault, recompile budget):
+                # every in-flight request is lost — fail them LOUDLY instead
+                # of hanging clients, free the lanes, keep serving
+                logger.exception("decode step failed; failing %d in-flight "
+                                 "request(s)", len(self._inflight),
+                                 exc_info=step_err)
+                for rid, p in list(self._inflight.items()):
+                    self.engine.evict(rid)
+                    if not p.future.done():
+                        p.future.set_exception(step_err)
+                self._inflight.clear()
+
+    # ---- observability ----------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "queue_depth": self.queue_depth,
+            "slots_busy": self.slots_busy,
+            "slots_total": self.engine.config.slots,
+            "steps_total": self.engine.steps_total,
+            "tokens_generated_total": self.engine.tokens_generated_total,
+            "requests_completed_total": self.completed_total,
+            "requests_rejected_total": self.rejected_total,
+            "deadline_drops_total": self.deadline_drops_total,
+            "compilations": self.engine.compilations,
+        }

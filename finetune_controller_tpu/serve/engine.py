@@ -1,0 +1,393 @@
+"""Slot-based continuous-batching decode engine.
+
+The serving core: a fixed batch of ``slots`` decode lanes runs ONE jitted
+single-token step, and requests are admitted into free lanes between steps —
+a new request joins mid-flight instead of waiting for the batch to drain
+(the VirtualFlow idea: request slots decoupled from physical batch shape, so
+traffic shape never changes the compiled program).
+
+Compile-count contract (armed with ``analysis.recompile_guard``):
+
+* prefill compiles once per **prompt bucket** (prompts are right-padded to
+  the smallest configured bucket that fits; causality makes the pad slots
+  invisible to the real tokens);
+* the decode step compiles **once**, at ``(slots, 1)``, regardless of how
+  many requests come and go.
+
+Correctness anchor (proved in ``tests/test_serve.py``): greedy output for
+any request is bit-identical to single-request
+:func:`~finetune_controller_tpu.models.generate.cached_generate`, no matter
+what else shares the batch.  Three properties make that hold:
+
+* every per-row op in the decode path (matmul rows, RMSNorm, RoPE, the
+  per-row-masked ``single_token_attention``) is independent of other rows;
+* masked cache slots contribute exactly 0.0 to the softmax (the f32-min
+  fill underflows ``exp`` to zero), so a bucketed cache length is invisible;
+* the per-row cache index (``models/llama.py::_decode_attention``) lets each
+  lane write and attend at its own position.
+
+MoE configs are refused: expert-capacity routing couples rows through the
+shared capacity budget, so batching invariance cannot hold there.
+Multimodal configs are refused until the image prefix learns per-slot fill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.recompile_guard import RecompileGuard
+from ..models.generate import _sample
+
+logger = logging.getLogger(__name__)
+
+
+class PromptTooLong(ValueError):
+    """Prompt exceeds the largest configured prefill bucket."""
+
+
+class EngineBusy(RuntimeError):
+    """No free slot (the batcher queues instead of surfacing this)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Shape of the serving batch — these knobs bound the compile count."""
+
+    #: fixed decode lanes (the physical batch); the compiled decode step
+    #: always runs all of them, occupied or not
+    slots: int = 8
+    #: prefill pad targets, ascending; one prefill compile per bucket used
+    prompt_buckets: tuple[int, ...] = (32, 128, 512)
+    #: per-request cap on generated tokens; also sizes the KV cache
+    max_new_tokens: int = 128
+    #: compile budget: defaults to len(prompt_buckets) + 1 (the decode step);
+    #: the guard RAISES past it — an unexpected compile on the serve path is
+    #: a latency bug, not a warning
+    recompile_budget: int = 0
+
+    @property
+    def cache_len(self) -> int:
+        return max(self.prompt_buckets) + self.max_new_tokens
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prompt_buckets:
+            if prompt_len <= b:
+                return b
+        raise PromptTooLong(
+            f"prompt length {prompt_len} exceeds the largest prefill bucket "
+            f"{max(self.prompt_buckets)}"
+        )
+
+
+@dataclasses.dataclass
+class GenRequest:
+    request_id: str
+    tokens: list[int]                  # prompt token ids
+    max_new_tokens: int = 32
+    temperature: float = 0.0           # 0 = greedy (the bit-reproducible path)
+    top_k: int = 0
+    eos_id: int | None = None
+    seed: int = 0                      # sampling stream (temperature > 0)
+
+
+@dataclasses.dataclass
+class GenResult:
+    request_id: str
+    prompt_tokens: list[int]
+    generated: list[int]               # includes the eos token when hit
+    finish_reason: str                 # "length" | "eos" | "evicted"
+    steps: int                         # decode steps this request rode
+    admitted_at: float = 0.0
+    finished_at: float = 0.0
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: GenRequest | None = None
+    next_pos: int = 0                  # sequence position of the token to feed
+    last_token: int = 0                # token to feed at next_pos
+    generated: list[int] = dataclasses.field(default_factory=list)
+    rng: Any = None                    # per-request sampling stream
+    admitted_at: float = 0.0
+
+    @property
+    def active(self) -> bool:
+        return self.req is not None
+
+
+def _batch_axis(big_shape: tuple, small_shape: tuple) -> int:
+    """The axis where a B=1 prefill cache leaf maps into the slots-wide batch
+    cache leaf (scanned models carry a leading layer axis, so it is not a
+    fixed position)."""
+    for ax, (b, s) in enumerate(zip(big_shape, small_shape)):
+        if s == 1 and b > 1:
+            return ax
+    return 0  # shapes identical (slots == 1): write-in-place anywhere
+
+
+class BatchEngine:
+    """Continuous-batching decode over shared serving weights.
+
+    Host-driven: :meth:`admit` fills a free lane, :meth:`step` advances every
+    active lane one token and returns whatever finished.  The asyncio layer
+    (``serve/batcher.py``) owns queuing/deadlines; this class owns device
+    state and numerics.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        variables: dict,
+        config: EngineConfig | None = None,
+    ):
+        cfg = model.cfg
+        if getattr(cfg, "n_experts", 0):
+            raise ValueError(
+                "BatchEngine does not serve MoE configs: expert-capacity "
+                "routing couples batch rows, breaking batching invariance"
+            )
+        if getattr(cfg, "vision", None) is not None:
+            raise ValueError("BatchEngine serves text-only models (no pixels)")
+        self.config = config or EngineConfig()
+        self.variables = variables
+        self._dcfg = cfg.replace(
+            remat=False, attention_impl="xla",
+            max_seq_len=self.config.cache_len,
+        )
+        self._dmodel = type(model)(cfg=self._dcfg)
+        budget = self.config.recompile_budget or (
+            len(self.config.prompt_buckets) + 1
+        )
+        self.guard = RecompileGuard(budget, on_excess="raise",
+                                    name="serve-engine")
+        self._slots = [_Slot() for _ in range(self.config.slots)]
+        self._cache = self._init_cache()
+        self._fill, self._decode, self._insert = self._build_fns()
+        # counters the /metrics gauges read
+        self.steps_total = 0
+        self.tokens_generated_total = 0
+        self.requests_finished_total = 0
+
+    # ---- jitted pieces ----------------------------------------------------
+
+    def _init_cache(self):
+        """Zero batch cache shaped by a throwaway (slots, 1) decode trace."""
+        tokens = jnp.zeros((self.config.slots, 1), jnp.int32)
+        _, variables = self._dmodel.apply(
+            self.variables, tokens,
+            positions=jnp.zeros((self.config.slots, 1), jnp.int32),
+            deterministic=True, decode=True, mutable=("cache",),
+        )
+        return jax.tree.map(jnp.zeros_like, variables["cache"])
+
+    def _build_fns(self) -> tuple[Callable, Callable, Callable]:
+        dmodel = self._dmodel
+
+        @jax.jit
+        def fill(variables, tokens, last_idx, true_len):
+            """Prefill one request (B=1, right-padded to a bucket): logits at
+            the TRUE last prompt position + a cache whose index rows read
+            ``true_len`` (the model wrote the padded length)."""
+            logits, updated = dmodel.apply(
+                variables, tokens, deterministic=True, decode=True,
+                mutable=("cache",),
+            )
+            def fix_index(path, leaf):
+                name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
+                return jnp.full_like(leaf, true_len) if name == "index" else leaf
+
+            cache = jax.tree_util.tree_map_with_path(
+                fix_index, updated["cache"]
+            )
+            return jnp.take(logits, last_idx, axis=1).astype(jnp.float32), cache
+
+        @jax.jit
+        def decode(variables, cache, tokens, positions):
+            logits, updated = dmodel.apply(
+                {**variables, "cache": cache}, tokens, positions=positions,
+                deterministic=True, decode=True, mutable=("cache",),
+            )
+            return logits[:, -1].astype(jnp.float32), updated["cache"]
+
+        @jax.jit
+        def insert(cache, one, slot):
+            """Write a B=1 prefill cache into batch lane ``slot``."""
+
+            def put(big, small):
+                ax = _batch_axis(big.shape, small.shape)
+                starts = [jnp.asarray(0, jnp.int32)] * big.ndim
+                starts[ax] = jnp.asarray(slot, jnp.int32)
+                return jax.lax.dynamic_update_slice(big, small, tuple(starts))
+
+            return jax.tree.map(put, cache, one)
+
+        # insert has exactly one signature (the cache trees are fixed-shape),
+        # so it stays outside the guard: the budget counts the shapes that
+        # can vary with traffic — prefill buckets and the decode step
+        return (
+            self.guard.wrap(fill, "fill"),
+            self.guard.wrap(decode, "decode_step"),
+            insert,
+        )
+
+    # ---- slot management --------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return sum(1 for s in self._slots if not s.active)
+
+    @property
+    def active_requests(self) -> int:
+        return self.config.slots - self.free_slots
+
+    @property
+    def compilations(self) -> int:
+        return self.guard.compilations
+
+    def admit(self, req: GenRequest) -> GenResult | None:
+        """Prefill ``req`` into a free lane (raises :class:`EngineBusy` when
+        the batch is full, :class:`PromptTooLong` past the largest bucket).
+
+        Returns a :class:`GenResult` when the request finishes ON admission
+        (its first sampled token hits eos, or ``max_new_tokens == 1``) —
+        such a request never occupies a lane across a step."""
+        slot_id = next(
+            (i for i, s in enumerate(self._slots) if not s.active), None
+        )
+        if slot_id is None:
+            raise EngineBusy("all decode slots are busy")
+        plen = len(req.tokens)
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        cap = self.config.max_new_tokens
+        if req.max_new_tokens > cap:
+            raise ValueError(f"max_new_tokens {req.max_new_tokens} > engine cap {cap}")
+        bucket = self.config.bucket_for(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = req.tokens
+        logits, one = self._fill(
+            self.variables, jnp.asarray(padded),
+            jnp.asarray(plen - 1, jnp.int32), jnp.asarray(plen, jnp.int32),
+        )
+        self._cache = self._insert(self._cache, one, slot_id)
+        slot = self._slots[slot_id]
+        slot.req = req
+        slot.generated = []
+        slot.next_pos = plen
+        slot.rng = jax.random.PRNGKey(req.seed)
+        slot.admitted_at = time.monotonic()
+        return self._emit(slot, logits)
+
+    def evict(self, request_id: str) -> GenResult | None:
+        """Drop an in-flight request (deadline blown / client gone); frees
+        the lane immediately — the next :meth:`step` simply decodes garbage
+        into it until re-admission, which other rows never see."""
+        for slot in self._slots:
+            if slot.active and slot.req.request_id == request_id:
+                return self._finish(slot, "evicted")
+        return None
+
+    def _emit(self, slot: _Slot, logits) -> GenResult | None:
+        """Sample the next token for one lane from its logits row."""
+        req = slot.req
+        if req.temperature <= 0.0:
+            tok = int(np.argmax(np.asarray(logits[0], np.float32)))
+        else:
+            # the same _sample stream a single-request cached_generate(B=1,
+            # rng=PRNGKey(seed)) walks, so sampled decodes are reproducible
+            # per request, independent of batch-mates
+            nxt, slot.rng = _sample(
+                logits[:1], temperature=req.temperature, top_k=req.top_k,
+                rng=slot.rng,
+            )
+            tok = int(nxt[0])
+        slot.generated.append(tok)
+        slot.last_token = tok
+        self.tokens_generated_total += 1
+        if req.eos_id is not None and tok == req.eos_id:
+            return self._finish(slot, "eos")
+        if len(slot.generated) >= req.max_new_tokens:
+            return self._finish(slot, "length")
+        return None
+
+    def _finish(self, slot: _Slot, reason: str) -> GenResult:
+        req = slot.req
+        result = GenResult(
+            request_id=req.request_id,
+            prompt_tokens=list(req.tokens),
+            generated=list(slot.generated),
+            finish_reason=reason,
+            steps=len(slot.generated),
+            admitted_at=slot.admitted_at,
+            finished_at=time.monotonic(),
+        )
+        slot.req = None
+        slot.generated = []
+        slot.rng = None
+        self.requests_finished_total += 1
+        return result
+
+    # ---- the decode loop --------------------------------------------------
+
+    def step(self) -> list[GenResult]:
+        """One batched decode step; returns requests that finished on it."""
+        if self.active_requests == 0:
+            return []
+        tokens = np.zeros((self.config.slots, 1), np.int32)
+        positions = np.zeros((self.config.slots, 1), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot.active:
+                tokens[i, 0] = slot.last_token
+                positions[i, 0] = slot.next_pos
+        logits, self._cache = self._decode(
+            self.variables, self._cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+        )
+        self.steps_total += 1
+        host_logits = None
+        finished: list[GenResult] = []
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            slot.next_pos += 1
+            if slot.req.temperature <= 0.0:
+                if host_logits is None:
+                    host_logits = np.asarray(logits, np.float32)
+                row = host_logits[i:i + 1]
+            else:
+                row = logits[i:i + 1]
+            done = self._emit(slot, row)
+            if done is not None:
+                finished.append(done)
+        return finished
+
+    def run(self, requests: list[GenRequest]) -> dict[str, GenResult]:
+        """Synchronous convenience driver (tests/bench): admit everything —
+        overflow waits for a lane — and step until the batch drains."""
+        results: dict[str, GenResult] = {}
+        pending = list(requests)
+        guard_steps = itertools.count()
+        limit = sum(r.max_new_tokens for r in requests) + len(requests) + 8
+        while pending or self.active_requests:
+            while pending and self.free_slots:
+                done = self.admit(pending.pop(0))
+                if done is not None:  # finished on admission (eos / max_new=1)
+                    results[done.request_id] = done
+            for done in self.step():
+                results[done.request_id] = done
+            if next(guard_steps) > limit:  # pragma: no cover - safety valve
+                raise RuntimeError("engine.run failed to converge")
+        missing = [r.request_id for r in requests if r.request_id not in results]
+        if missing:  # pragma: no cover - engine invariant
+            raise RuntimeError(f"requests did not finish: {missing}")
+        return results

@@ -1,0 +1,241 @@
+"""Resolve and load a promoted job's checkpoint into serving weights.
+
+The deploy-bucket prefix a promotion copies (``controller/promotion.py``) is
+the artifact layout the trainer produced: ``resolved_config.json`` (the job
+spec — model preset + overrides + LoRA rank + training knobs),
+``checkpoints/step_N/`` (trainable tree + opt state), plus adapter/merged
+exports.  This module closes the loop the reference leaves open: it turns
+that prefix back into ``(model, variables)`` the serving engine can decode
+with.
+
+Load path: rebuild the model from ``resolved_config.json`` exactly as the
+trainer did (same preset, same seed ⇒ same frozen base for from-scratch test
+jobs; same ``pretrained_weights_dir`` for real ones), restore the latest
+checkpoint's trainable tree into it, then — for LoRA jobs — optionally fold
+the adapter deltas into the base kernels so the serving matmul count drops to
+the dense model's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Any
+
+from ..controller.objectstore import ObjectStore
+from ..controller.schemas import JobRecord, PromotionStatus
+from ..controller.statestore import StateStore
+
+logger = logging.getLogger(__name__)
+
+
+class ServeLoadError(RuntimeError):
+    """A job cannot be served; ``status`` maps to the HTTP response."""
+
+    def __init__(self, message: str, status: int = 409):
+        super().__init__(message)
+        self.status = status
+
+
+async def resolve_promoted(state: StateStore, job_id: str) -> JobRecord:
+    """The serve-side gate: only a COMPLETED promotion is servable.
+
+    IN_PROGRESS/DELETING would read a half-copied prefix; FAILED and
+    NOT_PROMOTED have no (trustworthy) deploy copy at all.  The error names
+    the observed state so operators see *why*, not just a 409.
+    """
+    job = await state.get_job(job_id)
+    if job is None:
+        raise ServeLoadError(f"job {job_id!r} not found", status=404)
+    if job.promotion_status is not PromotionStatus.COMPLETED:
+        raise ServeLoadError(
+            f"job {job_id!r} is not servable: promotion_status is "
+            f"{job.promotion_status.value!r} (serving requires 'completed' — "
+            "promote the job and wait for the copy to finish)"
+        )
+    if not job.promotion_uri:
+        raise ServeLoadError(
+            f"job {job_id!r} has promotion_status=completed but no "
+            "promotion_uri recorded — re-promote it"
+        )
+    return job
+
+
+async def fetch_promoted(
+    store: ObjectStore, promotion_uri: str, dest_dir: Path | str
+) -> Path:
+    """Stage the servable slice of the deploy prefix to a local directory:
+    the resolved job spec + the checkpoints tree (adapter/merged exports and
+    metrics are not needed to serve)."""
+    import shutil
+
+    dest = Path(dest_dir)
+    # stage FRESH: leftovers from a previous load (e.g. a higher step_N from
+    # a promotion that was since rolled back and re-promoted) would win the
+    # latest-checkpoint pick and silently serve stale weights
+    if dest.exists():
+        await asyncio.to_thread(shutil.rmtree, dest, ignore_errors=True)
+    prefix = promotion_uri.rstrip("/") + "/"
+    objs = await store.list_prefix(promotion_uri)
+    if not objs:
+        raise ServeLoadError(f"no objects under promotion uri {promotion_uri}")
+    n = 0
+    for obj in objs:
+        rel = obj["uri"][len(prefix):]
+        if rel != "resolved_config.json" and not rel.startswith("checkpoints/"):
+            continue
+        await store.get_file(obj["uri"], dest / rel)
+        n += 1
+    if n == 0:
+        raise ServeLoadError(
+            f"promotion prefix {promotion_uri} holds no resolved_config.json/"
+            "checkpoints — was this job trained by this stack?"
+        )
+    logger.info("staged %d promoted objects <- %s", n, promotion_uri)
+    return dest
+
+
+def merge_lora_variables(model_cfg: Any, variables: dict) -> tuple[Any, dict]:
+    """Fold LoRA deltas into the base kernels: ``W' = W + (α/r)·A·B``.
+
+    Returns a rank-0 config and a variables tree without the ``lora``
+    collection — the serving forward then runs the dense matmul count.  The
+    merge happens in the param dtype (f32), matching ``hf_export``'s merged
+    checkpoint math.  Quantized bases refuse (int4 kernels cannot absorb a
+    dense delta); serve those unmerged.
+    """
+    import jax.numpy as jnp
+
+    if "lora" not in variables:
+        return model_cfg, variables
+    if getattr(model_cfg, "quantize_base", False):
+        raise ServeLoadError(
+            "cannot merge LoRA into an int4-quantized base; serve unmerged"
+        )
+    scale = model_cfg.lora.alpha / model_cfg.lora.rank
+
+    def merge(params: dict, lora: dict) -> dict:
+        out = {}
+        for key, sub in params.items():
+            if key in lora and isinstance(lora[key], dict) \
+                    and "lora_a" in lora[key]:
+                a, b = lora[key]["lora_a"], lora[key]["lora_b"]
+                kernel = sub["kernel"]
+                # jnp.matmul batches over the leading layer axis of scanned
+                # models ((L, in, r) @ (L, r, out)) and is a plain matmul on
+                # unscanned ones
+                delta = jnp.matmul(
+                    a.astype(jnp.float32), b.astype(jnp.float32)
+                ) * scale
+                out[key] = {
+                    **sub, "kernel": (
+                        kernel.astype(jnp.float32) + delta
+                    ).astype(kernel.dtype),
+                }
+            elif key in lora and isinstance(sub, dict):
+                out[key] = merge(sub, lora[key])
+            else:
+                out[key] = sub
+        return out
+
+    merged = dict(variables)
+    lora = merged.pop("lora")
+    merged["params"] = merge(dict(merged["params"]), dict(lora))
+    from ..models.lora import LoRAConfig
+
+    merged_cfg = model_cfg.replace(
+        lora=LoRAConfig(rank=0, alpha=model_cfg.lora.alpha,
+                        targets=model_cfg.lora.targets)
+    )
+    return merged_cfg, merged
+
+
+def load_serving_model(
+    local_dir: Path | str, *, merge_lora: bool = True
+) -> tuple[Any, dict, dict]:
+    """Build ``(model, variables, meta)`` from a staged promoted prefix.
+
+    Heavy (JAX init + checkpoint IO) and synchronous — callers run it in a
+    thread (``asyncio.to_thread``) off the event loop.
+    """
+    local_dir = Path(local_dir)
+    spec_path = local_dir / "resolved_config.json"
+    if not spec_path.exists():
+        raise ServeLoadError(
+            f"{spec_path} missing: the promoted prefix carries no job spec"
+        )
+    with open(spec_path) as f:
+        spec = json.load(f)
+
+    from ..train.checkpoint import CheckpointManager
+    from ..train.cli import build_model_config, build_train_config
+    from ..train.trainer import Trainer
+
+    model_cfg = build_model_config(spec)
+    if getattr(model_cfg, "vision", None) is not None:
+        raise ServeLoadError("serving multimodal checkpoints is not supported yet")
+    if getattr(model_cfg, "n_experts", 0):
+        raise ServeLoadError(
+            "serving MoE checkpoints is not supported (batching invariance "
+            "does not hold under capacity routing)"
+        )
+    train_cfg = build_train_config(spec)
+    trainer = Trainer(model_cfg, train_cfg)
+    state = trainer.init_state()
+
+    ckpt_dir = local_dir / "checkpoints"
+    if not ckpt_dir.is_dir() or not os.listdir(ckpt_dir):
+        raise ServeLoadError(
+            f"no checkpoints under {ckpt_dir} — the job produced none"
+        )
+    ckpt = CheckpointManager(str(ckpt_dir))
+    latest = ckpt.latest_step()
+    if latest is None:
+        raise ServeLoadError(f"no committed checkpoint steps under {ckpt_dir}")
+    template = trainer.state_to_host(state)
+    host = ckpt.restore(latest, like=template)
+
+    pretrained = spec.get("model", {}).get("weights_dir")
+    if pretrained:
+        state = trainer.load_pretrained(state, pretrained)
+    variables = trainer._assemble(state.frozen, host["trainable"])
+
+    model = trainer.model
+    merged = False
+    if merge_lora and "lora" in variables \
+            and not getattr(model_cfg, "quantize_base", False):
+        model_cfg, variables = merge_lora_variables(model_cfg, variables)
+        model = type(model)(cfg=model_cfg)
+        merged = True
+
+    meta = {
+        "preset": spec.get("model", {}).get("preset"),
+        "checkpoint_step": latest,
+        "lora_merged": merged,
+        "vocab_size": model_cfg.vocab_size,
+        "max_seq_len": model_cfg.max_seq_len,
+    }
+    logger.info("serving model ready: %s", meta)
+    return model, variables, meta
+
+
+async def load_promoted(
+    state: StateStore,
+    store: ObjectStore,
+    job_id: str,
+    work_dir: Path | str,
+    *,
+    merge_lora: bool = True,
+) -> tuple[Any, dict, dict]:
+    """resolve → stage → load, the whole serve-side path for one job."""
+    job = await resolve_promoted(state, job_id)
+    local = await fetch_promoted(store, job.promotion_uri, Path(work_dir) / job_id)
+    model, variables, meta = await asyncio.to_thread(
+        load_serving_model, local, merge_lora=merge_lora
+    )
+    meta["job_id"] = job_id
+    meta["promotion_uri"] = job.promotion_uri
+    return model, variables, meta

@@ -1,0 +1,264 @@
+"""HTTP serving surface, mounted on the controller server.
+
+Routes (``controller/server.py::build_app`` mounts them; the app's auth/CORS
+middlewares apply — a bearer token that can read a job can generate from it):
+
+* ``POST {prefix}/jobs/{job_id}/generate`` — generate from a promoted job's
+  checkpoint (auto-loads on first use when ``serve_autoload`` is on);
+* ``POST {prefix}/admin/serve/{job_id}/load`` / ``.../unload`` — explicit
+  model lifecycle (admin);
+* ``GET {prefix}/admin/serve`` — per-model engine/batcher stats (admin).
+
+The manager refuses jobs whose promotion is not COMPLETED
+(``serve/loader.py::resolve_promoted``) — serving a half-copied or deleted
+deploy prefix would decode garbage with a 200 status.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+import uuid
+from pathlib import Path
+from typing import Any
+
+from aiohttp import web
+
+from .batcher import Batcher, DeadlineExceeded, QueueFull
+from .engine import BatchEngine, EngineConfig, GenRequest, GenResult, PromptTooLong
+from .loader import ServeLoadError, load_promoted
+
+logger = logging.getLogger(__name__)
+
+SERVE_KEY = web.AppKey("serve", object)
+
+
+@dataclasses.dataclass
+class _Session:
+    job_id: str
+    batcher: Batcher
+    meta: dict[str, Any]
+    loaded_at: float
+
+
+class ServeManager:
+    """Loaded serving sessions, one engine+batcher per promoted job."""
+
+    def __init__(self, state, store, settings):
+        self.state = state
+        self.store = store
+        self.settings = settings
+        self.sessions: dict[str, _Session] = {}
+        self._load_lock = asyncio.Lock()
+        self.work_dir = Path(settings.state_path) / "serve_cache"
+
+    def _engine_config(self) -> EngineConfig:
+        s = self.settings
+        return EngineConfig(
+            slots=s.serve_slots,
+            prompt_buckets=tuple(s.serve_prompt_buckets),
+            max_new_tokens=s.serve_max_new_tokens,
+        )
+
+    async def load(self, job_id: str) -> dict[str, Any]:
+        """Idempotent: returns the existing session's meta when loaded."""
+        existing = self.sessions.get(job_id)
+        if existing is not None:
+            return existing.meta
+        async with self._load_lock:  # single-flight per manager
+            existing = self.sessions.get(job_id)
+            if existing is not None:
+                return existing.meta
+            model, variables, meta = await load_promoted(
+                self.state, self.store, job_id, self.work_dir,
+                merge_lora=self.settings.serve_merge_lora,
+            )
+            # engine construction traces a forward to shape the batch cache —
+            # device work that must not run on the event loop
+            engine = await asyncio.to_thread(
+                BatchEngine, model, variables, self._engine_config()
+            )
+            batcher = Batcher(
+                engine,
+                max_queue=self.settings.serve_max_queue,
+                max_wait_ms=self.settings.serve_max_wait_ms,
+                default_timeout_s=self.settings.serve_request_timeout_s,
+            )
+            self.sessions[job_id] = _Session(
+                job_id=job_id, batcher=batcher, meta=meta,
+                loaded_at=time.time(),
+            )
+            logger.info("serve session loaded for %s: %s", job_id, meta)
+            return meta
+
+    async def unload(self, job_id: str) -> bool:
+        session = self.sessions.pop(job_id, None)
+        if session is None:
+            return False
+        await session.batcher.close()
+        logger.info("serve session unloaded for %s", job_id)
+        return True
+
+    async def generate(
+        self, job_id: str, req: GenRequest, *, timeout_s: float | None = None
+    ) -> tuple[GenResult, dict[str, Any]]:
+        session = self.sessions.get(job_id)
+        if session is None:
+            if not self.settings.serve_autoload:
+                raise ServeLoadError(
+                    f"job {job_id!r} is not loaded for serving; "
+                    f"POST /admin/serve/{job_id}/load first", status=409,
+                )
+            await self.load(job_id)
+            session = self.sessions.get(job_id)
+            if session is None:  # admin unloaded while we were loading
+                raise ServeLoadError(
+                    f"job {job_id!r} was unloaded while loading; retry",
+                    status=409,
+                )
+        result = await session.batcher.submit(req, timeout_s=timeout_s)
+        return result, session.meta
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            job_id: session.batcher.stats()
+            for job_id, session in self.sessions.items()
+        }
+
+    async def close(self) -> None:
+        for job_id in list(self.sessions):
+            await self.unload(job_id)
+
+
+# ---------------------------------------------------------------------------
+# Handlers (lazy-import the server module: it imports us at build time)
+# ---------------------------------------------------------------------------
+
+
+def _json_error(status: int, detail: Any) -> web.Response:
+    return web.json_response({"detail": detail}, status=status)
+
+
+def _parse_gen_request(body: dict[str, Any], settings) -> GenRequest:
+    tokens = body.get("tokens")
+    if not isinstance(tokens, list) or not tokens \
+            or not all(isinstance(t, int) and t >= 0 for t in tokens):
+        raise ValueError("'tokens' must be a non-empty list of token ids")
+    max_new = body.get("max_new_tokens", settings.serve_default_max_new_tokens)
+    if not isinstance(max_new, int) or max_new < 1:
+        raise ValueError("'max_new_tokens' must be a positive integer")
+    temperature = float(body.get("temperature", 0.0))
+    top_k = int(body.get("top_k", 0))
+    eos_id = body.get("eos_id")
+    if eos_id is not None and not isinstance(eos_id, int):
+        raise ValueError("'eos_id' must be an integer token id")
+    return GenRequest(
+        request_id=body.get("request_id") or f"gen-{uuid.uuid4().hex[:12]}",
+        tokens=tokens,
+        max_new_tokens=max_new,
+        temperature=temperature,
+        top_k=top_k,
+        eos_id=eos_id,
+        seed=int(body.get("seed", 0)),
+    )
+
+
+async def generate_job(request: web.Request) -> web.Response:
+    """POST /jobs/{job_id}/generate — tokens in, tokens out."""
+    from ..controller.server import (
+        LIMITER_KEY,
+        RUNTIME_KEY,
+        _json_body,
+        _owned_job,
+    )
+
+    rt = request.app[RUNTIME_KEY]
+    limiter = request.app[LIMITER_KEY]
+    user = request.get("user")
+    uid = user.user_id if user else request.remote or "anon"
+    if not await limiter.check(uid, "generate"):
+        return _json_error(429, "rate limit exceeded (generate)")
+    job = await _owned_job(request, request.match_info["job_id"])
+    body = await _json_body(request)
+    manager: ServeManager = request.app[SERVE_KEY]
+    try:
+        req = _parse_gen_request(body, rt.settings)
+        timeout_raw = body.get("timeout_s")
+        timeout_s = None if timeout_raw is None else float(timeout_raw)
+    except (TypeError, ValueError) as e:
+        return _json_error(400, str(e))
+    t0 = time.monotonic()
+    try:
+        result, meta = await manager.generate(
+            job.job_id, req, timeout_s=timeout_s
+        )
+    except QueueFull as e:
+        return web.Response(
+            status=429, headers={"Retry-After": "1"},
+            body=json.dumps({"detail": str(e)}).encode(),
+            content_type="application/json",
+        )
+    except DeadlineExceeded as e:
+        return _json_error(504, str(e))
+    except (PromptTooLong, ValueError) as e:
+        return _json_error(400, str(e))
+    except ServeLoadError as e:
+        return _json_error(e.status, str(e))
+    return web.json_response(
+        {
+            "job_id": job.job_id,
+            "request_id": result.request_id,
+            "prompt_tokens": result.prompt_tokens,
+            "tokens": result.generated,
+            "finish_reason": result.finish_reason,
+            "latency_ms": round((time.monotonic() - t0) * 1000, 2),
+            "model": {
+                "checkpoint_step": meta.get("checkpoint_step"),
+                "lora_merged": meta.get("lora_merged"),
+            },
+        }
+    )
+
+
+async def admin_serve_load(request: web.Request) -> web.Response:
+    from ..controller.server import _admin
+
+    _admin(request)
+    manager: ServeManager = request.app[SERVE_KEY]
+    try:
+        meta = await manager.load(request.match_info["job_id"])
+    except ServeLoadError as e:
+        return _json_error(e.status, str(e))
+    return web.json_response({"message": "loaded", "model": meta})
+
+
+async def admin_serve_unload(request: web.Request) -> web.Response:
+    from ..controller.server import _admin
+
+    _admin(request)
+    manager: ServeManager = request.app[SERVE_KEY]
+    if not await manager.unload(request.match_info["job_id"]):
+        return _json_error(404, "job is not loaded")
+    return web.json_response({"message": "unloaded"})
+
+
+async def admin_serve_status(request: web.Request) -> web.Response:
+    from ..controller.server import _admin
+
+    _admin(request)
+    manager: ServeManager = request.app[SERVE_KEY]
+    return web.json_response({"sessions": manager.stats()})
+
+
+def add_serve_routes(app: web.Application, prefix: str) -> None:
+    app.router.add_post(f"{prefix}/jobs/{{job_id}}/generate", generate_job)
+    app.router.add_post(
+        f"{prefix}/admin/serve/{{job_id}}/load", admin_serve_load
+    )
+    app.router.add_post(
+        f"{prefix}/admin/serve/{{job_id}}/unload", admin_serve_unload
+    )
+    app.router.add_get(f"{prefix}/admin/serve", admin_serve_status)

@@ -400,11 +400,19 @@ class Trainer:
         fn = self._step_jits.get(key)
         if fn is None:
             batch_sh = {k: self._batch_leaf_sharding(batch[k]) for k in batch}
+            # Donating the state reuses its buffers for the output — the HBM
+            # lever that lets big states fit on TPU.  On the CPU test backend
+            # it buys nothing (host RAM, no HBM pressure) and, combined with
+            # the persistent compilation cache, deserialized executables have
+            # been observed mis-aliasing donated scalars under a long test
+            # session (a resumed step counter reading back as garbage), so
+            # CPU skips donation — numerics are identical either way.
+            donate = (0,) if jax.default_backend() != "cpu" else ()
             fn = jax.jit(
                 self._train_step,
                 in_shardings=(self._state_shardings, batch_sh),
                 out_shardings=(self._state_shardings, None),
-                donate_argnums=(0,),
+                donate_argnums=donate,
             )
             if self._recompile_guard is not None:
                 fn = self._recompile_guard.wrap(fn, label=f"step:{','.join(key)}")
@@ -1125,7 +1133,14 @@ class Trainer:
                     # Collective gather on all hosts; rank 0 persists.
                     host_state = self.state_to_host(state)
                     if jax.process_index() == 0:
-                        ckpt.save(step_idx + 1, host_state)
+                        # Mid-run saves overlap the next steps (the goodput
+                        # lever); the LAST save has nothing left to overlap
+                        # with — commit it synchronously so no background
+                        # save thread races the teardown below (prefetch
+                        # close / profiler stop), a race observed as a rare
+                        # interpreter crash on fast CPU test runs.
+                        ckpt.save(step_idx + 1, host_state,
+                                  blocking=last or preempt)
                 if preempt:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
                     raise SystemExit(143)

@@ -1,17 +1,19 @@
 #!/usr/bin/env bash
-# The one-command CI gate: static analysis, the fast chaos suite, then the
-# tier-1 test suite.
+# The one-command CI gate: static analysis, the fast serve suite, the fast
+# chaos suite, then the tier-1 test suite.
 #
-#   scripts/ci_check.sh            # lint + chaos-fast + tests
+#   scripts/ci_check.sh            # lint + serve-fast + chaos-fast + tests
 #   scripts/ci_check.sh --lint-only
 #
 # Lint: `ftc-lint finetune_controller_tpu/` must exit 0 — every finding is
 # fixed or carries a justified `# ftc: ignore[rule-id] -- reason`
 # (docs/static_analysis.md).
+# Serve-fast: the continuous-batching inference suite (docs/serving.md) —
+# batching invariance is THE serving correctness anchor, and a broken
+# engine should fail in seconds, before the full tier-1 wall-clock.
 # Chaos-fast: the resilience/fault-injection suite (docs/resilience.md)
-# runs first and alone — a broken recovery path should fail in seconds,
-# before the full tier-1 wall-clock is spent.  The full kill→resume loss-
-# trajectory proof is marked `slow` and excluded here (run it with
+# runs next and alone.  The full kill→resume loss-trajectory proof is
+# marked `slow` and excluded here (run it with
 # `pytest tests/test_chaos.py -m slow`).
 # Tests: the tier-1 command from ROADMAP.md.
 set -uo pipefail
@@ -28,6 +30,19 @@ fi
 
 if [ "${1:-}" = "--lint-only" ]; then
     exit 0
+fi
+
+echo "== serve-fast (batching invariance + metrics) ==" >&2
+# no 'not slow' filter here: the serve suite IS this stage's whole job, so
+# its slow-marked extras (sampled-decode parity) run too — they are excluded
+# from tier-1 below only to protect that stage's wall-clock budget
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serve.py tests/test_metrics_endpoint.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+serve_rc=$?
+if [ "$serve_rc" -ne 0 ]; then
+    echo "ci_check: serve-fast failed (exit $serve_rc)" >&2
+    exit "$serve_rc"
 fi
 
 echo "== chaos-fast (resilience) ==" >&2

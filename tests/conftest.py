@@ -19,6 +19,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the test suite: every run re-compiles
+# the same tiny-model programs (train steps per remat policy, decode fills,
+# pipeline stages ...), which dominates tier-1 wall-clock on a small CPU box.
+# Caching the compiled executables across runs (keyed by HLO hash — safe) cuts
+# repeat-run time substantially.  Opt out with FTC_TEST_XLA_CACHE=0 when
+# debugging compiler flags or suspecting a stale-cache artifact.
+if os.environ.get("FTC_TEST_XLA_CACHE", "1") != "0":
+    _xla_cache = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), os.pardir, ".cache", "xla")
+    )
+    jax.config.update("jax_compilation_cache_dir", _xla_cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import asyncio  # noqa: E402
 
 import pytest  # noqa: E402

@@ -158,6 +158,105 @@ def test_pagination_and_computed_fields(store):
     run(go())
 
 
+def test_promotion_cas_state_machine(store):
+    """ISSUE 4 satellite: every promotion transition is a compare-and-swap.
+    promote-while-IN_PROGRESS, promote-while-DELETING, and a stale task's
+    completion write all LOSE in the store, not in handler guards."""
+    PROMOTE_FROM = [
+        PromotionStatus.NOT_PROMOTED,
+        PromotionStatus.FAILED,
+        PromotionStatus.COMPLETED,
+    ]
+    UNPROMOTE_FROM = [PromotionStatus.COMPLETED, PromotionStatus.FAILED]
+
+    async def go():
+        await store.connect()
+        await store.create_job(_job())
+        jid = "llama-abc12345"
+
+        # claim promote; a second promote and an unpromote both lose
+        assert await store.begin_promotion(
+            jid, PromotionStatus.IN_PROGRESS, "d://1", expect_from=PROMOTE_FROM
+        )
+        assert not await store.begin_promotion(
+            jid, PromotionStatus.IN_PROGRESS, "d://2", expect_from=PROMOTE_FROM
+        )
+        assert not await store.begin_promotion(
+            jid, PromotionStatus.DELETING, "d://1", expect_from=UNPROMOTE_FROM
+        )
+
+        # the winning task settles via CAS from the state it claimed
+        assert await store.transition_job_promotion(
+            jid, [PromotionStatus.IN_PROGRESS], PromotionStatus.COMPLETED, "d://1"
+        )
+        # ... and its now-stale duplicate settle is a no-op
+        assert not await store.transition_job_promotion(
+            jid, [PromotionStatus.IN_PROGRESS], PromotionStatus.FAILED
+        )
+        job = await store.get_job(jid)
+        assert job.promotion_status is PromotionStatus.COMPLETED
+
+        # unpromote claims DELETING; promote-while-DELETING is refused
+        assert await store.begin_promotion(
+            jid, PromotionStatus.DELETING, "d://1", expect_from=UNPROMOTE_FROM
+        )
+        assert not await store.begin_promotion(
+            jid, PromotionStatus.IN_PROGRESS, "d://3", expect_from=PROMOTE_FROM
+        )
+        # a promote task's stale COMPLETED write cannot stomp the delete
+        assert not await store.transition_job_promotion(
+            jid, [PromotionStatus.IN_PROGRESS], PromotionStatus.COMPLETED
+        )
+        assert await store.transition_job_promotion(
+            jid, [PromotionStatus.DELETING], PromotionStatus.NOT_PROMOTED
+        )
+        job = await store.get_job(jid)
+        assert job.promotion_status is PromotionStatus.NOT_PROMOTED
+
+    run(go())
+
+
+def test_promotion_task_settle_respects_concurrent_transition(store, tmp_path):
+    """A PromotionTask that lost its claim (crash-recovery marked the job
+    FAILED; the user re-promoted) must not overwrite the newer state when its
+    stale copy finally completes."""
+    from finetune_controller_tpu.controller.promotion import PromotionTask
+
+    async def go():
+        await store.connect()
+        await store.create_job(_job())
+        jid = "llama-abc12345"
+        obj_store = LocalObjectStore(tmp_path / "objects")
+        await obj_store.put_bytes("obj://artifacts/a/x.bin", b"payload")
+        promo = PromotionTask(store, obj_store)
+
+        assert await store.begin_promotion(
+            jid, PromotionStatus.IN_PROGRESS, "obj://deploy/a"
+        )
+        # another process's recovery sweep declares the attempt dead ...
+        assert await store.transition_job_promotion(
+            jid, [PromotionStatus.IN_PROGRESS], PromotionStatus.FAILED
+        )
+        # ... and a fresh promote claims the next attempt
+        assert await store.begin_promotion(
+            jid, PromotionStatus.IN_PROGRESS, "obj://deploy/b"
+        )
+        await store.transition_job_promotion(
+            jid, [PromotionStatus.IN_PROGRESS], PromotionStatus.COMPLETED,
+            "obj://deploy/b",
+        )
+        # the STALE task finally finishes its copy: its settle must lose
+        await promo.promote_job_task(
+            jid, "obj://artifacts/a", "obj://deploy/a"
+        )
+        job = await store.get_job(jid)
+        assert job.promotion_status is PromotionStatus.COMPLETED
+        assert job.promotion_uri == "obj://deploy/b"
+        await obj_store.close()
+
+    run(go())
+
+
 def test_delete_archives(store):
     async def go():
         await store.connect()

@@ -72,3 +72,4 @@ def test_ctl_submit_watch_metrics_logs(tmp_path, capsys):
             await rt.close()
 
     run_async(main())
+

@@ -125,6 +125,33 @@ def test_cached_generate_eos_and_sampling_shapes():
         seen = seen or t == 19
 
 
+def test_decode_fns_cache_is_lru_not_clear_all():
+    """N+1 alternating decode configs must thrash ONE cache slot, not clear
+    the whole cache (the old behavior re-traced all N+1 forever)."""
+    from finetune_controller_tpu.models import generate as G
+
+    G._DECODE_FNS_CACHE.clear()
+    n = G._DECODE_FNS_MAX
+    cfgs = [
+        PRESETS["tiny-test"].replace(max_seq_len=128 + i) for i in range(n + 1)
+    ]
+    fns = [G._decode_fns(LlamaForCausalLM, c) for c in cfgs]
+
+    # the (n+1)-th insert evicted only the least-recently-used entry (cfg 0)
+    assert len(G._DECODE_FNS_CACHE) == n
+    assert (LlamaForCausalLM, cfgs[0]) not in G._DECODE_FNS_CACHE
+    for c, (fill, step) in zip(cfgs[1:], fns[1:]):
+        hit_fill, hit_step = G._decode_fns(LlamaForCausalLM, c)
+        assert hit_fill is fill and hit_step is step
+
+    # re-admitting cfg 0 evicts exactly the new LRU (cfg 1), nothing else
+    G._decode_fns(LlamaForCausalLM, cfgs[0])
+    assert (LlamaForCausalLM, cfgs[1]) not in G._DECODE_FNS_CACHE
+    for c in cfgs[2:]:
+        assert (LlamaForCausalLM, c) in G._DECODE_FNS_CACHE
+    G._DECODE_FNS_CACHE.clear()
+
+
 def test_multimodal_cached_generate_matches_oracle():
     """Round-5: the KV-cached decode covers LLaVA — fill caches the
     [image; text] prefix, decode steps run at absolute positions; greedy

@@ -1,0 +1,113 @@
+"""The /metrics Prometheus endpoint: exposition format, content type, label
+escaping, and the serve-plane gauges (ISSUE 4 satellite — the endpoint
+shipped untested).
+"""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from conftest import run_async
+from finetune_controller_tpu.controller.server import (
+    PROMETHEUS_CONTENT_TYPE,
+    prom_escape,
+)
+
+#: exposition-format sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+$"
+)
+
+
+def test_prom_escape():
+    assert prom_escape('plain') == "plain"
+    assert prom_escape('a"b') == 'a\\"b'
+    assert prom_escape("a\\b") == "a\\\\b"
+    assert prom_escape("a\nb") == "a\\nb"
+    # composed: every dangerous char in one value stays one logical line
+    hostile = 'x"\\\n'
+    escaped = prom_escape(hostile)
+    assert "\n" not in escaped
+
+
+def test_metrics_format_and_content_type(tmp_path):
+    from test_api import _client, _runtime
+
+    async def main():
+        client = await _client(_runtime(tmp_path), with_monitor=False)
+        r = await client.get("/metrics")
+        assert r.status == 200
+        # text/plain; version=0.0.4 is the Prometheus exposition contract;
+        # a bare text/plain parses but is ambiguous to scrapers
+        assert r.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+        body = await r.text()
+        assert body.endswith("\n")
+        types_seen = set()
+        for line in body.strip().split("\n"):
+            if line.startswith("# TYPE "):
+                _, _, name, kind = line.split(" ")
+                assert kind in ("counter", "gauge"), line
+                types_seen.add(name)
+            else:
+                assert _SAMPLE_RE.match(line), f"malformed sample: {line!r}"
+        assert "ftc_monitor_ticks_total" in types_seen
+        assert "ftc_jobs_active" in types_seen
+        await client.close()
+
+    run_async(main())
+
+
+def test_metrics_jobs_active_counts(tmp_path):
+    from test_api import _client, _runtime
+    from finetune_controller_tpu.controller.schemas import JobRecord
+
+    async def main():
+        rt = _runtime(tmp_path)
+        client = await _client(rt, with_monitor=False)
+        await rt.state.create_job(JobRecord(
+            job_id="m-1", user_id="dev-user", model_name="tiny-test-lora",
+        ))
+        body = await (await client.get("/metrics")).text()
+        # a non-final job shows up under its active status label
+        assert 'ftc_jobs_active{status="queued"} 1' in body
+        await client.close()
+
+    run_async(main())
+
+
+@pytest.mark.slow  # runs on every ci_check gate via the serve-fast stage
+def test_metrics_serve_gauges_after_generate(tmp_path):
+    """The serve plane exports queue/slot/token gauges per loaded job
+    (fabricated promoted job — no trainer subprocess, keeps tier-1 fast)."""
+    from test_api import _client
+    from test_serve import _fabricate_promoted_job, _serve_runtime
+
+    async def main():
+        rt = _serve_runtime(tmp_path)
+        client = await _client(rt, with_monitor=False)
+        job_id = await _fabricate_promoted_job(rt)
+        r = await client.post(
+            f"/api/v1/jobs/{job_id}/generate",
+            json={"tokens": [5, 9, 2, 7], "max_new_tokens": 5},
+        )
+        assert r.status == 200, await r.text()
+
+        body = await (await client.get("/metrics")).text()
+        assert "ftc_serve_models_loaded 1" in body
+        label = f'job_id="{job_id}"'
+        assert f"ftc_serve_tokens_generated_total{{{label}}} 5" in body
+        assert f"ftc_serve_requests_completed_total{{{label}}} 1" in body
+        assert f"ftc_serve_slots_total{{{label}}} {rt.settings.serve_slots}" in body
+        assert f"ftc_serve_queue_depth{{{label}}} 0" in body
+        assert f"ftc_serve_slots_busy{{{label}}} 0" in body
+        # decode-step compile count stayed within the bucket-bounded budget
+        m = re.search(
+            rf"ftc_serve_compilations\{{{re.escape(label)}\}} (\d+)", body
+        )
+        assert m is not None
+        assert int(m.group(1)) <= len(rt.settings.serve_prompt_buckets) + 1
+        await client.close()
+
+    run_async(main())

@@ -122,11 +122,14 @@ def test_remat_policies_are_numerically_identical():
     from finetune_controller_tpu.models.llama import remat_policy_fn
 
     toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    # the policy only affects the backward pass, never the parameters —
+    # one init serves every policy (repeating it was pure wall-clock)
+    _, init_model = _tiny(lora_rank=4, remat_policy="full")
+    vars_ = init_model.init_variables(jax.random.PRNGKey(0), batch=2, seq=16)
+    frozen = {"params": vars_["params"]}
 
     def loss_and_grads(policy):
         cfg, model = _tiny(lora_rank=4, remat_policy=policy)
-        vars_ = model.init_variables(jax.random.PRNGKey(0), batch=2, seq=16)
-        frozen = {"params": vars_["params"]}
 
         def loss_fn(lora):
             logits = model.apply({**frozen, "lora": lora}, toks)
