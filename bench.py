@@ -496,6 +496,105 @@ def _measure_chaos_recovery() -> dict:
         return asyncio.run(run(Path(d)))
 
 
+def _measure_sched() -> dict:
+    """BENCH_MODE=sched: fair-share scheduler vs the FIFO baseline.
+
+    Replays the canonical head-of-line-blocking trace (``sched/sim.py``:
+    long low-priority batch jobs saturate the cluster, then a stream of
+    short higher-tenant jobs arrives) through BOTH schedulers on the
+    deterministic simulator and reports, against FIFO on the same seeded
+    trace: makespan, Jain fairness index over entitlement-normalised
+    contention chip-seconds, p95/p50 queue wait for the small (1-chip)
+    jobs — the head-of-line-blocking number — plus the fair-share side's
+    preemption count and preempt→readmit latency (the checkpoint-aware
+    eviction cost).  Pure control-flow: no accelerator, milliseconds.
+
+    Knobs: BENCH_SCHED_SEED, BENCH_SCHED_CHIPS, BENCH_SCHED_BIG,
+    BENCH_SCHED_SMALL.
+    """
+    from finetune_controller_tpu.controller.backends.scheduler import (
+        GangScheduler,
+    )
+    from finetune_controller_tpu.sched import FairShareScheduler
+    from finetune_controller_tpu.sched.sim import (
+        TRACE_QUEUES,
+        ClusterSim,
+        percentile,
+        sim_catalog,
+        synthetic_trace,
+    )
+
+    seed = int(os.environ.get("BENCH_SCHED_SEED", "0"))
+    chips = int(os.environ.get("BENCH_SCHED_CHIPS", "8"))
+    n_big = int(os.environ.get("BENCH_SCHED_BIG", "4"))
+    n_small = int(os.environ.get("BENCH_SCHED_SMALL", "24"))
+    catalog = sim_catalog(chips)
+    trace = synthetic_trace(seed, n_big=n_big, n_small=n_small)
+
+    def leg(factory) -> tuple[dict, float, float]:
+        # both legs score fairness against the SAME entitlements
+        report = ClusterSim(
+            catalog, factory, queue_weights=TRACE_QUEUES
+        ).run(trace)
+        unfinished = [
+            o.job_id for o in report.outcomes.values() if o.finish_s is None
+        ]
+        if unfinished:
+            fail("sched bench: jobs never finished", unfinished=unfinished)
+        waits = report.waits(max_chips=1)
+        lat = report.preempt_resume_latencies_s
+        raw_p95 = percentile(waits, 95)
+        out = {
+            "makespan_s": round(report.makespan_s, 1),
+            "jain_fairness": round(report.jain_fairness, 3),
+            "preemptions": report.preemptions,
+            "small_job_wait_p50_s": round(percentile(waits, 50), 1),
+            "small_job_wait_p95_s": round(raw_p95, 1),
+            "preempt_readmit_p50_s": (
+                round(percentile(lat, 50), 1) if lat else None
+            ),
+            "preempt_readmit_p95_s": (
+                round(percentile(lat, 95), 1) if lat else None
+            ),
+        }
+        # gate on the RAW numbers: an improvement smaller than the display
+        # rounding grain must still count as an improvement
+        return out, raw_p95, report.jain_fairness
+
+    fifo, fifo_p95, fifo_jain = leg(lambda clock: GangScheduler(catalog))
+    fair, fair_p95, fair_jain = leg(
+        lambda clock: FairShareScheduler(catalog, TRACE_QUEUES, clock=clock)
+    )
+    if fair_p95 >= fifo_p95:
+        fail(
+            "sched bench: fair-share did not reduce small-job p95 wait",
+            fifo=fifo, fairshare=fair,
+        )
+    if fair_jain <= fifo_jain:
+        fail(
+            "sched bench: fair-share did not improve the Jain index",
+            fifo=fifo, fairshare=fair,
+        )
+    return {
+        "metric": (
+            f"sched_small_job_wait_p95[chips{chips},big{n_big},"
+            f"small{n_small},seed{seed}]"
+        ),
+        "value": fair["small_job_wait_p95_s"],
+        "unit": "s (p95 queue wait, 1-chip jobs, fair-share)",
+        "fifo": fifo,
+        "fairshare": fair,
+        "wait_p95_speedup": round(
+            fifo["small_job_wait_p95_s"]
+            / max(fair["small_job_wait_p95_s"], 1e-9), 1,
+        ),
+        "jain_delta": round(
+            fair["jain_fairness"] - fifo["jain_fairness"], 3
+        ),
+        "queues": TRACE_QUEUES,
+    }
+
+
 def _measure_serve() -> dict:
     """BENCH_MODE=serve: continuous-batching engine vs sequential decode.
 
@@ -613,6 +712,11 @@ def main() -> None:
         # the trainers run as subprocesses with their own JAX runtime
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps(_measure_chaos_recovery()))
+        return
+    if os.environ.get("BENCH_MODE", "").strip().lower() == "sched":
+        # scheduler-policy bench: pure simulator, no accelerator at all
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(_measure_sched()))
         return
     _init_backend_with_fallback()
     import jax

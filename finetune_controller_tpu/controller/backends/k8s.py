@@ -880,6 +880,25 @@ class K8sJobSetBackend(TrainingBackend):
         dataset_uri: str | None,
         artifacts_uri: str,
     ) -> None:
+        from ...sched.queues import DEFAULT_QUEUE, PRIORITY_CLASSES, parse_priority
+
+        try:
+            non_default_priority = (
+                parse_priority(job.priority) != PRIORITY_CLASSES["normal"]
+            )
+        except ValueError:
+            non_default_priority = True  # unparseable: certainly not default
+        if job.queue != DEFAULT_QUEUE or non_default_priority:
+            # tenant queue/priority are the in-repo fair-share scheduler's
+            # vocabulary (docs/scheduling.md); on k8s, admission belongs to
+            # Kueue (LocalQueue label from the flavor + WorkloadPriorityClass
+            # CRs).  Say so loudly rather than silently dropping the intent.
+            logger.warning(
+                "job %s: queue=%r priority=%r are ignored on the k8s "
+                "backend — admission is Kueue's (flavor LocalQueue %r); "
+                "configure Kueue WorkloadPriorityClass for priorities",
+                job.job_id, job.queue, job.priority, flavor.queue,
+            )
         trainer_spec = render_trainer_spec(
             job, spec, flavor, dataset_uri=dataset_uri
         )

@@ -36,6 +36,7 @@ import time
 from pathlib import Path
 from typing import Any, AsyncIterator
 
+from ...sched import FairShareScheduler
 from ..devices import DeviceCatalog, DeviceFlavor, default_mesh_for
 from ..objectstore import ObjectStore
 from ..schemas import BackendJobReport, BackendJobState, JobInput
@@ -64,6 +65,14 @@ class _JobHandle:
         self.run_task: asyncio.Task | None = None
         self.sync_task: asyncio.Task | None = None
         self.restarts = 0
+        #: tenant queue + priority (sched/), echoed into reports/metadata
+        self.queue = "default"
+        self.priority: object = "normal"
+        #: scheduler evicted this job: the run loop must NOT burn local
+        #: restarts — it reports FAILED (exit 143) so the resilience
+        #: supervisor requeues it with resume (docs/scheduling.md)
+        self.preempted = False
+        self.preempted_by = ""
         self.exit_code: int | None = None  # last attempt's exit code
         self.restored_checkpoints = 0  # files staged back from the store
         self.start_time: float | None = None
@@ -103,17 +112,32 @@ class LocalProcessBackend(TrainingBackend):
         python: str | None = None,
         extra_env: dict[str, str] | None = None,
         warm_workers: int = 0,
+        sched_policy: str = "fairshare",
+        sched_queues: dict[str, float] | None = None,
     ):
         self.root = Path(root_dir).expanduser()
         self.root.mkdir(parents=True, exist_ok=True)
         self.store = object_store
         self.catalog = catalog
-        self.scheduler = GangScheduler(catalog)
+        #: admission control (docs/scheduling.md): the multi-tenant
+        #: fair-share scheduler by default; "fifo" is the legacy best-effort
+        #: gang scheduler (no tenants, no preemption) kept as an escape hatch
+        if sched_policy == "fifo":
+            self.scheduler = GangScheduler(catalog)
+        elif sched_policy == "fairshare":
+            self.scheduler = FairShareScheduler(catalog, sched_queues)
+        else:
+            raise ValueError(f"unknown sched_policy {sched_policy!r}")
         self.sync_interval_s = sync_interval_s
         self.backoff_limit = backoff_limit
         self.python = python or sys.executable
         self.extra_env = dict(extra_env or {})
         self._handles: dict[str, _JobHandle] = {}
+        #: tombstone reports for jobs the backend lost before launch (the
+        #: admitted-without-a-handle race): surfaced as FAILED so the retry
+        #: supervisor classifies + resubmits instead of the DB job sitting
+        #: QUEUED forever (ISSUE 5 satellite)
+        self._lost: dict[str, BackendJobReport] = {}
         self._closing = False
         #: pre-warmed trainer processes (train/warm_worker.py) keyed by their
         #: platform env — they have already paid JAX import + backend init,
@@ -169,9 +193,19 @@ class LocalProcessBackend(TrainingBackend):
 
             handle.env = self._runtime_env(flavor, job.num_slices)
 
-            self.scheduler.submit(job.job_id, flavor.name, job.num_slices)
+            handle.queue = job.queue
+            handle.priority = job.priority
+            self.scheduler.submit(
+                job.job_id, flavor.name, job.num_slices,
+                queue=job.queue, priority=job.priority,
+            )
+            self._lost.pop(job.job_id, None)  # resubmit clears any tombstone
             handle.set_state(BackendJobState.SUSPENDED)
-            handle.event("Queued", f"flavor={flavor.name} slices={job.num_slices}")
+            handle.event(
+                "Queued",
+                f"flavor={flavor.name} slices={job.num_slices} "
+                f"queue={job.queue} priority={job.priority}",
+            )
         except BackendError:
             raise
         except Exception as exc:
@@ -369,11 +403,64 @@ class LocalProcessBackend(TrainingBackend):
         for w in self.scheduler.try_admit():
             handle = self._handles.get(w.job_id)
             if handle is None:
+                # the workload outlived its handle (a submit-path crash
+                # dropped the handle after the scheduler registration): a
+                # silent release here left the DB job QUEUED forever.  Leave
+                # a FAILED tombstone report instead — the monitor hands it
+                # to the retry supervisor, which classifies the message as
+                # an infra failure and resubmits (ISSUE 5 satellite).
                 self.scheduler.release(w.job_id)
+                logger.error(
+                    "job %s admitted without a live handle; reporting it "
+                    "as failed so the supervisor can retry", w.job_id,
+                )
+                self._lost[w.job_id] = BackendJobReport(
+                    job_id=w.job_id,
+                    state=BackendJobState.FAILED,
+                    completion_time=time.time(),
+                    message=(
+                        "backend error: workload admitted without a live "
+                        "handle (submit-path crash); the job never started"
+                    ),
+                    metadata={"exit_code": None, "restarts": 0},
+                )
                 continue
             handle.set_state(BackendJobState.CREATED)
-            handle.event("Admitted", f"queue={w.queue}")
+            handle.event(
+                "Admitted", f"queue={w.queue} priority={handle.priority}"
+            )
             handle.run_task = asyncio.get_running_loop().create_task(self._run(handle))
+        self._execute_preemptions()
+
+    def _execute_preemptions(self) -> None:
+        """Deliver the scheduler's eviction decisions: SIGTERM each victim so
+        the trainer checkpoints and exits 143; the run loop then reports
+        FAILED without burning local restarts, and the resilience supervisor
+        requeues the victim with resume.  The victim's chips stay reserved
+        for the preemptor inside the scheduler until they actually free."""
+        take = getattr(self.scheduler, "take_preemptions", None)
+        if take is None:
+            return
+        for victim_id, preemptor_id in take():
+            handle = self._handles.get(victim_id)
+            if handle is None:
+                self.scheduler.release(victim_id)
+                continue
+            handle.preempted = True
+            handle.preempted_by = preemptor_id
+            handle.event("Preempted", f"evicted for {preemptor_id}")
+            logger.info("preempting job %s for %s", victim_id, preemptor_id)
+            if handle.proc is not None:
+                with contextlib.suppress(ProcessLookupError):
+                    handle.proc.terminate()
+            # a proc-less victim (admitted, subprocess not yet spawned) is
+            # caught by the post-spawn check in _run_once
+
+    def scheduler_tick(self) -> None:
+        """Monitor-tick admission hook: re-evaluate admission/preemption even
+        without a submit/release edge (e.g. shares drifted, or a reservation
+        became satisfiable) — the Kueue reconcile loop equivalent."""
+        self._admit_pending()
 
     # --------------------------------------------------------------- run loop
 
@@ -389,7 +476,22 @@ class LocalProcessBackend(TrainingBackend):
                 if handle.cancelled:
                     return
                 if rc == 0:
+                    # a preemption that lands as the process exits 0 is moot:
+                    # the job trained to completion and must be SUCCEEDED,
+                    # not spuriously failed-and-requeued
+                    handle.preempted = False
                     outcome = BackendJobState.SUCCEEDED
+                    break
+                if handle.preempted:
+                    # scheduler eviction: do NOT restart locally — the chips
+                    # are reserved for the preemptor.  Report FAILED with the
+                    # SIGTERM exit code so the supervisor classifies it as a
+                    # preemption and requeues it with resume.
+                    outcome = BackendJobState.FAILED
+                    message = (
+                        f"preempted by scheduler for {handle.preempted_by} "
+                        f"(exit code {rc})"
+                    )
                     break
                 attempt += 1
                 handle.restarts = attempt
@@ -467,6 +569,12 @@ class LocalProcessBackend(TrainingBackend):
             finally:
                 log_f.close()
         handle.proc = proc
+        if handle.preempted:
+            # preemption landed between admission and spawn: the victim's
+            # process must still die now, not run to completion on chips the
+            # scheduler already promised away
+            with contextlib.suppress(ProcessLookupError):
+                proc.terminate()
         if handle.start_time is None:
             handle.start_time = time.time()
         handle.set_state(BackendJobState.RUNNING)
@@ -535,9 +643,17 @@ class LocalProcessBackend(TrainingBackend):
         metadata: dict[str, Any] = {
             "restarts": handle.restarts,
             "exit_code": handle.exit_code,
+            "queue": handle.queue,
+            "priority": handle.priority,
         }
         if handle.restored_checkpoints:
             metadata["restored_checkpoints"] = handle.restored_checkpoints
+        if handle.preempted:
+            # persisted by the monitor's metadata merge -> the preemption
+            # event survives in the job document (crash-safe, like
+            # retry_next_at)
+            metadata["preempted"] = True
+            metadata["preempted_by"] = handle.preempted_by
         return BackendJobReport(
             job_id=handle.job_id,
             state=handle.state,
@@ -548,11 +664,15 @@ class LocalProcessBackend(TrainingBackend):
         )
 
     async def list_jobs(self) -> list[BackendJobReport]:
-        return [self._report(h) for h in self._handles.values()]
+        return [self._report(h) for h in self._handles.values()] + list(
+            self._lost.values()
+        )
 
     async def get_job(self, job_id: str) -> BackendJobReport | None:
         h = self._handles.get(job_id)
-        return self._report(h) if h else None
+        if h is not None:
+            return self._report(h)
+        return self._lost.get(job_id)
 
     async def queue_snapshot(self) -> list[str]:
         return self.scheduler.pending()
@@ -571,6 +691,9 @@ class LocalProcessBackend(TrainingBackend):
         the SAME sandbox — two writers on one artifacts dir would corrupt
         the checkpoints the resumed attempt depends on, so the old process
         must be dead before this returns."""
+        if self._lost.pop(job_id, None) is not None:
+            # tombstone of a job that never started: nothing to kill
+            return True
         handle = self._handles.pop(job_id, None)
         if handle is None:
             return False
@@ -677,6 +800,7 @@ class LocalProcessBackend(TrainingBackend):
 
     async def close(self) -> None:
         self._closing = True
+        self._lost.clear()
         for job_id in list(self._handles):
             await self.delete_job(job_id)
         for pool in self._warm.values():

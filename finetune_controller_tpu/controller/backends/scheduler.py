@@ -24,8 +24,6 @@ from ..devices import DeviceCatalog
 
 logger = logging.getLogger(__name__)
 
-_seq = itertools.count()
-
 
 @dataclasses.dataclass
 class Workload:
@@ -35,7 +33,7 @@ class Workload:
     flavor: str
     chips: int
     queue: str
-    seq: int = dataclasses.field(default_factory=lambda: next(_seq))
+    seq: int = 0
     admitted: bool = False
 
 
@@ -45,6 +43,10 @@ class GangScheduler:
     def __init__(self, catalog: DeviceCatalog):
         self._catalog = catalog
         self._workloads: dict[str, Workload] = {}
+        # per-scheduler sequence: the previous module-global counter leaked
+        # submission ordering across instances, making queue positions
+        # depend on which tests (or sibling backends) ran first
+        self._seq = itertools.count()
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -53,9 +55,23 @@ class GangScheduler:
             w.chips for w in self._workloads.values() if w.admitted and w.flavor == flavor
         )
 
-    def submit(self, job_id: str, flavor_name: str, num_slices: int = 1) -> Workload:
+    def submit(
+        self,
+        job_id: str,
+        flavor_name: str,
+        num_slices: int = 1,
+        *,
+        queue: str | None = None,
+        priority: object | None = None,
+    ) -> Workload:
         """Register a suspended workload (``runPolicy.suspend: true`` until
-        admitted — ``PyTorchJobDeployer.py:179-185``)."""
+        admitted — ``PyTorchJobDeployer.py:179-185``).
+
+        ``queue``/``priority`` are accepted for signature parity with the
+        fair-share scheduler (``finetune_controller_tpu/sched/``) and
+        deliberately ignored: this is the documented FIFO escape hatch
+        (``FTC_SCHED_POLICY=fifo``), which has no tenant semantics.
+        """
         if job_id in self._workloads:
             raise ValueError(f"workload {job_id!r} already queued")
         flavor = self._catalog.get_worker(flavor_name)
@@ -64,6 +80,7 @@ class GangScheduler:
             flavor=flavor.name,
             chips=flavor.total_chips * max(1, num_slices),
             queue=flavor.queue,
+            seq=next(self._seq),
         )
         self._workloads[job_id] = w
         return w

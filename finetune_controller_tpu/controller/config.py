@@ -94,6 +94,13 @@ class Settings(BaseModel):
 
     # --- Scheduler / device catalog (reference: CONFIGURATION_FILE, app/core/config.py:43) ---
     device_config_file: str = ""
+    #: admission policy (docs/scheduling.md): "fairshare" (multi-tenant
+    #: weighted DRF with checkpoint-aware preemption, the default) | "fifo"
+    #: (the legacy best-effort gang scheduler — no tenants, no preemption)
+    sched_policy: str = "fairshare"
+    #: tenant queue weights as a JSON object, e.g. '{"prod": 4, "batch": 1}'.
+    #: Unknown queues named at submit auto-register with weight 1.0.
+    sched_queues: str = ""
 
     # --- Backend selection ---
     backend: str = "local"  # local | k8s

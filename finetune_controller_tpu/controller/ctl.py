@@ -9,8 +9,9 @@ an on-cluster deployment).
 
 Commands:
     models                              list submittable models
-    submit MODEL [--arg k=v ...] [--device D] [--dataset-file F | --dataset-url U | --dataset-id I] [--watch]
+    submit MODEL [--arg k=v ...] [--device D] [--queue Q] [--priority P] [--dataset-file F | --dataset-url U | --dataset-id I] [--watch]
     jobs [--page N]                     paginated job table
+    queue                               tenant queues: usage/share/borrowed + pending
     status JOB_ID [--watch]             one job (``--watch`` polls to final)
     logs JOB_ID [--follow]              job logs (REST; --follow re-polls)
     metrics JOB_ID                      metrics rows (latest last)
@@ -141,6 +142,10 @@ async def cmd_submit(client: Client, ns: argparse.Namespace) -> int:
         form.add_field("model_name", ns.model)
         if ns.device:
             form.add_field("device", ns.device)
+        if ns.queue:
+            form.add_field("queue", ns.queue)
+        if ns.priority:
+            form.add_field("priority", ns.priority)
         form.add_field("arguments", json.dumps(arguments))
         def _read_dataset() -> bytes:
             with open(ns.dataset_file, "rb") as f:
@@ -153,6 +158,10 @@ async def cmd_submit(client: Client, ns: argparse.Namespace) -> int:
         body: dict[str, Any] = {"model_name": ns.model, "arguments": arguments}
         if ns.device:
             body["device"] = ns.device
+        if ns.queue:
+            body["queue"] = ns.queue
+        if ns.priority:
+            body["priority"] = ns.priority
         if ns.dataset_url:
             body["dataset_url"] = ns.dataset_url
         if ns.dataset_id:
@@ -211,6 +220,36 @@ async def cmd_logs(client: Client, ns: argparse.Namespace) -> int:
         seen = await fetch_new(seen)
 
 
+async def cmd_queue(client: Client, ns: argparse.Namespace) -> int:
+    """Tenant-queue table from ``GET /admin/scheduler``: usage, weighted
+    dominant share, borrowed chips, preemptions, and pending positions."""
+    snap = await client.get("/admin/scheduler")
+    queues = snap.get("queues") or {}
+    if not queues:
+        print(f"no tenant queues (policy={snap.get('policy')})")
+        return 0
+    header = (f"{'QUEUE':<16} {'WEIGHT':>6} {'RUN':>4} {'PEND':>5} "
+              f"{'CHIPS':>6} {'SHARE':>7} {'BORROW':>7} {'PREEMPT':>8}")
+    print(header)
+    for name, q in sorted(queues.items()):
+        print(
+            f"{name:<16} {q['weight']:>6.1f} {q['running']:>4} "
+            f"{q['depth']:>5} {q['used_chips_total']:>6} "
+            f"{q['dominant_share']:>7.3f} {q['borrowed_chips']:>7.1f} "
+            f"{q['preemptions']:>8}"
+        )
+    pending = [
+        (p["position"], p["job_id"], name)
+        for name, q in queues.items()
+        for p in q.get("pending", [])
+    ]
+    for pos, job_id, qname in sorted(pending):
+        print(f"  #{pos}  {job_id}  ({qname})")
+    if snap.get("preemptions_total") is not None:
+        print(f"(preemptions total: {snap['preemptions_total']})")
+    return 0
+
+
 async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:
     body = await client.get(f"/jobs/{ns.job_id}/metrics")
     _print_json(body.get("records", body))
@@ -261,6 +300,8 @@ async def amain(ns: argparse.Namespace) -> int:
             return await cmd_submit(client, ns)
         if ns.cmd == "jobs":
             return await cmd_jobs(client, ns)
+        if ns.cmd == "queue":
+            return await cmd_queue(client, ns)
         if ns.cmd == "status":
             return await cmd_status(client, ns)
         if ns.cmd == "logs":
@@ -294,12 +335,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("model")
     s.add_argument("--arg", action="append", metavar="K=V")
     s.add_argument("--device")
+    s.add_argument("--queue", help="tenant queue (docs/scheduling.md)")
+    s.add_argument("--priority", help="low | normal | high | integer")
     s.add_argument("--dataset-file")
     s.add_argument("--dataset-url")
     s.add_argument("--dataset-id")
     s.add_argument("--watch", action="store_true")
     s = sub.add_parser("jobs")
     s.add_argument("--page", type=int, default=1)
+    sub.add_parser("queue")
     for name in ("status", "logs", "metrics", "artifacts", "promote",
                  "unpromote", "cancel"):
         s = sub.add_parser(name)

@@ -112,6 +112,14 @@ class JobMonitor:
             # resubmit retries whose backoff expired — runs even on an empty
             # snapshot (a RETRYING job has, by design, no backend half)
             await self.supervisor.tick()
+        sched_tick = getattr(self.backend, "scheduler_tick", None)
+        if sched_tick is not None:
+            # tick-driven admission (docs/scheduling.md): re-evaluate
+            # admission/preemption even without a submit/release edge, and
+            # within the same tick that resubmitted due retries — a
+            # preemptor must be admitted within one monitor tick of its
+            # victims' chips freeing
+            sched_tick()
         if not reports:
             return
         pending = await self.backend.queue_snapshot()  # queue order (kueue_helpers.py:19-46)

@@ -87,12 +87,25 @@ def build_runtime(
     catalog = load_catalog(settings.device_config_file or None)
     backend: TrainingBackend
     if settings.backend == "local":
+        sched_queues = None
+        if settings.sched_queues:
+            import json
+
+            parsed = json.loads(settings.sched_queues)
+            if not isinstance(parsed, dict):
+                raise ValueError(
+                    "FTC_SCHED_QUEUES must be a JSON object of "
+                    "queue-name -> weight"
+                )
+            sched_queues = {str(k): float(v) for k, v in parsed.items()}
         backend = LocalProcessBackend(
             settings.state_path / "sandboxes",
             store,
             catalog,
             sync_interval_s=settings.artifact_sync_interval_s,
             warm_workers=settings.warm_workers,
+            sched_policy=settings.sched_policy,
+            sched_queues=sched_queues,
         )
     elif settings.backend == "k8s":
         from .backends.k8s import K8sJobSetBackend

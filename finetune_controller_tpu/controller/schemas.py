@@ -195,6 +195,11 @@ class JobInput(BaseModel):
     device: str
     num_slices: int = 1
     arguments: dict[str, Any] = Field(default_factory=dict)
+    #: tenant queue + priority class for the fair-share scheduler
+    #: (``finetune_controller_tpu/sched/``, docs/scheduling.md); validated
+    #: against sched.queues.parse_priority in the API layer
+    queue: str = "default"
+    priority: str | int = "normal"
 
 
 class PaginatedTableResponse(BaseModel):

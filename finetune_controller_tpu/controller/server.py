@@ -25,6 +25,7 @@ from urllib.parse import urlencode
 from aiohttp import web
 from pydantic import ValidationError
 
+from ..sched.queues import parse_priority
 from . import registry
 from .config import Settings
 from .promotion import PromotionTask, promotion_destination
@@ -328,7 +329,8 @@ async def start_job(request: web.Request) -> web.Response:
 
     # unknown fields are rejected, not ignored: a typo'd "training_arguments"
     # silently training 100 default steps is far costlier than a 400
-    known = {"model_name", "model", "arguments", "task", "device", "num_slices"}
+    known = {"model_name", "model", "arguments", "task", "device",
+             "num_slices", "queue", "priority"}
     unknown = sorted(set(fields) - known)
     if unknown:
         return _json_error(
@@ -355,7 +357,8 @@ async def start_job(request: web.Request) -> web.Response:
         return _json_error(400, f"model {model_name!r} is a {cls.task.value} model")
 
     device = fields.get("device") or cls.default_device
-    if rt.catalog.get(device) is None:
+    flavor = rt.catalog.get(device)
+    if flavor is None:
         return _json_error(
             400,
             f"unknown device {device!r}; available: {rt.catalog.names()}",
@@ -364,6 +367,28 @@ async def start_job(request: web.Request) -> web.Response:
         num_slices = int(fields.get("num_slices") or cls.default_num_slices)
     except (TypeError, ValueError):
         return _json_error(400, "num_slices must be an integer")
+    need = flavor.total_chips * max(1, num_slices)
+    quota = rt.catalog.quota_for(device)
+    if need > quota:
+        # the fair-share scheduler refuses never-fitting workloads (they
+        # would wedge their flavor's reservation); surface that as a 400
+        # with the quota named instead of a 500 from the backend
+        return _json_error(
+            400,
+            f"request needs {need} chips of {device!r} but the quota is "
+            f"{quota}; reduce num_slices or pick a larger flavor",
+        )
+
+    # tenant queue + priority class (docs/scheduling.md): validated here so
+    # a bad priority is a 400 at submit, never a failure inside admission
+    queue = str(fields.get("queue") or "default").strip()
+    if not queue or len(queue) > 64:
+        return _json_error(400, "queue must be a non-empty name (<= 64 chars)")
+    priority = fields.get("priority", "normal")
+    try:
+        parse_priority(priority)
+    except ValueError as exc:
+        return _json_error(400, str(exc))
 
     job_id = f"{model_name}-{generate_short_uuid()}"  # reference: app/main.py:422
     job = JobInput(
@@ -373,6 +398,8 @@ async def start_job(request: web.Request) -> web.Response:
         device=device,
         num_slices=num_slices,
         arguments=arguments,
+        queue=queue,
+        priority=priority,
     )
     await task_builder(
         job, spec, ds,
@@ -764,6 +791,25 @@ async def admin_job_events(request: web.Request) -> web.Response:
     return web.json_response({"events": events})
 
 
+async def admin_scheduler(request: web.Request) -> web.Response:
+    """Fair-share scheduler introspection (docs/scheduling.md): per-queue
+    usage, weighted shares, borrowed chips, pending positions, preemption
+    counters — the tenant view ``ftc-ctl queue`` renders."""
+    rt = request.app[RUNTIME_KEY]
+    _admin(request)
+    scheduler = getattr(rt.backend, "scheduler", None)
+    if scheduler is None:
+        return web.json_response({"policy": None, "queues": {}, "flavors": {}})
+    snapshot = getattr(scheduler, "snapshot", None)
+    if snapshot is None:
+        # the FIFO escape hatch has no tenant view; serve what it knows
+        return web.json_response({
+            "policy": "fifo", "queues": {}, "flavors": scheduler.usage(),
+            "pending": scheduler.pending(),
+        })
+    return web.json_response(snapshot())
+
+
 async def admin_backend_jobs(request: web.Request) -> web.Response:
     rt = request.app[RUNTIME_KEY]
     _admin(request)
@@ -869,6 +915,25 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
             lines.append(
                 f'ftc_quota_chips{{flavor="{f}",kind="nominal"}} {u["nominal_chips"]}'
             )
+    if scheduler is not None and hasattr(scheduler, "snapshot"):
+        # fair-share tenant gauges (docs/scheduling.md)
+        snap = scheduler.snapshot()
+        sched_gauges = (
+            ("ftc_sched_queue_depth", "gauge", "depth"),
+            ("ftc_sched_queue_running", "gauge", "running"),
+            ("ftc_sched_queue_used_chips", "gauge", "used_chips_total"),
+            ("ftc_sched_queue_dominant_share", "gauge", "dominant_share"),
+            ("ftc_sched_queue_borrowed_chips", "gauge", "borrowed_chips"),
+            ("ftc_sched_queue_preemptions_total", "counter", "preemptions"),
+        )
+        for metric, kind, stat_key in sched_gauges:
+            lines.append(f"# TYPE {metric} {kind}")
+            for qname, q in sorted(snap["queues"].items()):
+                lines.append(
+                    f'{metric}{{queue="{prom_escape(qname)}"}} {q[stat_key]}'
+                )
+        lines.append("# TYPE ftc_sched_preemptions_total counter")
+        lines.append(f"ftc_sched_preemptions_total {snap['preemptions_total']}")
     if rt.serve is not None:
         sessions = rt.serve.stats()
         serve_gauges = (
@@ -1022,6 +1087,7 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
     app.router.add_get(f"{p}/download", download)
     app.router.add_get(f"{p}/admin/jobs", admin_jobs)
     app.router.add_get(f"{p}/admin/queue", admin_queue)
+    app.router.add_get(f"{p}/admin/scheduler", admin_scheduler)
     app.router.add_get(f"{p}/admin/jobs/{{job_id}}/events", admin_job_events)
     app.router.add_get(f"{p}/admin/backend/jobs", admin_backend_jobs)
     app.router.add_get(f"{p}/admin/resilience", admin_resilience)

@@ -120,6 +120,11 @@ async def task_builder(
         dataset_id=dataset_id,
         dataset_uri=dataset_uri,
         artifacts_uri=artifacts_uri,
+        # queue/priority live in metadata (crash-safe, like retry_next_at):
+        # the retry supervisor rebuilds the JobInput from the record, so a
+        # resubmitted job must re-enter the SAME tenant queue at the SAME
+        # priority (docs/scheduling.md)
+        metadata={"queue": job.queue, "priority": job.priority},
     )
     try:
         await state.create_job(record)

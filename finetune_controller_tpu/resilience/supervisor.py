@@ -210,6 +210,10 @@ class RetrySupervisor:
                     device=job.device,
                     num_slices=job.num_slices,
                     arguments=job.arguments,
+                    # a retried (or preempted) job re-enters its tenant
+                    # queue at its original priority (docs/scheduling.md)
+                    queue=job.metadata.get("queue") or "default",
+                    priority=job.metadata.get("priority", "normal"),
                 ),
                 spec,
                 flavor,

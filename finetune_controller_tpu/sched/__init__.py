@@ -1,0 +1,46 @@
+"""Multi-tenant fair-share scheduling (docs/scheduling.md).
+
+The in-repo replacement for the best-effort-FIFO :class:`GangScheduler`
+(``controller/backends/scheduler.py``): named tenant queues with weights,
+priority classes on every workload, weighted dominant-resource fair sharing
+over the per-flavor chip quotas in the :class:`DeviceCatalog`, cohort
+borrowing, checkpoint-aware preemption through the resilience loop, and
+reservation-protected backfill.
+
+Modules:
+
+- :mod:`.queues` — tenant queues, priority classes, the Workload record;
+- :mod:`.fairshare` — the :class:`FairShareScheduler` itself plus the
+  weighted-DRF share math and the Jain fairness index;
+- :mod:`.preemption` — victim selection (lowest priority, most-over-share,
+  youngest first);
+- :mod:`.backfill` — the reservation-protected backfill gate;
+- :mod:`.sim` — a seeded, clock-injected cluster simulator so fairness /
+  starvation / preemption properties are provable in fast deterministic
+  tests (and ``BENCH_MODE=sched`` comparisons against the FIFO baseline).
+"""
+
+from .backfill import backfill_capacity
+from .fairshare import FairShareScheduler, jain_index
+from .preemption import select_victims
+from .queues import (
+    DEFAULT_QUEUE,
+    PRIORITY_CLASSES,
+    QueueConfig,
+    QueueSet,
+    Workload,
+    parse_priority,
+)
+
+__all__ = [
+    "DEFAULT_QUEUE",
+    "PRIORITY_CLASSES",
+    "FairShareScheduler",
+    "QueueConfig",
+    "QueueSet",
+    "Workload",
+    "backfill_capacity",
+    "jain_index",
+    "parse_priority",
+    "select_victims",
+]

@@ -1,0 +1,76 @@
+"""Victim selection for checkpoint-aware preemption.
+
+Preemption is *cheap* here because the resilience subsystem (PR 3) already
+turned SIGTERM into "checkpoint, exit 143, classify as preemption, requeue
+with backoff, resume from the committed checkpoint" — so evicting a workload
+costs it at most ``checkpoint_every`` steps of progress, not the whole run.
+
+Who may be preempted (both triggers from ISSUE 5):
+
+- a **higher-priority** workload that cannot fit may evict strictly-lower-
+  priority victims regardless of queue shares;
+- an **under-share** workload may evict same-priority victims whose queue is
+  *over* its nominal share — the fair-share reclaim.  The caller only sets
+  ``preemptor_under_share`` when the preemptor's queue stays within its
+  nominal share *after* admission (reclaim-only): a borrower preempting
+  would oscillate — post-swap the roles reverse and the displaced queue
+  preempts right back.
+
+Victim order (most expendable first): lowest priority, then most-over-share
+queue, then youngest (highest seq) — the youngest workload has the least
+sunk progress beyond its last checkpoint, and evicting it perturbs the
+cluster least.  Selection is greedy and all-or-nothing: if the eligible
+victims cannot cover the shortfall, nobody is killed (a partial eviction
+would not admit the preemptor and would only thrash the victims).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .queues import Workload
+
+
+def select_victims(
+    preemptor: Workload,
+    candidates: Iterable[Workload],
+    shortfall: int,
+    *,
+    over_share: dict[str, float],
+    preemptor_under_share: bool,
+) -> list[Workload]:
+    """Pick victims freeing ``shortfall`` chips for ``preemptor``.
+
+    ``over_share`` maps queue name -> chips above its weighted nominal share
+    (<= 0 means at-or-under share); ``preemptor_under_share`` is whether the
+    preemptor's queue is below its share.  Returns ``[]`` when the eligible
+    set cannot cover the shortfall.
+    """
+    if shortfall <= 0:
+        return []
+    eligible: list[Workload] = []
+    for w in candidates:
+        if w.preempting or not w.admitted or w.job_id == preemptor.job_id:
+            continue
+        if w.priority < preemptor.priority:
+            eligible.append(w)
+        elif (
+            preemptor_under_share
+            and w.priority == preemptor.priority
+            and over_share.get(w.queue, 0.0) > 0
+        ):
+            eligible.append(w)
+    # lowest priority, most-over-share queue, youngest first — deterministic
+    eligible.sort(
+        key=lambda w: (w.priority, -over_share.get(w.queue, 0.0), -w.seq)
+    )
+    victims: list[Workload] = []
+    freed = 0
+    for w in eligible:
+        if freed >= shortfall:
+            break
+        victims.append(w)
+        freed += w.chips
+    if freed < shortfall:
+        return []
+    return victims

@@ -32,6 +32,19 @@ if [ "${1:-}" = "--lint-only" ]; then
     exit 0
 fi
 
+echo "== sched-fast (fair-share properties on the simulator) ==" >&2
+# pure control-flow (no trainer subprocesses): quota safety under
+# preemption/backfill, victims-always-resume, Jain >= 0.8, FIFO starvation
+# pins (docs/scheduling.md) — fails in seconds if admission regresses
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_sched.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+sched_rc=$?
+if [ "$sched_rc" -ne 0 ]; then
+    echo "ci_check: sched-fast failed (exit $sched_rc)" >&2
+    exit "$sched_rc"
+fi
+
 echo "== serve-fast (batching invariance + metrics) ==" >&2
 # no 'not slow' filter here: the serve suite IS this stage's whole job, so
 # its slow-marked extras (sampled-decode parity) run too — they are excluded

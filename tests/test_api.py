@@ -208,6 +208,43 @@ def test_submit_validation_errors(tmp_path):
     run_async(main())
 
 
+def test_submit_queue_priority_and_admin_scheduler(tmp_path):
+    """Per-job tenant queue + priority (docs/scheduling.md): validated at
+    submit, persisted crash-safe in job metadata, and visible through
+    ``GET /admin/scheduler``."""
+
+    async def main():
+        client = await _client(_runtime(tmp_path), with_monitor=False)
+
+        bad = dict(SUBMIT_BODY, priority="urgent")
+        r = await client.post("/api/v1/jobs", json=bad)
+        assert r.status == 400
+        assert "priority" in (await r.json())["detail"]
+
+        bad = dict(SUBMIT_BODY, num_slices=99)  # beyond the flavor quota
+        r = await client.post("/api/v1/jobs", json=bad)
+        assert r.status == 400
+        assert "quota" in (await r.json())["detail"]
+
+        good = dict(SUBMIT_BODY, queue="prod", priority="high")
+        r = await client.post("/api/v1/jobs", json=good)
+        assert r.status == 200, await r.text()
+        job_id = (await r.json())["job_id"]
+        job = await (await client.get(f"/api/v1/jobs/{job_id}")).json()
+        assert job["metadata"]["queue"] == "prod"
+        assert job["metadata"]["priority"] == "high"
+
+        snap = await (await client.get("/api/v1/admin/scheduler")).json()
+        assert snap["policy"] == "fairshare"
+        assert "prod" in snap["queues"]
+        q = snap["queues"]["prod"]
+        assert q["running"] + q["depth"] == 1  # our job, admitted or pending
+        assert "preemptions_total" in snap
+        await client.close()
+
+    run_async(main())
+
+
 def test_rate_limit_429(tmp_path):
     async def main():
         rt = _runtime(tmp_path)

@@ -257,6 +257,66 @@ def test_local_backend_stages_dataset(tmp_path):
     run(main())
 
 
+def test_admitted_without_handle_becomes_failed_tombstone(tmp_path):
+    """ISSUE 5 satellite: a workload admitted after its handle vanished (a
+    submit-path crash window) used to be silently released, leaving the DB
+    job QUEUED forever.  It must now surface as a FAILED report that the
+    retry supervisor classifies as an infra failure and requeues."""
+    from finetune_controller_tpu.controller.monitor import JobMonitor
+    from finetune_controller_tpu.controller.schemas import (
+        DatabaseStatus,
+        JobRecord,
+    )
+    from finetune_controller_tpu.controller.statestore import StateStore
+    from finetune_controller_tpu.resilience.policy import RetryPolicy, classify_failure, FailureClass
+    from finetune_controller_tpu.resilience.supervisor import RetrySupervisor
+
+    async def main():
+        backend, store = _backend(tmp_path, quota=1)
+        spec = _job_spec()
+        flavor = backend.catalog.get("chip-1")
+        for jid in ("h-1", "h-2"):
+            await backend.submit(
+                JobInput(job_id=jid, user_id="u", model_name="tiny-test-lora",
+                         device="chip-1", arguments={}),
+                spec, flavor, dataset_uri=None,
+                artifacts_uri=f"obj://artifacts/u/{jid}",
+            )
+        # simulate the crash window: h-2's handle is gone, its workload isn't
+        backend._handles.pop("h-2")
+        assert await backend.delete_job("h-1")  # frees the chip -> h-2 admits
+        report = await backend.get_job("h-2")
+        assert report is not None and report.state is BackendJobState.FAILED
+        assert "backend error" in report.message
+        # the message classifies as an infra failure (retryable)
+        assert classify_failure(None, report.message) is FailureClass.INFRA
+        assert any(r.job_id == "h-2" for r in await backend.list_jobs())
+
+        # the monitor hands the tombstone to the supervisor -> RETRYING
+        state = StateStore(tmp_path / "state")
+        await state.connect()
+        await state.create_job(JobRecord(
+            job_id="h-2", user_id="u", model_name="tiny-test-lora",
+            status=DatabaseStatus.QUEUED, device="chip-1",
+        ))
+        supervisor = RetrySupervisor(
+            state, backend, backend.catalog,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=30.0, seed=0),
+        )
+        monitor = JobMonitor(state, store, backend, interval_s=0.1,
+                             supervisor=supervisor)
+        await monitor.tick()
+        rec = await state.get_job("h-2")
+        assert rec.status is DatabaseStatus.RETRYING, rec.metadata
+        assert rec.metadata["failure_class"] == "infra"
+        # the supervisor's substrate cleanup consumed the tombstone
+        assert await backend.get_job("h-2") is None
+        await backend.close()
+        await state.close()
+
+    run(main())
+
+
 def test_warm_worker_pool_runs_job(tmp_path):
     """A pre-warmed trainer process (JAX already imported) picks up the job:
     the Started event records the warm worker, the job trains to success, and

@@ -73,3 +73,39 @@ def test_ctl_submit_watch_metrics_logs(tmp_path, capsys):
 
     run_async(main())
 
+
+def test_ctl_queue_renders_tenant_table(tmp_path, capsys):
+    """`ftc-ctl queue` renders GET /admin/scheduler: per-queue usage/share/
+    borrowed plus pending positions (ISSUE 5 satellite)."""
+
+    async def main():
+        from aiohttp.test_utils import TestServer
+
+        from finetune_controller_tpu.controller.server import build_app
+        from finetune_controller_tpu.sched import FairShareScheduler
+
+        rt = _runtime(tmp_path)
+        await rt.start(with_monitor=False)
+        server = TestServer(build_app(rt))
+        await server.start_server()
+        api = f"http://{server.host}:{server.port}"
+        try:
+            sched = FairShareScheduler(rt.catalog, {"prod": 4.0, "batch": 1.0})
+            sched.submit("q-run", "chip-1", 2, queue="prod", priority="high")
+            sched.try_admit()
+            sched.submit("q-wait", "chip-1", 1, queue="batch", priority="low")
+            sched.try_admit()
+            rt.backend.scheduler = sched
+
+            assert await ctl.amain(ctl.build_parser().parse_args(
+                ["--api", api, "queue"])) == 0
+            out = capsys.readouterr().out
+            assert "QUEUE" in out and "SHARE" in out and "BORROW" in out
+            assert "prod" in out and "batch" in out
+            assert "#1  q-wait  (batch)" in out
+        finally:
+            await server.close()
+            await rt.close()
+
+    run_async(main())
+

@@ -77,6 +77,37 @@ def test_metrics_jobs_active_counts(tmp_path):
     run_async(main())
 
 
+def test_metrics_sched_gauges(tmp_path):
+    """The fair-share scheduler exports per-queue depth/share/borrowed
+    gauges and the cluster preemption counter (docs/scheduling.md)."""
+    from test_api import _client, _runtime
+    from finetune_controller_tpu.sched import FairShareScheduler
+
+    async def main():
+        rt = _runtime(tmp_path)
+        client = await _client(rt, with_monitor=False)
+        # populate a scheduler directly (no subprocesses): one admitted
+        # high-priority job, one pending low-priority job, one preemption
+        sched = FairShareScheduler(rt.catalog, {"prod": 4.0, "batch": 1.0})
+        sched.submit("m-lo", "chip-1", 2, queue="batch", priority="low")
+        sched.try_admit()
+        sched.submit("m-hi", "chip-1", 2, queue="prod", priority="high")
+        sched.try_admit()
+        assert sched.take_preemptions() == [("m-lo", "m-hi")]
+        rt.backend.scheduler = sched
+
+        body = await (await client.get("/metrics")).text()
+        assert 'ftc_sched_queue_depth{queue="prod"} 1' in body
+        assert 'ftc_sched_queue_running{queue="batch"} 1' in body
+        assert 'ftc_sched_queue_preemptions_total{queue="batch"} 1' in body
+        assert "ftc_sched_preemptions_total 1" in body
+        assert 'ftc_sched_queue_dominant_share{queue="batch"}' in body
+        assert 'ftc_sched_queue_borrowed_chips{queue="batch"}' in body
+        await client.close()
+
+    run_async(main())
+
+
 @pytest.mark.slow  # runs on every ci_check gate via the serve-fast stage
 def test_metrics_serve_gauges_after_generate(tmp_path):
     """The serve plane exports queue/slot/token gauges per loaded job

@@ -1,0 +1,368 @@
+"""Multi-tenant fair-share scheduler: unit + property tests (docs/scheduling.md).
+
+Covers ISSUE 5's provable properties on the deterministic simulator —
+quota safety under preemption/backfill, victims always resume, Jain >= 0.8
+at steady state, head-of-line blocking eliminated vs the FIFO baseline —
+plus the legacy-scheduler pins (per-instance sequence, FIFO starvation).
+"""
+
+import dataclasses
+import random
+
+import pytest
+
+from conftest import one_chip_catalog
+
+from finetune_controller_tpu.controller.backends.scheduler import GangScheduler
+from finetune_controller_tpu.controller.devices import (
+    DeviceCatalog,
+    DeviceFlavor,
+    FlavorQuota,
+)
+from finetune_controller_tpu.sched import FairShareScheduler, jain_index
+from finetune_controller_tpu.sched.queues import parse_priority, priority_name
+from finetune_controller_tpu.sched.sim import (
+    TRACE_QUEUES,
+    ClusterSim,
+    SimJob,
+    percentile,
+    sim_catalog,
+    synthetic_trace,
+)
+
+
+def _catalog(quota=8, chips_per_host=1):
+    return DeviceCatalog(
+        flavors=[DeviceFlavor(
+            name="chip", generation="cpu", hosts=1,
+            chips_per_host=chips_per_host, runtime="cpu", queue="q",
+        )],
+        quotas=[FlavorQuota(flavor="chip", nominal_chips=quota)],
+        default_flavor="chip",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Priority classes
+# ---------------------------------------------------------------------------
+
+
+def test_parse_priority():
+    assert parse_priority("high") > parse_priority("normal") > parse_priority("low")
+    assert parse_priority("HIGH") == parse_priority("high")
+    assert parse_priority(7) == 7
+    assert parse_priority("7") == 7
+    assert priority_name(parse_priority("normal")) == "normal"
+    for bad in ("urgent", None, 1.5, True):
+        with pytest.raises(ValueError):
+            parse_priority(bad)
+
+
+# ---------------------------------------------------------------------------
+# Legacy scheduler pins (ISSUE 5 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_gang_scheduler_seq_is_per_instance():
+    """The seed's module-global sequence leaked ordering across scheduler
+    instances (test-order-dependent queue positions).  Two fresh schedulers
+    must produce identical, instance-local orderings."""
+    cat = one_chip_catalog(quota=1)
+    for _ in range(2):
+        sched = GangScheduler(cat)
+        a = sched.submit("a", "chip-1")
+        b = sched.submit("b", "chip-1")
+        assert (a.seq, b.seq) == (0, 1)
+        sched.try_admit()
+        assert sched.pending() == ["b"]
+        assert sched.position("b") == 1
+
+
+def test_gang_scheduler_fifo_starvation_pinned():
+    """Pin the legacy behavior the fair-share scheduler exists to fix: a
+    blocked large job is starved forever by a stream of small jobs."""
+    sched = GangScheduler(_catalog(quota=2))
+    sched.submit("big", "chip", num_slices=2)
+    sched.submit("s0", "chip")
+    assert [w.job_id for w in sched.try_admit()] == ["big"]
+    sched.release("big")
+    # big resubmits while one small slot is held: now the stream starves it
+    assert [w.job_id for w in sched.try_admit()] == ["s0"]
+    sched.submit("big2", "chip", num_slices=2)
+    for i in range(1, 6):
+        sched.submit(f"s{i}", "chip")
+        admitted = [w.job_id for w in sched.try_admit()]
+        assert admitted == [f"s{i}"]  # small passes the blocked big
+        sched.release(f"s{i - 1}")
+    assert not sched.is_admitted("big2")
+    assert sched.position("big2") == 1  # head of queue, never admitted
+
+
+def test_fairshare_reserves_for_blocked_head_no_starvation():
+    """The fix for the pin above: once the big job is head-of-line, free
+    chips are reserved for it — small jobs stop slipping past, and the big
+    job admits as soon as its reservation is satisfied."""
+    sched = FairShareScheduler(_catalog(quota=2))
+    sched.submit("s0", "chip")
+    sched.submit("s1", "chip")
+    assert {w.job_id for w in sched.try_admit()} == {"s0", "s1"}
+    sched.submit("big", "chip", num_slices=2)
+    sched.submit("s2", "chip")
+    sched.release("s0")
+    # one chip free, big (2 chips) is head: s2 must NOT take the free chip
+    assert sched.try_admit() == []
+    assert sched.pending() == ["big", "s2"]
+    sched.release("s1")
+    admitted = [w.job_id for w in sched.try_admit()]
+    assert admitted == ["big"]  # reservation satisfied, head admits first
+    assert not sched.is_admitted("s2")
+
+
+def test_fairshare_rejects_never_fitting_workload():
+    sched = FairShareScheduler(_catalog(quota=2))
+    with pytest.raises(ValueError, match="never be admitted"):
+        sched.submit("huge", "chip", num_slices=3)
+
+
+# ---------------------------------------------------------------------------
+# Fair-share admission ordering
+# ---------------------------------------------------------------------------
+
+
+def test_priority_orders_admission():
+    sched = FairShareScheduler(_catalog(quota=1))
+    sched.submit("lo", "chip", priority="low")
+    sched.submit("hi", "chip", priority="high")
+    sched.submit("mid", "chip", priority="normal")
+    assert sched.pending() == ["hi", "mid", "lo"]
+    assert [w.job_id for w in sched.try_admit()] == ["hi"]
+    sched.release("hi")
+    assert [w.job_id for w in sched.try_admit()] == ["mid"]
+
+
+def test_under_share_queue_admits_first():
+    """Same priority: the queue farthest below its weighted entitlement
+    wins the next slot (weighted DRF ordering)."""
+    sched = FairShareScheduler(_catalog(quota=4), {"a": 1.0, "b": 1.0})
+    for i in range(3):
+        sched.submit(f"a{i}", "chip", queue="a")
+    sched.try_admit()  # a holds 3 of 4
+    sched.submit("a3", "chip", queue="a")
+    sched.submit("b0", "chip", queue="b")
+    # b has zero usage: it ranks first despite submitting later
+    assert sched.pending() == ["b0", "a3"]
+    assert [w.job_id for w in sched.try_admit()] == ["b0"]
+
+
+def test_idle_queue_quota_is_borrowable():
+    """Cohort borrowing: with queue b idle, queue a may use the whole
+    flavor quota (beyond its 50% nominal share); the borrowed amount shows
+    up in the snapshot."""
+    sched = FairShareScheduler(_catalog(quota=4), {"a": 1.0, "b": 1.0})
+    for i in range(4):
+        sched.submit(f"a{i}", "chip", queue="a")
+    assert len(sched.try_admit()) == 4  # full quota, no cap at nominal
+    snap = sched.snapshot()
+    assert snap["queues"]["a"]["used_chips_total"] == 4
+    assert snap["queues"]["a"]["borrowed_chips"] == 0.0  # cohort of one: all nominal
+    # b wakes up: now the cohort splits 2/2 and a is over share
+    sched.submit("b0", "chip", queue="b")
+    snap = sched.snapshot()
+    assert snap["queues"]["a"]["borrowed_chips"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# Preemption
+# ---------------------------------------------------------------------------
+
+
+def test_high_priority_preempts_lowest_youngest_first():
+    sched = FairShareScheduler(_catalog(quota=3))
+    sched.submit("lo-old", "chip", priority="low")
+    sched.submit("lo-young", "chip", priority="low")
+    sched.submit("mid", "chip", priority="normal")
+    sched.try_admit()
+    sched.submit("hi", "chip", priority="high")
+    assert sched.try_admit() == []  # full: hi blocks as head
+    victims = sched.take_preemptions()
+    # exactly the shortfall: one victim, lowest priority, youngest first
+    assert victims == [("lo-young", "hi")]
+    sched.release("lo-young")  # the backend reports the exit
+    assert [w.job_id for w in sched.try_admit()] == ["hi"]
+
+
+def test_preemption_is_all_or_nothing():
+    """If eligible victims cannot cover the shortfall, nobody is killed —
+    partial eviction would thrash victims without admitting the head."""
+    sched = FairShareScheduler(_catalog(quota=4))
+    sched.submit("lo", "chip", priority="low")
+    sched.submit("hi-old", "chip", num_slices=3, priority="high")
+    sched.try_admit()
+    sched.submit("hi-new", "chip", num_slices=2, priority="high")
+    sched.try_admit()
+    assert sched.take_preemptions() == []  # only 1 low chip < 2 needed
+    assert not sched.is_admitted("hi-new")
+
+
+def test_reserved_chips_not_stolen_by_later_submit():
+    """The no-admission-race guarantee: chips freed by a preemption go to
+    the preemptor even when another job arrives (and ranks lower) while the
+    victim is still exiting."""
+    sched = FairShareScheduler(_catalog(quota=2))
+    sched.submit("lo", "chip", num_slices=2, priority="low")
+    sched.try_admit()
+    sched.submit("hi", "chip", num_slices=2, priority="high")
+    sched.try_admit()
+    assert sched.take_preemptions() == [("lo", "hi")]
+    # a normal-priority 1-chip job arrives mid-eviction
+    sched.submit("sneak", "chip", priority="normal")
+    assert sched.try_admit() == []  # nothing is free yet
+    sched.release("lo")
+    admitted = [w.job_id for w in sched.try_admit()]
+    assert admitted == ["hi"]  # the full freed slice goes to the preemptor
+    assert not sched.is_admitted("sneak")
+
+
+def test_backfill_rides_preemption_excess():
+    """A 1-chip job may ride along when a preemption frees more than the
+    head needs — but only the excess, and only chips physically free."""
+    sched = FairShareScheduler(_catalog(quota=4))
+    sched.submit("lo", "chip", num_slices=4, priority="low")
+    sched.try_admit()
+    sched.submit("hi", "chip", num_slices=2, priority="high")
+    sched.submit("small", "chip", num_slices=1, priority="normal")
+    sched.try_admit()
+    assert sched.take_preemptions() == [("lo", "hi")]
+    # victim still holds its chips: nothing admits while it exits
+    assert sched.try_admit() == []
+    sched.release("lo")
+    admitted = [w.job_id for w in sched.try_admit()]
+    # head first, then the backfill candidate into the freed excess
+    assert admitted == ["hi", "small"]
+
+
+def test_same_priority_reclaim_only_no_thrash():
+    """Fairness preemption is reclaim-only: an under-share queue evicts a
+    borrower, but the displaced borrower must NOT preempt back (the swap is
+    a fixed point, not an oscillation)."""
+    sched = FairShareScheduler(_catalog(quota=4), {"a": 1.0, "b": 1.0})
+    for i in range(4):
+        sched.submit(f"a{i}", "chip", queue="a")  # a borrows the lot
+    sched.try_admit()
+    sched.submit("b0", "chip", queue="b")
+    sched.try_admit()
+    victims = sched.take_preemptions()
+    assert victims == [("a3", "b0")]  # youngest borrower evicted
+    sched.release("a3")
+    assert [w.job_id for w in sched.try_admit()] == ["b0"]
+    # the displaced a-job requeues: a is now AT its nominal share (2 used of
+    # 2 nominal after the swap? no: 3 used, nominal 2 -> still over) and b is
+    # within share holding 1 of 2 — the requeued a-job must not evict b0
+    sched.submit("a3", "chip", queue="a")
+    sched.try_admit()
+    assert sched.take_preemptions() == []
+
+
+# ---------------------------------------------------------------------------
+# Simulator properties
+# ---------------------------------------------------------------------------
+
+
+class _CheckedScheduler(FairShareScheduler):
+    """Asserts quota safety after every admission pass."""
+
+    def try_admit(self):
+        out = super().try_admit()
+        for f in self._catalog.flavors:
+            used = self._used_chips(f.name)
+            quota = self._catalog.quota_for(f.name)
+            assert used <= quota, (
+                f"quota violated on {f.name}: {used} > {quota}"
+            )
+        return out
+
+
+def _random_trace(seed: int, n_jobs: int = 20) -> list[SimJob]:
+    rng = random.Random(seed)
+    queues = list(TRACE_QUEUES)
+    jobs = []
+    for i in range(n_jobs):
+        jobs.append(SimJob(
+            job_id=f"j{i}", flavor="sim-chip",
+            num_slices=rng.randint(1, 6),
+            duration_s=rng.uniform(10.0, 200.0),
+            arrival_s=rng.uniform(0.0, 120.0),
+            queue=rng.choice(queues),
+            priority=rng.choice(["low", "normal", "high"]),
+            checkpoint_every_s=rng.choice([10.0, 30.0, 60.0]),
+        ))
+    return jobs
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_sim_quota_never_exceeded_and_victims_resume(seed):
+    """Across random seeded traces: no admission pass ever exceeds the
+    flavor quota (preemption + backfill included), every preempted job
+    resumes, and every job finishes."""
+    catalog = sim_catalog(8)
+    sim = ClusterSim(
+        catalog,
+        lambda clock: _CheckedScheduler(catalog, TRACE_QUEUES, clock=clock),
+    )
+    report = sim.run(_random_trace(seed), horizon_s=1_000_000.0)
+    for o in report.outcomes.values():
+        assert o.finish_s is not None, f"{o.job_id} never finished"
+        assert len(o.resumed_at) == len(o.preempted_at), (
+            f"{o.job_id} was preempted but never resumed"
+        )
+    assert len(report.preempt_resume_latencies_s) == report.preemptions
+
+
+def test_sim_is_deterministic():
+    catalog = sim_catalog(8)
+
+    def run():
+        sim = ClusterSim(
+            catalog,
+            lambda clock: FairShareScheduler(
+                catalog, TRACE_QUEUES, clock=clock
+            ),
+        )
+        return sim.run(synthetic_trace(0))
+
+    a, b = run(), run()
+    assert dataclasses.asdict(a) == dataclasses.asdict(b)
+
+
+def test_sim_fairshare_beats_fifo_on_canonical_trace():
+    """The acceptance numbers (also BENCH_MODE=sched): vs FIFO on the same
+    seeded trace, fair-share eliminates head-of-line blocking for small
+    jobs, improves the Jain index past 0.8 at steady state, and reports
+    preempt->readmit latency."""
+    catalog = sim_catalog(8)
+    trace = synthetic_trace(0)
+    # both legs' Jain indices are normalised by the SAME entitlements
+    fifo = ClusterSim(
+        catalog, lambda clock: GangScheduler(catalog),
+        queue_weights=TRACE_QUEUES,
+    ).run(trace)
+    fair = ClusterSim(
+        catalog,
+        lambda clock: FairShareScheduler(catalog, TRACE_QUEUES, clock=clock),
+        queue_weights=TRACE_QUEUES,
+    ).run(trace)
+    fifo_p95 = percentile(fifo.waits(max_chips=1), 95)
+    fair_p95 = percentile(fair.waits(max_chips=1), 95)
+    assert fair_p95 < fifo_p95 / 10, (fair_p95, fifo_p95)
+    assert fair.jain_fairness >= 0.8 > fifo.jain_fairness
+    assert fair.preemptions > 0 == fifo.preemptions
+    assert fair.preempt_resume_latencies_s  # the latency IS reported
+    # starvation-free both ways: every batch job still completes
+    for o in fair.outcomes.values():
+        assert o.finish_s is not None
+
+
+def test_jain_index():
+    assert jain_index([1, 1, 1, 1]) == pytest.approx(1.0)
+    assert jain_index([1, 0, 0, 0]) == pytest.approx(0.25)
+    assert jain_index([]) == 1.0

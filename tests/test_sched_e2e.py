@@ -1,0 +1,173 @@
+"""End-to-end preemption on the REAL local backend (ISSUE 5 acceptance).
+
+The chaos-style loop, scheduler edition: a full cluster runs a low-priority
+job past its first committed checkpoint; a high-priority submission preempts
+it through the scheduler (SIGTERM -> trainer checkpoints -> exit 143); the
+victim lands in RETRYING via the resilience supervisor and later RESUMES
+from its checkpoint with step-continuous metrics, while the preemptor is
+admitted the moment the chips free (within one monitor tick).
+
+Reuses the PR 3 proof harness patterns from tests/test_chaos.py.
+"""
+
+import asyncio
+import csv
+import re
+import time
+
+from conftest import one_chip_catalog
+from conftest import run_async as run
+
+from finetune_controller_tpu.controller import registry
+from finetune_controller_tpu.controller.backends.local import LocalProcessBackend
+from finetune_controller_tpu.controller.examples import LoRASFTArguments, TinyTestLoRA
+from finetune_controller_tpu.controller.monitor import JobMonitor
+from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+from finetune_controller_tpu.controller.schemas import (
+    BackendJobState,
+    DatabaseStatus,
+    JobInput,
+)
+from finetune_controller_tpu.controller.statestore import StateStore
+from finetune_controller_tpu.controller.task_builder import DatasetInput, task_builder
+from finetune_controller_tpu.resilience.policy import RetryPolicy
+from finetune_controller_tpu.resilience.supervisor import RetrySupervisor
+
+
+def _arguments(total_steps, cadence=10):
+    return LoRASFTArguments(
+        total_steps=total_steps, warmup_steps=1, batch_size=2, seq_len=16,
+        lora_rank=2, log_every=cadence, checkpoint_every=cadence,
+    )
+
+
+def _plane(tmp_path):
+    """Real control plane on a FULL one-chip cluster, fair-share scheduler
+    (the default), backend restart budget zeroed so recovery flows through
+    the supervisor, fast seeded backoff."""
+    registry.reset()
+    registry.load_builtin_models()
+    root = tmp_path / "plane"
+    state = StateStore(root / "state")
+    store = LocalObjectStore(root / "objects")
+    catalog = one_chip_catalog(quota=1)
+    backend = LocalProcessBackend(
+        root / "sandboxes", store, catalog,
+        sync_interval_s=0.2, backoff_limit=0,
+        sched_queues={"batch": 1.0, "prod": 4.0},
+    )
+    supervisor = RetrySupervisor(
+        state, backend, catalog,
+        policy=RetryPolicy(max_attempts=3, base_delay_s=0.2, max_delay_s=0.5,
+                           seed=0),
+    )
+    monitor = JobMonitor(state, store, backend, interval_s=0.1,
+                         supervisor=supervisor)
+    return state, store, catalog, backend, supervisor, monitor
+
+
+async def _submit(state, store, backend, catalog, arguments, job_id, *,
+                  queue, priority):
+    spec = TinyTestLoRA(training_arguments=arguments)
+    await task_builder(
+        JobInput(job_id=job_id, user_id="u", model_name="tiny-test-lora",
+                 device="chip-1", arguments=arguments.model_dump(),
+                 queue=queue, priority=priority),
+        spec, DatasetInput(),
+        state=state, store=store, backend=backend, catalog=catalog,
+        datasets_bucket="datasets", artifacts_bucket="artifacts",
+    )
+
+
+def _metric_steps(artifacts_dir):
+    with open(artifacts_dir / "metrics.csv", newline="") as f:
+        rows = list(csv.DictReader(f))
+    return [int(float(r["step"])) for r in rows]
+
+
+def test_preemption_evicts_checkpoints_and_resumes(tmp_path):
+    async def main():
+        total, cadence = 40, 10
+        state, store, catalog, backend, sup, monitor = _plane(tmp_path)
+        await state.connect()
+
+        # -- the victim saturates the (one-chip) cluster -------------------
+        await _submit(state, store, backend, catalog, _arguments(total, cadence),
+                      "victim-1", queue="batch", priority="low")
+        victim = backend._handles["victim-1"]
+        ckpt_dir = victim.artifacts_dir / "checkpoints"
+        committed = re.compile(r"^step_\d+$")
+        deadline = time.monotonic() + 240
+        while not (ckpt_dir.is_dir()
+                   and any(committed.match(p.name) for p in ckpt_dir.iterdir())):
+            assert time.monotonic() < deadline, "no checkpoint within 240s"
+            await asyncio.sleep(0.1)
+
+        # -- a high-priority submit preempts it through the scheduler ------
+        await _submit(state, store, backend, catalog, _arguments(4, 2),
+                      "preemptor-1", queue="prod", priority="high")
+        assert backend.scheduler.preemptions_total == 1
+
+        # -- drive the plane; record when each side transitions ------------
+        victim_retrying_tick = None
+        preemptor_admitted_tick = None
+        preemptor_done = False
+        deadline = time.monotonic() + 300
+        tick = 0
+        while True:
+            await monitor.tick()
+            tick += 1
+            vrec = await state.get_job("victim-1")
+            if victim_retrying_tick is None and (
+                vrec.status is DatabaseStatus.RETRYING
+            ):
+                victim_retrying_tick = tick
+            prep = await backend.get_job("preemptor-1")
+            if preemptor_admitted_tick is None and prep is not None and (
+                prep.state not in (BackendJobState.PENDING,
+                                   BackendJobState.SUSPENDED)
+            ):
+                preemptor_admitted_tick = tick
+            prec = await state.get_job("preemptor-1")
+            preemptor_done = prec.status is DatabaseStatus.SUCCEEDED
+            if vrec.status.is_final and preemptor_done:
+                break
+            assert time.monotonic() < deadline, (
+                vrec.status, vrec.metadata, prec.status,
+            )
+            await asyncio.sleep(0.05)
+
+        # victim: preempted -> RETRYING -> resumed -> SUCCEEDED
+        assert vrec.status is DatabaseStatus.SUCCEEDED, vrec.metadata
+        history = vrec.metadata["attempt_history"]
+        assert len(history) == 1, history
+        assert history[0]["failure_class"] == "preemption"
+        assert vrec.metadata.get("preempted") is True
+        assert vrec.metadata.get("preempted_by") == "preemptor-1"
+        # queue/priority survive in metadata across the retry (crash-safe)
+        assert vrec.metadata["queue"] == "batch"
+        assert vrec.metadata["priority"] == "low"
+        assert victim_retrying_tick is not None
+
+        # the preemptor was admitted the moment the victim's chip freed —
+        # no later than one monitor tick around the RETRYING transition
+        assert preemptor_admitted_tick is not None
+        assert preemptor_admitted_tick <= victim_retrying_tick + 1, (
+            preemptor_admitted_tick, victim_retrying_tick,
+        )
+
+        # resume proof (the PR 3 harness): continued, not restarted
+        log_text = (victim.sandbox / "logs.txt").read_text()
+        assert "resumed from checkpoint step" in log_text
+        steps = _metric_steps(victim.artifacts_dir)
+        assert steps == list(range(cadence, total + 1, cadence)), steps
+
+        # scheduler bookkeeping drained cleanly
+        snap = backend.scheduler.snapshot()
+        assert snap["preemptions_total"] == 1
+        assert snap["reservations"] == {}
+        assert sup.retries_scheduled == 1 and sup.resubmits == 1
+        await backend.close()
+        await state.close()
+
+    run(main())
