@@ -42,6 +42,11 @@ per-leg step time + input_fraction under "prefetch_ab"). Every bench JSON now
 carries "input_fraction": the share of the timed window the training thread
 spent WAITING on its next batch — the number that catches an input-bound
 config that raw tokens/sec would hide.
+
+Serving knobs (BENCH_MODE=serve): BENCH_SERVE_REQUESTS, BENCH_SERVE_NEW_TOKENS,
+BENCH_SERVE_SLOTS, and — for the prefix-reuse A/B (ISSUE 6, gated) —
+BENCH_SERVE_PREFIX_LEN (shared system-prompt length, default 240) and
+BENCH_SERVE_PREFIX_CACHE_MB (snapshot budget, default 64).
 """
 
 from __future__ import annotations
@@ -686,6 +691,119 @@ def _measure_serve() -> dict:
     def pct(xs: list[float], p: float) -> float:
         return float(np.percentile(np.asarray(xs), p))
 
+    # --- prefix-reuse A/B (docs/serving.md): the ISSUE 6 gates ------------
+    # (a) the EXISTING mixed workload must not regress with the cache on;
+    # (b) a shared-system-prompt workload must cut time-to-first-token >= 2x
+    #     and save > 50% of prefill tokens.
+    cache_mb = int(os.environ.get("BENCH_SERVE_PREFIX_CACHE_MB", "64"))
+    engine_on = BatchEngine(
+        model, variables,
+        EngineConfig(slots=slots, prompt_buckets=(32, 128),
+                     max_new_tokens=max_new + 8,
+                     prefix_cache_bytes=cache_mb << 20),
+    )
+    engine_on.run(reqs())  # warm pass 1: fill compiles + seeds the cache
+    engine_on.run(reqs())  # warm pass 2: the hit path compiles fill_from
+    t0 = time.perf_counter()
+    results_on = engine_on.run(reqs())
+    on_window = time.perf_counter() - t0
+    for rid, r in results.items():
+        if results_on[rid].generated != r.generated:
+            fail("prefix cache changed greedy output on the mixed workload",
+                 request_id=rid)
+    mixed_on_tps = total_tokens / on_window
+    if mixed_on_tps < 0.8 * engine_tps:
+        # the cache must be ~free when it cannot help (same-run baseline =
+        # the PR-4 configuration); 0.8 absorbs CPU timer noise on the tiny
+        # preset — a real regression from trie/insert overhead is far larger
+        fail(
+            "prefix cache regressed the mixed serve workload",
+            mixed_on_tps=round(mixed_on_tps, 1),
+            mixed_off_tps=round(engine_tps, 1),
+        )
+
+    prefix_len = int(os.environ.get("BENCH_SERVE_PREFIX_LEN", "240"))
+    suffix_len = 8
+    pre_buckets = (32, prefix_len + 2 * suffix_len)
+    system_prompt = list(
+        rng.integers(1, cfg.vocab_size - 1, size=prefix_len)
+    )
+    shared_prompts = [
+        system_prompt + list(
+            rng.integers(1, cfg.vocab_size - 1, size=suffix_len)
+        )
+        for _ in range(n_requests)
+    ]
+
+    def shared_reqs(tag):
+        return [
+            GenRequest(request_id=f"{tag}{i}", tokens=p,
+                       max_new_tokens=max_new)
+            for i, p in enumerate(shared_prompts)
+        ]
+
+    def ttft_and_drain(eng, requests):
+        """Admit with per-request wall timing (TTFT: prefill + first token
+        selection happen inside admit), then drain the batch."""
+        ttfts, out, pending = [], {}, list(requests)
+        while pending or eng.active_requests:
+            while pending and eng.free_slots:
+                r = pending.pop(0)
+                t1 = time.perf_counter()
+                done = eng.admit(r)
+                ttfts.append(time.perf_counter() - t1)
+                if done is not None:
+                    out[r.request_id] = done
+            for done in eng.step():
+                out[done.request_id] = done
+        return ttfts, out
+
+    ab = {}
+    for leg, cache_bytes in (("off", 0), ("on", cache_mb << 20)):
+        eng = BatchEngine(
+            model, variables,
+            EngineConfig(slots=slots, prompt_buckets=pre_buckets,
+                         max_new_tokens=max_new + 8,
+                         prefix_cache_bytes=cache_bytes),
+        )
+        ttft_and_drain(eng, shared_reqs("w"))  # warm + seed the cache
+        saved0 = eng.prefill_tokens_saved_total
+        ttfts, out = ttft_and_drain(eng, shared_reqs("m"))
+        ab[leg] = {
+            "ttft_p50_s": round(pct(ttfts, 50), 5),
+            "ttft_p95_s": round(pct(ttfts, 95), 5),
+            "prefill_tokens_saved": eng.prefill_tokens_saved_total - saved0,
+            "prefix_hits": eng.prefix_hits_total,
+            "compilations": eng.compilations,
+            "tokens": {r: out[r].generated for r in sorted(out)},
+        }
+    if ab["on"].pop("tokens") != ab["off"].pop("tokens"):
+        fail("prefix cache changed greedy output on the shared-prefix "
+             "workload")
+    ttft_speedup = ab["off"]["ttft_p50_s"] / ab["on"]["ttft_p50_s"]
+    if ttft_speedup < 2.0:
+        fail(
+            "shared-prefix TTFT improvement below the 2x gate",
+            ttft_speedup=round(ttft_speedup, 2), **{
+                f"ttft_{leg}_p50_s": ab[leg]["ttft_p50_s"]
+                for leg in ("off", "on")
+            },
+        )
+    prompt_tokens_total = sum(len(p) for p in shared_prompts)
+    saved_fraction = ab["on"]["prefill_tokens_saved"] / prompt_tokens_total
+    if saved_fraction <= 0.5:
+        fail(
+            "prefix cache saved <= 50% of prompt tokens on the "
+            "shared-prefix workload",
+            saved_fraction=round(saved_fraction, 3),
+        )
+    compile_bound = 2 * len(pre_buckets) + 1
+    if ab["on"]["compilations"] > compile_bound:
+        fail(  # the armed RecompileGuard should have raised first
+            "prefix-cache engine exceeded the compile budget",
+            compilations=ab["on"]["compilations"], bound=compile_bound,
+        )
+
     return {
         "metric": f"serve_tokens_per_sec[{preset},req{n_requests},"
                   f"new{max_new},slots{slots}]",
@@ -702,6 +820,15 @@ def _measure_serve() -> dict:
         "slots": slots,
         "compilations": engine.compilations,
         "recompile_budget": engine.guard.budget,
+        "mixed_prefix_on_tokens_per_sec": round(mixed_on_tps, 1),
+        "prefix_ab": {
+            "ttft_speedup": round(ttft_speedup, 2),
+            "prefill_tokens_saved_fraction": round(saved_fraction, 3),
+            "prefix_len": prefix_len,
+            "cache_mb": cache_mb,
+            **{f"{leg}_{k}": v for leg in ("off", "on")
+               for k, v in ab[leg].items()},
+        },
         "device_kind": jax.devices()[0].device_kind,
     }
 

@@ -122,13 +122,26 @@ class Settings(BaseModel):
     #: hard per-request generation cap; also sizes the KV cache
     #: (max(buckets) + this = cache slots per lane)
     serve_max_new_tokens: int = 128
+    #: prefix-reuse KV cache (docs/serving.md): admissions sharing a cached
+    #: prompt prefix (the shared-system-prompt case) splice it in and prefill
+    #: only the suffix — bit-identical outputs, prefill compute saved
+    serve_prefix_cache: bool = True
+    #: byte budget (MiB) of device-resident prefix snapshots per served
+    #: model; least-recently-used snapshots evict past it.  Size it to hold
+    #: AT LEAST one snapshot (2 * cache_len * n_kv_heads * head_dim *
+    #: n_layers * dtype bytes — ~84 MB for an 8B config at the default
+    #: buckets): a budget below one snapshot makes every insert refuse and
+    #: the cache silently inert (the engine logs a warning once)
+    serve_prefix_cache_mb: int = 512
     #: default when a request omits max_new_tokens
     serve_default_max_new_tokens: int = 32
     #: admission queue depth — past it requests get 429 (backpressure)
     serve_max_queue: int = 64
-    #: idle poll interval of the drive loop (first-token latency floor when
-    #: lanes are free)
-    serve_max_wait_ms: float = 5.0
+    #: idle park interval of the drive loop (1 ms floor).  Submissions wake
+    #: the loop IMMEDIATELY via an event, so this never adds first-token
+    #: latency — it only bounds the fallback re-check while fully idle
+    #: (keep it large: an idle loop wakes 1000/this times per second)
+    serve_max_wait_ms: float = 1000.0
     #: default per-request deadline: queued-past-it → dropped, decoding-past-it
     #: → evicted mid-flight (0 = no deadline)
     serve_request_timeout_s: float = 60.0

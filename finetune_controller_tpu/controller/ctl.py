@@ -12,6 +12,7 @@ Commands:
     submit MODEL [--arg k=v ...] [--device D] [--queue Q] [--priority P] [--dataset-file F | --dataset-url U | --dataset-id I] [--watch]
     jobs [--page N]                     paginated job table
     queue                               tenant queues: usage/share/borrowed + pending
+    serve                               serving sessions: slots/queue/tokens + prefix-cache hits
     status JOB_ID [--watch]             one job (``--watch`` polls to final)
     logs JOB_ID [--follow]              job logs (REST; --follow re-polls)
     metrics JOB_ID                      metrics rows (latest last)
@@ -250,6 +251,30 @@ async def cmd_queue(client: Client, ns: argparse.Namespace) -> int:
     return 0
 
 
+async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
+    """Serving-session table from ``GET /admin/serve``: slot/queue occupancy,
+    token throughput counters, and the prefix-reuse cache's hit economics
+    (docs/serving.md)."""
+    sessions = (await client.get("/admin/serve")).get("sessions") or {}
+    if not sessions:
+        print("no serving sessions loaded")
+        return 0
+    header = (f"{'JOB':<24} {'SLOTS':>7} {'QUEUE':>5} {'TOKENS':>8} "
+              f"{'HITS':>5} {'MISS':>5} {'SAVED':>8} {'CACHE_MB':>8}")
+    print(header)
+    for job_id, s in sorted(sessions.items()):
+        slots = f"{s['slots_busy']}/{s['slots_total']}"
+        cache_mb = s.get("prefix_cache_bytes", 0) / (1 << 20)
+        print(
+            f"{job_id:<24} {slots:>7} {s['queue_depth']:>5} "
+            f"{s['tokens_generated_total']:>8} "
+            f"{s.get('prefix_hits_total', 0):>5} "
+            f"{s.get('prefix_misses_total', 0):>5} "
+            f"{s.get('prefill_tokens_saved_total', 0):>8} {cache_mb:>8.1f}"
+        )
+    return 0
+
+
 async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:
     body = await client.get(f"/jobs/{ns.job_id}/metrics")
     _print_json(body.get("records", body))
@@ -302,6 +327,8 @@ async def amain(ns: argparse.Namespace) -> int:
             return await cmd_jobs(client, ns)
         if ns.cmd == "queue":
             return await cmd_queue(client, ns)
+        if ns.cmd == "serve":
+            return await cmd_serve(client, ns)
         if ns.cmd == "status":
             return await cmd_status(client, ns)
         if ns.cmd == "logs":
@@ -344,6 +371,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("jobs")
     s.add_argument("--page", type=int, default=1)
     sub.add_parser("queue")
+    sub.add_parser("serve")
     for name in ("status", "logs", "metrics", "artifacts", "promote",
                  "unpromote", "cancel"):
         s = sub.add_parser(name)

@@ -948,6 +948,13 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
              "requests_rejected_total"),
             ("ftc_serve_decode_steps_total", "counter", "steps_total"),
             ("ftc_serve_compilations", "gauge", "compilations"),
+            # prefix-reuse KV cache (docs/serving.md)
+            ("ftc_serve_prefix_hits_total", "counter", "prefix_hits_total"),
+            ("ftc_serve_prefix_misses_total", "counter",
+             "prefix_misses_total"),
+            ("ftc_serve_prefill_tokens_saved_total", "counter",
+             "prefill_tokens_saved_total"),
+            ("ftc_serve_prefix_cache_bytes", "gauge", "prefix_cache_bytes"),
         )
         lines.append("# TYPE ftc_serve_models_loaded gauge")
         lines.append(f"ftc_serve_models_loaded {len(sessions)}")

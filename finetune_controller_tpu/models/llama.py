@@ -443,17 +443,29 @@ class Attention(nn.Module):
         """KV-cached generation path (``models/generate.py`` fill-then-decode).
 
         A static-length cache (``cfg.max_seq_len`` slots) lives in the flax
-        ``cache`` collection: a prompt-length call fills slots ``[0, S)``, a
-        single-token call appends at the cache index and attends over the
-        valid prefix.  Closes the round-2 gap of the uncached O(n²)-per-token
-        sampler being impractical at 7B (VERDICT r2 weak #7).
+        ``cache`` collection.  Three regimes:
+
+        * **fresh** (no cache variable yet): prefill from zero — write the
+          prompt's K/V at ``[0, S)`` and run the normal causal kernel;
+        * **existing cache, S == 1**: the decode step — append at the cache
+          index and attend over the valid prefix;
+        * **existing cache, S > 1**: suffix prefill — continue FROM the cache
+          index (per-row): the chunk's K/V land at ``[idx, idx + S)`` and
+          query j attends the cached prefix plus the chunk up to itself.
+          This is how the serving engine's prefix-reuse path
+          (``serve/prefix_cache.py``) prefills only the uncached tail of a
+          prompt; causality makes the result bit-identical to a monolithic
+          prefill of the whole sequence.
+
+        Closes the round-2 gap of the uncached O(n²)-per-token sampler being
+        impractical at 7B (VERDICT r2 weak #7).
 
         The cache index is a PER-ROW ``(B,)`` vector: ``cached_generate``
         keeps every row in lockstep (all entries equal), while the serving
         engine (``serve/engine.py``) decodes each batch slot at its own
         position so requests can join mid-flight.
         """
-        from ..ops.attention import single_token_attention
+        from ..ops.attention import chunked_cache_attention, single_token_attention
 
         cfg = self.cfg
         b, s, _, hd = q.shape
@@ -467,7 +479,7 @@ class Attention(nn.Module):
             lambda: jnp.zeros((b, m, cfg.n_kv_heads, hd), cfg.dtype))
         ci = self.variable("cache", "index",
                            lambda: jnp.zeros((b,), jnp.int32))
-        if s > 1 or fresh:
+        if fresh:
             # prefill: write the prompt's K/V and run the normal causal kernel
             ck.value = jax.lax.dynamic_update_slice(
                 ck.value, k.astype(cfg.dtype), (0, 0, 0, 0))
@@ -475,12 +487,27 @@ class Attention(nn.Module):
                 cv.value, v.astype(cfg.dtype), (0, 0, 0, 0))
             ci.value = jnp.full((b,), s, jnp.int32)
             out = causal_attention(q, k, v, impl="xla")
+        elif s > 1:
+            # suffix prefill: continue an existing cache at its per-row index
+            idx = ci.value  # (B,)
+            rows = jnp.arange(b)[:, None]
+            cols = idx[:, None] + jnp.arange(s)[None, :]
+            ck.value = ck.value.at[rows, cols].set(k.astype(cfg.dtype))
+            cv.value = cv.value.at[rows, cols].set(v.astype(cfg.dtype))
+            ci.value = idx + s
+            out = chunked_cache_attention(q, ck.value, cv.value, idx)
         else:
             idx = ci.value  # (B,) — rows may sit at different positions
             rows = jnp.arange(b)
-            ck.value = ck.value.at[rows, idx].set(k[:, 0].astype(cfg.dtype))
-            cv.value = cv.value.at[rows, idx].set(v[:, 0].astype(cfg.dtype))
-            ci.value = idx + 1
+            # write clamped to the last slot and index advance saturated at
+            # m: identity for live rows (the caller never decodes past the
+            # cache end), but a PARKED serving lane riding the batched step
+            # indefinitely (serve/engine.py) stays in-bounds forever instead
+            # of creeping past m
+            wr = jnp.minimum(idx, m - 1)
+            ck.value = ck.value.at[rows, wr].set(k[:, 0].astype(cfg.dtype))
+            cv.value = cv.value.at[rows, wr].set(v[:, 0].astype(cfg.dtype))
+            ci.value = jnp.minimum(idx + 1, m)
             out = single_token_attention(q, ck.value, cv.value, idx)
         return _proj(cfg, "o_proj", cfg.d_model)(
             out.reshape(b, s, -1), deterministic)

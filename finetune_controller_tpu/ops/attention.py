@@ -63,6 +63,41 @@ def xla_causal_attention(
     return out.reshape(b, s, h, d)
 
 
+def chunked_cache_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    idx: jax.Array,
+) -> jax.Array:
+    """A chunk of S query tokens against a static-length KV cache.
+
+    q: (B, S, H, D); caches (B, M, Hkv, D); ``idx`` is the absolute position
+    of the chunk's FIRST query token — scalar (whole batch in lockstep) or
+    (B,) per-row.  Query j attends cache slots ``<= idx + j``: causal within
+    the chunk, full visibility over the already-cached prefix.  S = 1 is the
+    classic decode step; S > 1 is a suffix prefill continuing a prefix cache
+    (``serve/prefix_cache.py``).  Same f32-softmax and 1/sqrt(D) conventions
+    as :func:`xla_causal_attention`, so a chunked fill matches a monolithic
+    one bit-for-bit: masked slots contribute exactly 0 to the softmax (the
+    f32-min fill underflows exp to 0.0), making per-row results independent
+    of the cache length and of whatever stale data other slots hold.
+    """
+    b, s, h, d = q.shape
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    qh = (q * d ** -0.5).reshape(b, s, hkv, g, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qh, k_cache).astype(jnp.float32)
+    idx = jnp.asarray(idx)
+    if idx.ndim:  # (B,) per-row positions -> broadcast over (b, k, g, s, t)
+        idx = idx.reshape(b, 1, 1, 1, 1)
+    qpos = idx + jnp.arange(s).reshape(1, 1, 1, s, 1)
+    valid = jnp.arange(k_cache.shape[1]).reshape(1, 1, 1, 1, -1) <= qpos
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache)
+    return out.reshape(b, s, h, d)
+
+
 def single_token_attention(
     q: jax.Array,
     k_cache: jax.Array,
@@ -74,26 +109,11 @@ def single_token_attention(
     q: (B, 1, H, D); caches (B, M, Hkv, D); ``idx`` is the position of the
     query token — scalar (whole batch in lockstep, the ``cached_generate``
     path) or (B,) per-row (the serving engine, where each slot decodes at its
-    own position) — cache slots > idx are masked out.  Same f32-softmax and
-    1/sqrt(D) conventions as :func:`xla_causal_attention`, so a cached decode
-    matches the uncached oracle bit-for-bit up to dtype rounding.  Masked
-    slots contribute exactly 0 to the softmax (the f32-min fill underflows
-    exp to 0.0), so per-row results are independent of the cache length and
-    of whatever other rows hold.
+    own position) — cache slots > idx are masked out.  The S = 1 case of
+    :func:`chunked_cache_attention` (the integer ``idx + 0`` query position
+    folds away, so the compiled program is unchanged).
     """
-    b, s, h, d = q.shape
-    hkv = k_cache.shape[2]
-    g = h // hkv
-    qh = (q * d ** -0.5).reshape(b, s, hkv, g, d)
-    scores = jnp.einsum("bskgd,btkd->bkgst", qh, k_cache).astype(jnp.float32)
-    idx = jnp.asarray(idx)
-    if idx.ndim:  # (B,) per-row positions -> broadcast over (b, k, g, s, t)
-        idx = idx.reshape(b, 1, 1, 1, 1)
-    valid = jnp.arange(k_cache.shape[1])[None, None, None, None, :] <= idx
-    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache)
-    return out.reshape(b, s, h, d)
+    return chunked_cache_attention(q, k_cache, v_cache, idx)
 
 
 def _check_block(name: str, raw) -> int:

@@ -13,9 +13,11 @@ plane's event loop:
 * **deadlines**: a request that waited in the queue past its deadline is
   dropped with :class:`DeadlineExceeded` before ever touching the engine; an
   admitted request past its deadline is evicted between steps;
-* ``max_wait_ms`` trades first-token latency for fill: with lanes free and
-  nothing queued the driver sleeps that long before re-checking rather than
-  spinning.
+* ``max_wait_ms`` is the idle park interval: with nothing queued and nothing
+  in flight the driver sleeps that long between re-checks rather than
+  spinning.  Submissions wake it immediately (the ``_wake`` event), so the
+  knob only bounds how stale the fallback re-check can go — floored at 1 ms
+  so a zero can never busy-spin the loop.
 """
 
 from __future__ import annotations
@@ -55,7 +57,7 @@ class Batcher:
         engine: BatchEngine,
         *,
         max_queue: int = 64,
-        max_wait_ms: float = 5.0,
+        max_wait_ms: float = 1000.0,
         default_timeout_s: float = 60.0,
     ):
         self.engine = engine
@@ -81,6 +83,12 @@ class Batcher:
     @property
     def slots_busy(self) -> int:
         return self.engine.active_requests
+
+    @property
+    def _park_timeout_s(self) -> float:
+        """Idle re-check interval of :meth:`_drive` — ``max_wait_ms`` with a
+        1 ms floor (pinned in ``tests/test_serve.py``)."""
+        return max(self.max_wait_ms, 1.0) / 1000.0
 
     def start(self) -> None:
         # restart a dead drive task too: a crashed loop (engine fault) must
@@ -191,7 +199,7 @@ class Batcher:
                 self._wake.clear()
                 try:
                     await asyncio.wait_for(
-                        self._wake.wait(), timeout=1.0
+                        self._wake.wait(), timeout=self._park_timeout_s
                     )
                 except asyncio.TimeoutError:
                     continue
@@ -240,4 +248,10 @@ class Batcher:
             "requests_rejected_total": self.rejected_total,
             "deadline_drops_total": self.deadline_drops_total,
             "compilations": self.engine.compilations,
+            # prefix-reuse KV cache (docs/serving.md) — all zeros when off
+            "prefix_hits_total": self.engine.prefix_hits_total,
+            "prefix_misses_total": self.engine.prefix_misses_total,
+            "prefill_tokens_saved_total": self.engine.prefill_tokens_saved_total,
+            "prefix_cache_bytes": self.engine.prefix_cache_bytes,
+            "prefix_cache_entries": self.engine.prefix_cache_entries,
         }

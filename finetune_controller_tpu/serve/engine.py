@@ -11,8 +11,23 @@ Compile-count contract (armed with ``analysis.recompile_guard``):
 * prefill compiles once per **prompt bucket** (prompts are right-padded to
   the smallest configured bucket that fits; causality makes the pad slots
   invisible to the real tokens);
+* with the prefix cache enabled, suffix prefill (``fill_from``) compiles
+  once per prompt bucket too — suffixes pad to the same bucket table, so
+  the budget grows by exactly ``len(prompt_buckets)``;
 * the decode step compiles **once**, at ``(slots, 1)``, regardless of how
   many requests come and go.
+
+Two host↔device traffic rules keep the hot path hot (docs/performance.md):
+
+* **prefix reuse** (``serve/prefix_cache.py``): ``admit`` resolves the
+  longest cached prefix of the prompt, splices that B=1 KV snapshot into
+  the lane, and prefills only the suffix — shared system prompts stop
+  recomputing prefill;
+* **on-device token selection**: the decode step returns a ``(slots,)``
+  int32 token vector (in-graph argmax for greedy; in-graph ``_sample``
+  walking stacked per-lane PRNG keys for temperature > 0), so the per-step
+  device→host transfer is ``slots*4 + slots*8`` bytes instead of
+  ``slots*vocab*4``.  Host keeps only eos/length bookkeeping.
 
 Correctness anchor (proved in ``tests/test_serve.py``): greedy output for
 any request is bit-identical to single-request
@@ -45,6 +60,7 @@ import numpy as np
 
 from ..analysis.recompile_guard import RecompileGuard
 from ..models.generate import _sample
+from .prefix_cache import PrefixCache, resolve_reuse_length
 
 logger = logging.getLogger(__name__)
 
@@ -68,9 +84,14 @@ class EngineConfig:
     prompt_buckets: tuple[int, ...] = (32, 128, 512)
     #: per-request cap on generated tokens; also sizes the KV cache
     max_new_tokens: int = 128
-    #: compile budget: defaults to len(prompt_buckets) + 1 (the decode step);
-    #: the guard RAISES past it — an unexpected compile on the serve path is
-    #: a latency bug, not a warning
+    #: byte budget for the prefix-reuse KV cache (0 = disabled): admissions
+    #: whose prompt shares a cached prefix prefill only the suffix
+    #: (``serve/prefix_cache.py``; ``serve_prefix_cache_mb`` in Settings)
+    prefix_cache_bytes: int = 0
+    #: compile budget: defaults to len(prompt_buckets) + 1 (the decode step),
+    #: or 2*len(prompt_buckets) + 1 with the prefix cache on (fill AND
+    #: fill_from per bucket); the guard RAISES past it — an unexpected
+    #: compile on the serve path is a latency bug, not a warning
     recompile_budget: int = 0
 
     @property
@@ -111,6 +132,7 @@ class GenResult:
 
 @dataclasses.dataclass
 class _Slot:
+    lane: int = 0                      # this slot's row in the batch cache
     req: GenRequest | None = None
     next_pos: int = 0                  # sequence position of the token to feed
     last_token: int = 0                # token to feed at next_pos
@@ -163,18 +185,31 @@ class BatchEngine:
             max_seq_len=self.config.cache_len,
         )
         self._dmodel = type(model)(cfg=self._dcfg)
+        self._prefix_cache = (
+            PrefixCache(self.config.prefix_cache_bytes)
+            if self.config.prefix_cache_bytes > 0 else None
+        )
+        per_bucket = 2 if self._prefix_cache is not None else 1
         budget = self.config.recompile_budget or (
-            len(self.config.prompt_buckets) + 1
+            per_bucket * len(self.config.prompt_buckets) + 1
         )
         self.guard = RecompileGuard(budget, on_excess="raise",
                                     name="serve-engine")
-        self._slots = [_Slot() for _ in range(self.config.slots)]
+        self._slots = [_Slot(lane=i) for i in range(self.config.slots)]
         self._cache = self._init_cache()
-        self._fill, self._decode, self._insert = self._build_fns()
+        # per-lane sampling streams, mirrored to the decode step as a
+        # (slots, 2) uint32 leaf — rows for greedy lanes are inert
+        self._rng_keys = np.zeros((self.config.slots, 2), np.uint32)
+        (self._fill, self._fill_from, self._decode,
+         self._insert, self._reset_lane) = self._build_fns()
         # counters the /metrics gauges read
         self.steps_total = 0
         self.tokens_generated_total = 0
         self.requests_finished_total = 0
+        self.prefix_hits_total = 0
+        self.prefix_misses_total = 0
+        self.prefill_tokens_saved_total = 0
+        self._prefix_warned = False
 
     # ---- jitted pieces ----------------------------------------------------
 
@@ -188,8 +223,15 @@ class BatchEngine:
         )
         return jax.tree.map(jnp.zeros_like, variables["cache"])
 
-    def _build_fns(self) -> tuple[Callable, Callable, Callable]:
+    def _build_fns(self) -> tuple[Callable, ...]:
         dmodel = self._dmodel
+
+        def _index_setter(value):
+            def fix(path, leaf):
+                name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
+                return jnp.full_like(leaf, value) if name == "index" else leaf
+
+            return fix
 
         @jax.jit
         def fill(variables, tokens, last_idx, true_len):
@@ -200,22 +242,77 @@ class BatchEngine:
                 variables, tokens, deterministic=True, decode=True,
                 mutable=("cache",),
             )
-            def fix_index(path, leaf):
-                name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
-                return jnp.full_like(leaf, true_len) if name == "index" else leaf
-
             cache = jax.tree_util.tree_map_with_path(
-                fix_index, updated["cache"]
+                _index_setter(true_len), updated["cache"]
             )
             return jnp.take(logits, last_idx, axis=1).astype(jnp.float32), cache
 
         @jax.jit
-        def decode(variables, cache, tokens, positions):
+        def fill_from(variables, cache, tokens, start, last_idx, true_len):
+            """Suffix prefill over a B=1 prefix snapshot: the first ``start``
+            cache positions are reused as-is, the (bucket-padded) suffix
+            ``tokens`` runs a chunked forward at absolute positions
+            ``[start, start + bucket)``.  Returns logits at the TRUE last
+            prompt position + a lane-ready cache whose index rows read
+            ``true_len`` — the same contract as ``fill``, which is what makes
+            a prefix hit invisible to everything downstream."""
+            cache = jax.tree_util.tree_map_with_path(
+                _index_setter(start), cache
+            )
+            positions = (
+                start + jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+            )
             logits, updated = dmodel.apply(
                 {**variables, "cache": cache}, tokens, positions=positions,
                 deterministic=True, decode=True, mutable=("cache",),
             )
-            return logits[:, -1].astype(jnp.float32), updated["cache"]
+            cache = jax.tree_util.tree_map_with_path(
+                _index_setter(true_len), updated["cache"]
+            )
+            return jnp.take(logits, last_idx, axis=1).astype(jnp.float32), cache
+
+        @jax.jit
+        def decode(variables, cache, tokens, positions, temps, top_ks, rngs):
+            """One batched decode step with ON-DEVICE token selection: returns
+            ``(slots,)`` int32 next tokens + advanced per-lane PRNG keys +
+            the updated cache — the per-step device→host transfer no longer
+            scales with vocab size.  Greedy lanes take the in-graph argmax;
+            sampled lanes walk the SAME ``_sample`` stream a single-request
+            ``cached_generate(rng=PRNGKey(seed))`` walks (scale → per-lane
+            top-k mask → split → categorical), so per-request sampled decodes
+            stay reproducible independent of batch-mates."""
+            logits, updated = dmodel.apply(
+                {**variables, "cache": cache}, tokens, positions=positions,
+                deterministic=True, decode=True, mutable=("cache",),
+            )
+            logits = logits[:, -1].astype(jnp.float32)   # (slots, V)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            vocab = logits.shape[-1]
+
+            def lane_sample(lane_logits, temp, top_k, key, greedy_tok):
+                # mirrors models.generate._sample with traced temp/top_k;
+                # the greedy fallback keeps inactive/greedy lanes inert
+                scaled = lane_logits / jnp.where(temp > 0.0, temp, 1.0)
+                kth = jnp.sort(scaled)[jnp.clip(vocab - top_k, 0, vocab - 1)]
+                dist = jnp.where(
+                    (top_k > 0) & (scaled < kth), -jnp.inf, scaled
+                )
+                split = jax.random.split(key)
+                tok = jax.random.categorical(split[1], dist).astype(jnp.int32)
+                sampled = temp > 0.0
+                return (
+                    jnp.where(sampled, tok, greedy_tok),
+                    jnp.where(sampled, split[0], key),
+                )
+
+            tokens_out, rngs_out = jax.lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: jax.vmap(lane_sample)(logits, temps, top_ks, rngs,
+                                              greedy),
+                # all-greedy traffic skips the per-lane vocab sort entirely
+                lambda: (greedy, rngs),
+            )
+            return tokens_out, rngs_out, updated["cache"]
 
         @jax.jit
         def insert(cache, one, slot):
@@ -229,13 +326,28 @@ class BatchEngine:
 
             return jax.tree.map(put, cache, one)
 
-        # insert has exactly one signature (the cache trees are fixed-shape),
-        # so it stays outside the guard: the budget counts the shapes that
-        # can vary with traffic — prefill buckets and the decode step
+        @jax.jit
+        def reset_lane(cache, slot):
+            """Park a freed lane: zero its cache-index rows so the dead lane
+            keeps writing its throwaway decode tokens at in-bounds positions
+            (index leaves are batch-last: ``(B,)``, or ``(L, B)`` scanned)."""
+
+            def fix(path, leaf):
+                name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
+                return leaf.at[..., slot].set(0) if name == "index" else leaf
+
+            return jax.tree_util.tree_map_with_path(fix, cache)
+
+        # insert and reset_lane have exactly one signature each (the cache
+        # trees are fixed-shape), so they stay outside the guard: the budget
+        # counts the shapes that can vary with traffic — prefill buckets
+        # (fill and fill_from) and the decode step
         return (
             self.guard.wrap(fill, "fill"),
+            self.guard.wrap(fill_from, "fill_from"),
             self.guard.wrap(decode, "decode_step"),
             insert,
+            reset_lane,
         )
 
     # ---- slot management --------------------------------------------------
@@ -252,9 +364,35 @@ class BatchEngine:
     def compilations(self) -> int:
         return self.guard.compilations
 
+    @property
+    def prefix_cache_bytes(self) -> int:
+        return self._prefix_cache.total_bytes if self._prefix_cache else 0
+
+    @property
+    def prefix_cache_entries(self) -> int:
+        return len(self._prefix_cache) if self._prefix_cache else 0
+
+    def _resolve_prefix(self, tokens: list[int], plen: int):
+        """Longest reusable cached prefix for ``tokens`` at bucket
+        granularity; returns ``(reuse_len, snapshot)`` or ``(0, None)``."""
+        match_len, snapshot = self._prefix_cache.lookup(tokens)
+        if snapshot is None:
+            return 0, None
+        reuse = resolve_reuse_length(
+            match_len, plen, self.config.prompt_buckets, self.config.cache_len
+        )
+        if reuse <= 0:
+            return 0, None
+        return reuse, snapshot
+
     def admit(self, req: GenRequest) -> GenResult | None:
         """Prefill ``req`` into a free lane (raises :class:`EngineBusy` when
         the batch is full, :class:`PromptTooLong` past the largest bucket).
+
+        With the prefix cache on, the longest cached prefix of the prompt is
+        spliced in and only the (bucket-padded) suffix runs a forward —
+        greedy/sampled outputs stay bit-identical to the cache-off path
+        because causal KV depends only on the tokens before it.
 
         Returns a :class:`GenResult` when the request finishes ON admission
         (its first sampled token hits eos, or ``max_new_tokens == 1``) —
@@ -273,12 +411,45 @@ class BatchEngine:
         if req.max_new_tokens > cap:
             raise ValueError(f"max_new_tokens {req.max_new_tokens} > engine cap {cap}")
         bucket = self.config.bucket_for(plen)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = req.tokens
-        logits, one = self._fill(
-            self.variables, jnp.asarray(padded),
-            jnp.asarray(plen - 1, jnp.int32), jnp.asarray(plen, jnp.int32),
+        reuse, snapshot = (
+            self._resolve_prefix(req.tokens, plen)
+            if self._prefix_cache is not None else (0, None)
         )
+        if snapshot is not None:
+            suffix = req.tokens[reuse:]
+            sbucket = self.config.bucket_for(len(suffix))
+            padded = np.zeros((1, sbucket), np.int32)
+            padded[0, :len(suffix)] = suffix
+            logits, one = self._fill_from(
+                self.variables, snapshot, jnp.asarray(padded),
+                jnp.asarray(reuse, jnp.int32),
+                jnp.asarray(len(suffix) - 1, jnp.int32),
+                jnp.asarray(plen, jnp.int32),
+            )
+            self.prefix_hits_total += 1
+            self.prefill_tokens_saved_total += reuse
+        else:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = req.tokens
+            logits, one = self._fill(
+                self.variables, jnp.asarray(padded),
+                jnp.asarray(plen - 1, jnp.int32), jnp.asarray(plen, jnp.int32),
+            )
+            if self._prefix_cache is not None:
+                self.prefix_misses_total += 1
+        if self._prefix_cache is not None:
+            # the hit path's `one` is a full-prompt cache too, so every
+            # admission leaves its prompt resolvable for the next request
+            if (not self._prefix_cache.insert(tuple(req.tokens), one)
+                    and not self._prefix_warned):
+                self._prefix_warned = True
+                logger.warning(
+                    "prefix cache cannot hold a single KV snapshot (%d B > "
+                    "budget %d B) — every admission will miss; raise "
+                    "serve_prefix_cache_mb or disable the cache",
+                    sum(x.nbytes for x in jax.tree.leaves(one)),
+                    self._prefix_cache.budget_bytes,
+                )
         self._cache = self._insert(self._cache, one, slot_id)
         slot = self._slots[slot_id]
         slot.req = req
@@ -286,19 +457,27 @@ class BatchEngine:
         slot.next_pos = plen
         slot.rng = jax.random.PRNGKey(req.seed)
         slot.admitted_at = time.monotonic()
-        return self._emit(slot, logits)
+        result = self._emit(slot, logits)
+        if result is None and req.temperature > 0.0:
+            # hand the post-first-token stream to the device-side sampler
+            self._rng_keys[slot_id] = np.asarray(slot.rng, np.uint32)
+        return result
 
     def evict(self, request_id: str) -> GenResult | None:
         """Drop an in-flight request (deadline blown / client gone); frees
-        the lane immediately — the next :meth:`step` simply decodes garbage
-        into it until re-admission, which other rows never see."""
+        the lane immediately and parks its cache index at 0 (see
+        :meth:`_finish`) — the freed lane still rides every step, decoding
+        throwaway tokens at benign in-bounds positions that other rows
+        never see, until re-admission overwrites it."""
         for slot in self._slots:
             if slot.active and slot.req.request_id == request_id:
                 return self._finish(slot, "evicted")
         return None
 
     def _emit(self, slot: _Slot, logits) -> GenResult | None:
-        """Sample the next token for one lane from its logits row."""
+        """Select the FIRST token for a just-admitted lane from its prefill
+        logits row (host-side — a B=1 admission transfer, not the per-step
+        hot path, which selects on device)."""
         req = slot.req
         if req.temperature <= 0.0:
             tok = int(np.argmax(np.asarray(logits[0], np.float32)))
@@ -311,6 +490,11 @@ class BatchEngine:
                 rng=slot.rng,
             )
             tok = int(nxt[0])
+        return self._record(slot, tok)
+
+    def _record(self, slot: _Slot, tok: int) -> GenResult | None:
+        """Host bookkeeping for one selected token: eos/length latching."""
+        req = slot.req
         slot.generated.append(tok)
         slot.last_token = tok
         self.tokens_generated_total += 1
@@ -334,39 +518,55 @@ class BatchEngine:
         slot.req = None
         slot.generated = []
         slot.rng = None
+        slot.last_token = 0
+        slot.next_pos = 0
+        # park the lane's device cache index at 0: a freed lane still rides
+        # every decode step, and left at its stale position it would creep
+        # toward (and past) the cache end — reset keeps its throwaway writes
+        # benign and in-bounds until re-admission overwrites the lane
+        self._cache = self._reset_lane(
+            self._cache, jnp.asarray(slot.lane, jnp.int32)
+        )
         self.requests_finished_total += 1
         return result
 
     # ---- the decode loop --------------------------------------------------
 
     def step(self) -> list[GenResult]:
-        """One batched decode step; returns requests that finished on it."""
+        """One batched decode step; returns requests that finished on it.
+
+        Token selection happens IN the compiled step: the host receives a
+        ``(slots,)`` int32 vector (plus the advanced per-lane PRNG keys),
+        never the ``(slots, vocab)`` logits array."""
         if self.active_requests == 0:
             return []
         tokens = np.zeros((self.config.slots, 1), np.int32)
         positions = np.zeros((self.config.slots, 1), np.int32)
+        temps = np.zeros((self.config.slots,), np.float32)
+        top_ks = np.zeros((self.config.slots,), np.int32)
         for i, slot in enumerate(self._slots):
             if slot.active:
                 tokens[i, 0] = slot.last_token
                 positions[i, 0] = slot.next_pos
-        logits, self._cache = self._decode(
+                temps[i] = max(slot.req.temperature, 0.0)
+                top_ks[i] = slot.req.top_k
+        next_tokens, rng_keys, self._cache = self._decode(
             self.variables, self._cache,
             jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(self._rng_keys),
         )
         self.steps_total += 1
-        host_logits = None
+        next_tokens = np.asarray(next_tokens)
+        # np.array (not asarray): admit() writes per-lane rows into this
+        # buffer, and a zero-copy view of a jax array is read-only
+        self._rng_keys = np.array(rng_keys, np.uint32)
         finished: list[GenResult] = []
         for i, slot in enumerate(self._slots):
             if not slot.active:
                 continue
             slot.next_pos += 1
-            if slot.req.temperature <= 0.0:
-                if host_logits is None:
-                    host_logits = np.asarray(logits, np.float32)
-                row = host_logits[i:i + 1]
-            else:
-                row = logits[i:i + 1]
-            done = self._emit(slot, row)
+            done = self._record(slot, int(next_tokens[i]))
             if done is not None:
                 finished.append(done)
         return finished

@@ -1,0 +1,255 @@
+"""Radix-trie prefix cache over prompt token ids (docs/serving.md).
+
+Production fine-tuned models are overwhelmingly served behind one shared
+system prompt, yet a vanilla continuous-batching engine re-runs the full
+prefill for every request.  This cache stores the B=1 KV snapshot a prefill
+produces — full ``cache_len`` shape, exactly what ``BatchEngine._insert``
+splices into a decode lane — keyed by the prompt's token ids in a
+path-compressed radix trie, so ``admit()`` can resolve the longest cached
+prefix of a new prompt and prefill only the suffix (``fill_from``).
+
+Why a *trie* and not an exact-match dict: causality.  The KV at position
+``i`` depends only on tokens ``[0, i]``, so a snapshot stored for prompt
+``K`` is a bit-exact KV source for ANY prompt sharing a prefix with ``K`` —
+restricted to the shared positions.  The useful lookup is therefore
+"longest common prefix with any stored key", which a radix walk answers in
+O(len(prompt)).  The classic case: one snapshot for ``[system; user_A]``
+serves ``[system; user_B]``'s whole system prompt.
+
+Budgeting: snapshots are device-resident (HBM alongside the serving
+weights), so the cache holds a strict **byte budget** and evicts least
+recently used entries past it.  Entries larger than the whole budget are
+refused outright.  Eviction only drops references — JAX arrays are
+immutable and lanes receive device-side *copies* at splice time, so
+evicting a snapshot mid-flight cannot perturb a request decoding from it
+(pinned in ``tests/test_prefix_cache.py``).
+
+Thread-safety: none needed — the cache is owned by a ``BatchEngine``, whose
+accesses the batcher's single drive loop already serializes (same contract
+as the engine's ``_slots``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Any
+
+
+def resolve_reuse_length(
+    match_len: int,
+    prompt_len: int,
+    buckets: tuple[int, ...],
+    cache_len: int,
+) -> int:
+    """Bucket-granular reuse length for a raw trie match of ``match_len``.
+
+    Two constraints shrink the raw match:
+
+    * at least one real suffix token must remain — the engine needs a
+      forward over ``[L, prompt_len)`` to produce last-position logits, so
+      a full-prompt hit reuses ``prompt_len - 1`` tokens and prefills one;
+    * the suffix is right-padded to a prompt bucket ``b``, and the padded
+      chunk must fit the lane: ``L + b <= cache_len``.
+
+    For each bucket the feasible reuse is the range
+    ``[prompt_len - b, min(match_len, prompt_len - 1, cache_len - b)]``
+    (lower bound: the suffix must fit the bucket; upper bound: the trie
+    match, the one-real-token rule, and the lane end).  The answer is the
+    largest feasible L over all buckets — when bucket rounding overshoots
+    the lane, this reuses *less* so a bigger padded suffix still fits
+    (any prefix of the match is a valid KV source).
+
+    Returns 0 when no usable reuse remains (treat as a miss).
+    """
+    best = 0
+    for bucket in buckets:
+        candidate = min(match_len, prompt_len - 1, cache_len - bucket)
+        if candidate >= max(1, prompt_len - bucket):
+            best = max(best, candidate)
+    return best
+
+
+@dataclasses.dataclass
+class _Entry:
+    key: tuple[int, ...]
+    cache: Any               # B=1 device KV pytree (full cache_len shape)
+    nbytes: int
+    node: "_Node"
+
+
+class _Node:
+    """Radix-trie node; edges are (label, child) keyed by the label's first
+    token.  ``n_entries`` counts stored snapshots in the subtree (self
+    included) so lookups can steer toward a live entry without scanning."""
+
+    __slots__ = ("edges", "entry", "parent", "n_entries")
+
+    def __init__(self, parent: "_Node | None" = None):
+        self.edges: dict[int, tuple[tuple[int, ...], "_Node"]] = {}
+        self.entry: _Entry | None = None
+        self.parent = parent
+        self.n_entries = 0
+
+
+def _lcp(a: tuple[int, ...], b: tuple[int, ...]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+class PrefixCache:
+    """LRU byte-budgeted radix trie of B=1 KV snapshots."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError("PrefixCache needs a positive byte budget "
+                             "(disable the cache instead of zeroing it)")
+        self.budget_bytes = int(budget_bytes)
+        self._root = _Node()
+        self._lru: OrderedDict[tuple[int, ...], _Entry] = OrderedDict()
+        self.total_bytes = 0
+        self.evictions_total = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    # ---- lookup -----------------------------------------------------------
+
+    def lookup(self, tokens: list[int] | tuple[int, ...]) -> tuple[int, Any]:
+        """Longest common prefix with any stored key.
+
+        Returns ``(match_len, cache)``; ``(0, None)`` on a miss.  The hit
+        entry is refreshed in the LRU order.
+        """
+        query = tuple(tokens)
+        node, depth = self._root, 0
+        while depth < len(query):
+            edge = node.edges.get(query[depth])
+            if edge is None:
+                break
+            label, child = edge
+            shared = _lcp(label, query[depth:])
+            depth += shared
+            node = child
+            if shared < len(label):
+                # diverged mid-edge: everything below child still shares
+                # `depth` tokens with the query, nothing shares more
+                break
+        if depth == 0:
+            return 0, None
+        entry = self._pick(node)
+        if entry is None:  # pragma: no cover - n_entries invariant
+            return 0, None
+        self._lru.move_to_end(entry.key)
+        return depth, entry.cache
+
+    def _pick(self, node: _Node) -> _Entry | None:
+        """Any live entry in ``node``'s subtree (they all share the resolved
+        prefix); prefer the shallowest so the walk stays O(depth)."""
+        while node is not None and node.n_entries:
+            if node.entry is not None:
+                return node.entry
+            node = next(
+                (child for _, child in node.edges.values() if child.n_entries),
+                None,
+            )
+        return None
+
+    # ---- insert / evict ---------------------------------------------------
+
+    def insert(self, tokens: list[int] | tuple[int, ...], cache: Any,
+               nbytes: int | None = None) -> bool:
+        """Store ``cache`` under ``tokens``; returns False when refused
+        (empty key, or the snapshot alone exceeds the budget).  Re-inserting
+        an existing key refreshes its LRU slot and keeps the stored snapshot
+        (equal content by construction — same prompt, same weights)."""
+        key = tuple(tokens)
+        if not key:
+            return False
+        existing = self._lru.get(key)
+        if existing is not None:
+            self._lru.move_to_end(key)
+            return True
+        if nbytes is None:
+            nbytes = _tree_nbytes(cache)
+        if nbytes > self.budget_bytes:
+            return False
+        node = self._attach(key)
+        entry = _Entry(key=key, cache=cache, nbytes=nbytes, node=node)
+        node.entry = entry
+        walk = node
+        while walk is not None:
+            walk.n_entries += 1
+            walk = walk.parent
+        self._lru[key] = entry
+        self.total_bytes += nbytes
+        while self.total_bytes > self.budget_bytes:
+            oldest_key = next(iter(self._lru))
+            if oldest_key == key:  # pragma: no cover - nbytes<=budget above
+                break
+            self._evict(self._lru[oldest_key])
+        return True
+
+    def _attach(self, key: tuple[int, ...]) -> _Node:
+        """Walk/extend the trie to the node for ``key``, splitting edges."""
+        node, i = self._root, 0
+        while i < len(key):
+            edge = node.edges.get(key[i])
+            if edge is None:
+                child = _Node(parent=node)
+                node.edges[key[i]] = (key[i:], child)
+                return child
+            label, child = edge
+            shared = _lcp(label, key[i:])
+            if shared == len(label):
+                node, i = child, i + shared
+                continue
+            # split the edge at the divergence point
+            mid = _Node(parent=node)
+            mid.n_entries = child.n_entries
+            mid.edges[label[shared]] = (label[shared:], child)
+            child.parent = mid
+            node.edges[key[i]] = (label[:shared], mid)
+            if shared == len(key) - i:
+                return mid
+            leaf = _Node(parent=mid)
+            mid.edges[key[i + shared]] = (key[i + shared:], leaf)
+            return leaf
+        return node
+
+    def _evict(self, entry: _Entry) -> None:
+        self._lru.pop(entry.key, None)
+        self.total_bytes -= entry.nbytes
+        self.evictions_total += 1
+        node = entry.node
+        node.entry = None
+        walk = node
+        while walk is not None:
+            walk.n_entries -= 1
+            walk = walk.parent
+        # prune now-dead branches so the trie never outgrows the live entries
+        while (node.parent is not None and node.entry is None
+               and not node.edges):
+            parent = node.parent
+            for first, (_, child) in list(parent.edges.items()):
+                if child is node:
+                    del parent.edges[first]
+                    break
+            node = parent
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "entries": len(self._lru),
+            "bytes": self.total_bytes,
+            "budget_bytes": self.budget_bytes,
+            "evictions_total": self.evictions_total,
+        }
+
+
+def _tree_nbytes(cache: Any) -> int:
+    import jax
+
+    return sum(leaf.nbytes for leaf in jax.tree.leaves(cache))

@@ -61,6 +61,10 @@ class ServeManager:
             slots=s.serve_slots,
             prompt_buckets=tuple(s.serve_prompt_buckets),
             max_new_tokens=s.serve_max_new_tokens,
+            prefix_cache_bytes=(
+                int(s.serve_prefix_cache_mb) * (1 << 20)
+                if s.serve_prefix_cache else 0
+            ),
         )
 
     async def load(self, job_id: str) -> dict[str, Any]:

@@ -45,12 +45,14 @@ if [ "$sched_rc" -ne 0 ]; then
     exit "$sched_rc"
 fi
 
-echo "== serve-fast (batching invariance + metrics) ==" >&2
+echo "== serve-fast (batching invariance + prefix cache + metrics) ==" >&2
 # no 'not slow' filter here: the serve suite IS this stage's whole job, so
-# its slow-marked extras (sampled-decode parity) run too — they are excluded
-# from tier-1 below only to protect that stage's wall-clock budget
+# its slow-marked extras (sampled-decode parity, prefix-cache eviction
+# mid-flight) run too — they are excluded from tier-1 below only to protect
+# that stage's wall-clock budget
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_serve.py tests/test_metrics_endpoint.py -q \
+    tests/test_serve.py tests/test_prefix_cache.py \
+    tests/test_metrics_endpoint.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 serve_rc=$?
 if [ "$serve_rc" -ne 0 ]; then

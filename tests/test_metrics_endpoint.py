@@ -119,26 +119,38 @@ def test_metrics_serve_gauges_after_generate(tmp_path):
         rt = _serve_runtime(tmp_path)
         client = await _client(rt, with_monitor=False)
         job_id = await _fabricate_promoted_job(rt)
-        r = await client.post(
-            f"/api/v1/jobs/{job_id}/generate",
-            json={"tokens": [5, 9, 2, 7], "max_new_tokens": 5},
-        )
-        assert r.status == 200, await r.text()
+        for _ in range(2):  # the identical repeat is a prefix-cache hit
+            r = await client.post(
+                f"/api/v1/jobs/{job_id}/generate",
+                json={"tokens": [5, 9, 2, 7], "max_new_tokens": 5},
+            )
+            assert r.status == 200, await r.text()
 
         body = await (await client.get("/metrics")).text()
         assert "ftc_serve_models_loaded 1" in body
         label = f'job_id="{job_id}"'
-        assert f"ftc_serve_tokens_generated_total{{{label}}} 5" in body
-        assert f"ftc_serve_requests_completed_total{{{label}}} 1" in body
+        assert f"ftc_serve_tokens_generated_total{{{label}}} 10" in body
+        assert f"ftc_serve_requests_completed_total{{{label}}} 2" in body
         assert f"ftc_serve_slots_total{{{label}}} {rt.settings.serve_slots}" in body
         assert f"ftc_serve_queue_depth{{{label}}} 0" in body
         assert f"ftc_serve_slots_busy{{{label}}} 0" in body
-        # decode-step compile count stayed within the bucket-bounded budget
+        # prefix-reuse counters (ISSUE 6): one cold miss, one exact-key hit
+        # that reused all but the final prompt token
+        assert f"ftc_serve_prefix_misses_total{{{label}}} 1" in body
+        assert f"ftc_serve_prefix_hits_total{{{label}}} 1" in body
+        assert f"ftc_serve_prefill_tokens_saved_total{{{label}}} 3" in body
+        m = re.search(
+            rf"ftc_serve_prefix_cache_bytes\{{{re.escape(label)}\}} (\d+)",
+            body,
+        )
+        assert m is not None and int(m.group(1)) > 0
+        # compile count stayed within the bucket-bounded budget (fill and
+        # fill_from per bucket + the decode step, since the cache is on)
         m = re.search(
             rf"ftc_serve_compilations\{{{re.escape(label)}\}} (\d+)", body
         )
         assert m is not None
-        assert int(m.group(1)) <= len(rt.settings.serve_prompt_buckets) + 1
+        assert int(m.group(1)) <= 2 * len(rt.settings.serve_prompt_buckets) + 1
         await client.close()
 
     run_async(main())

@@ -137,6 +137,53 @@ def test_sampled_decode_reproducible_per_request(tiny_model):
         assert results[f"s{i}"].generated == want
 
 
+def test_batching_invariance_with_prefix_cache_staggered(tiny_model):
+    """ISSUE 6: the invariance anchor holds with the prefix cache ON —
+    fill_from admissions (shared-prefix and exact-key hits) join MID-FLIGHT
+    next to cold misses, and every request still matches single-request
+    cached_generate bit-for-bit."""
+    model, variables = tiny_model
+    eng = _engine(model, variables, slots=2, prefix_cache_bytes=1 << 20)
+    shared = [5, 9, 2, 7, 1, 3]
+    prompts = [
+        shared + [11, 4],        # miss: seeds the shared prefix
+        shared + [7, 7, 7],      # shared-prefix hit, admitted mid-flight
+        [2, 13],                 # miss next to a hit in the same batch
+        shared + [11, 4],        # exact-key hit
+        shared + [2, 2, 2, 2, 2, 2, 2, 2],  # longer prompt, shared-prefix hit
+    ]
+    max_new = [10, 8, 11, 4, 6]
+    reqs = [
+        GenRequest(request_id=f"r{i}", tokens=p, max_new_tokens=max_new[i])
+        for i, p in enumerate(prompts)
+    ]
+    results = {}
+
+    def collect(done_list):
+        for r in done_list:
+            results[r.request_id] = r
+
+    eng.admit(reqs[0])
+    collect(eng.step())
+    collect(eng.step())
+    eng.admit(reqs[1])           # hit splices in while r0 decodes
+    collect(eng.step())
+    pending = reqs[2:]
+    while pending or eng.active_requests:
+        while pending and eng.free_slots:
+            done = eng.admit(pending.pop(0))
+            if done is not None:
+                results[done.request_id] = done
+        collect(eng.step())
+
+    assert eng.prefix_hits_total >= 3 and eng.prefix_misses_total == 2
+    assert eng.prefill_tokens_saved_total >= 3 * len(shared)
+    for i, p in enumerate(prompts):
+        want = _baseline(model, variables, p, reqs[i].max_new_tokens)
+        assert results[f"r{i}"].generated == want, f"request r{i} diverged"
+    assert eng.compilations <= 2 * len(eng.config.prompt_buckets) + 1
+
+
 def test_eos_latching_finishes_early(tiny_model):
     """A request whose greedy path emits eos finishes with reason "eos" and
     its tokens match the cached_generate prefix up to (and including) it."""
@@ -203,6 +250,88 @@ def test_eviction_frees_lane_and_preserves_others(tiny_model):
             results[r.request_id] = r
     assert results["keep"].generated == _baseline(model, variables, [5, 9, 2, 7], 8)
     assert results["late"].generated == _baseline(model, variables, [7, 7, 7], 4)
+
+
+def test_evicted_lane_parks_benign(tiny_model):
+    """ISSUE 6 satellite: a freed lane must not keep decoding at its stale
+    cache position.  After evict, the lane's device cache index rows read 0
+    (benign, in-bounds), post-evict steps generate tokens only for live
+    lanes, and the survivor's output stays bit-identical."""
+    import jax.tree_util as jtu
+
+    model, variables = tiny_model
+
+    def index_rows(eng, lane):
+        rows = []
+        for path, leaf in jtu.tree_flatten_with_path(eng._cache)[0]:
+            name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
+            if name == "index":
+                rows.extend(np.asarray(leaf)[..., lane].reshape(-1).tolist())
+        assert rows, "no cache index leaves found"
+        return rows
+
+    eng = _engine(model, variables, slots=2)
+    keep = GenRequest(request_id="keep", tokens=[5, 9, 2, 7], max_new_tokens=8)
+    gone = GenRequest(request_id="gone", tokens=[1, 3, 3, 8], max_new_tokens=8)
+    eng.admit(keep)
+    eng.admit(gone)
+    eng.step()
+    gone_lane = next(
+        i for i, s in enumerate(eng._slots)
+        if s.req is not None and s.req.request_id == "gone"
+    )
+    assert all(r > 0 for r in index_rows(eng, gone_lane))  # mid-decode
+    eng.evict("gone")
+    assert all(r == 0 for r in index_rows(eng, gone_lane))  # parked at 0
+    # post-evict steps advance ONLY the live lane's token count
+    before = eng.tokens_generated_total
+    results = {}
+    steps = 0
+    while eng.active_requests:
+        for r in eng.step():
+            results[r.request_id] = r
+        steps += 1
+    assert eng.tokens_generated_total - before == steps  # 1 live lane
+    assert results["keep"].generated == _baseline(
+        model, variables, [5, 9, 2, 7], 8
+    )
+
+
+def test_decode_index_saturates_at_cache_end(tiny_model):
+    """The decode write clamps to the last cache slot and the index advance
+    saturates at ``max_seq_len``: identity for live rows, but a parked lane
+    riding the batched step indefinitely can never creep out of bounds —
+    the invariant ``test_evicted_lane_parks_benign`` relies on holds for
+    arbitrarily long idle stretches, not just the first few steps."""
+    import jax.tree_util as jtu
+
+    model, variables = tiny_model
+    dcfg = model.cfg.replace(remat=False, attention_impl="xla", max_seq_len=8)
+    dmodel = type(model)(cfg=dcfg)
+    tokens = jnp.asarray([[5, 9, 2, 7], [1, 3, 3, 8]], jnp.int32)
+    _, upd = dmodel.apply(
+        variables, tokens, deterministic=True, decode=True,
+        mutable=("cache",),
+    )
+
+    def park_row0(path, leaf):
+        name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
+        return leaf.at[..., 0].set(8) if name == "index" else leaf
+
+    cache = jtu.tree_map_with_path(park_row0, upd["cache"])  # row0 at the end
+    logits, upd2 = dmodel.apply(
+        {**variables, "cache": cache},
+        jnp.asarray([[0], [4]], jnp.int32),
+        positions=jnp.asarray([[0], [4]], jnp.int32),
+        deterministic=True, decode=True, mutable=("cache",),
+    )
+    assert bool(jnp.isfinite(logits).all())
+    for path, leaf in jtu.tree_flatten_with_path(upd2["cache"])[0]:
+        name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
+        if name == "index":
+            rows = np.asarray(leaf).reshape(-1, 2)
+            assert (rows[:, 0] == 8).all()  # saturated, NOT 9
+            assert (rows[:, 1] == 5).all()  # live row advances normally
 
 
 def test_engine_input_validation(tiny_model):
@@ -275,6 +404,37 @@ def test_batcher_queue_overflow_rejects(tiny_model):
         with pytest.raises(QueueFull):
             await b.submit(GenRequest(request_id="q", tokens=[1], max_new_tokens=2))
         assert b.rejected_total == 1
+        await b.close()
+
+    run_async(main())
+
+
+def test_max_wait_ms_is_the_idle_park_interval(tiny_model):
+    """ISSUE 6 satellite: the once-dead ``max_wait_ms`` knob now sets the
+    drive loop's idle park interval (with a 1 ms floor), and a parked driver
+    still wakes IMMEDIATELY on submit — the knob bounds the fallback
+    re-check, never first-token latency."""
+    import time as _time
+
+    model, variables = tiny_model
+
+    async def main():
+        eng = _engine(model, variables, slots=1)
+        b = Batcher(eng, max_wait_ms=30_000.0)
+        assert b._park_timeout_s == 30.0
+        assert Batcher(eng, max_wait_ms=0.0)._park_timeout_s == 0.001
+        # default 1 s: submissions wake the loop via the event, so a large
+        # idle interval costs nothing — a small one just burns idle CPU
+        assert Batcher(eng)._park_timeout_s == 1.0
+        b.start()
+        await asyncio.sleep(0.05)  # the driver parks on the 30 s interval
+        t0 = _time.monotonic()
+        res = await b.submit(
+            GenRequest(request_id="wake", tokens=[5, 9], max_new_tokens=2)
+        )
+        # served via the wake event, nowhere near the 30 s park interval
+        assert _time.monotonic() - t0 < 10.0
+        assert res.generated == _baseline(model, variables, [5, 9], 2)
         await b.close()
 
     run_async(main())
@@ -487,11 +647,17 @@ def test_generate_endpoint_end_to_end(tmp_path):
         r2 = await client.post(f"/api/v1/jobs/{job_id}/generate", json=body)
         assert (await r2.json())["tokens"] == out["tokens"]
 
-        # admin status sees the loaded session and its counters
+        # admin status sees the loaded session and its counters — including
+        # the prefix cache economics: the repeated identical prompt above
+        # was an exact-key hit that skipped most of its prefill
         r = await client.get("/api/v1/admin/serve")
         sessions = (await r.json())["sessions"]
         assert job_id in sessions
         assert sessions[job_id]["tokens_generated_total"] >= 12
+        assert sessions[job_id]["prefix_misses_total"] >= 1
+        assert sessions[job_id]["prefix_hits_total"] >= 1
+        assert sessions[job_id]["prefill_tokens_saved_total"] >= 3
+        assert sessions[job_id]["prefix_cache_bytes"] > 0
 
         # unload then explicit admin load round-trips
         r = await client.post(f"/api/v1/admin/serve/{job_id}/unload")
@@ -574,6 +740,15 @@ def test_ctl_generate_hits_serving_endpoint(tmp_path, capsys):
             assert len(out["tokens"]) == 4
             assert out["finish_reason"] == "length"
             assert out["prompt_tokens"] == [5, 9, 2, 7]
+
+            # `ftc-ctl serve`: the serving-session table with prefix stats
+            rc = await ctl.amain(ctl.build_parser().parse_args([
+                "--api", api, "serve",
+            ]))
+            assert rc == 0
+            table = capsys.readouterr().out
+            assert job_id in table
+            assert "HITS" in table and "SAVED" in table
 
             # unknown job -> 404 through the client's error mapping
             with pytest.raises(ctl.ApiError, match="404"):
