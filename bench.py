@@ -502,20 +502,24 @@ def _measure_chaos_recovery() -> dict:
 
 
 def _measure_sched() -> dict:
-    """BENCH_MODE=sched: fair-share scheduler vs the FIFO baseline.
+    """BENCH_MODE=sched: fair-share vs FIFO, and resize vs full eviction.
 
-    Replays the canonical head-of-line-blocking trace (``sched/sim.py``:
-    long low-priority batch jobs saturate the cluster, then a stream of
-    short higher-tenant jobs arrives) through BOTH schedulers on the
-    deterministic simulator and reports, against FIFO on the same seeded
-    trace: makespan, Jain fairness index over entitlement-normalised
-    contention chip-seconds, p95/p50 queue wait for the small (1-chip)
-    jobs — the head-of-line-blocking number — plus the fair-share side's
-    preemption count and preempt→readmit latency (the checkpoint-aware
-    eviction cost).  Pure control-flow: no accelerator, milliseconds.
+    Two gated comparisons on the deterministic simulator (pure control
+    flow: no accelerator, milliseconds):
+
+    1. **fair-share vs FIFO** on the canonical head-of-line-blocking trace
+       (PR 5): small-job p95 wait and the Jain index must both improve.
+    2. **resize vs full eviction** on the capacity-reclaim trace
+       (``sched/sim.py::elastic_trace`` — a whole-cluster XL job loses
+       chips to a high-priority reclaim + tenant stream): resize must
+       strictly reduce chip-seconds-of-progress-lost (checkpoint replay +
+       exit-grace overhead + demanded-but-idle capacity), with Jain no
+       worse and small-job p95 wait within two exit graces of the evict
+       leg (ISSUE 7).
 
     Knobs: BENCH_SCHED_SEED, BENCH_SCHED_CHIPS, BENCH_SCHED_BIG,
-    BENCH_SCHED_SMALL.
+    BENCH_SCHED_SMALL, BENCH_SCHED_GROW_DELAY (virtual seconds the grow
+    pass waits for tenant-quiet before restoring a shrunk job).
     """
     from finetune_controller_tpu.controller.backends.scheduler import (
         GangScheduler,
@@ -524,6 +528,7 @@ def _measure_sched() -> dict:
     from finetune_controller_tpu.sched.sim import (
         TRACE_QUEUES,
         ClusterSim,
+        elastic_trace,
         percentile,
         sim_catalog,
         synthetic_trace,
@@ -533,13 +538,17 @@ def _measure_sched() -> dict:
     chips = int(os.environ.get("BENCH_SCHED_CHIPS", "8"))
     n_big = int(os.environ.get("BENCH_SCHED_BIG", "4"))
     n_small = int(os.environ.get("BENCH_SCHED_SMALL", "24"))
+    grow_delay = float(os.environ.get("BENCH_SCHED_GROW_DELAY", "5"))
+    preempt_exit_s = 1.0
     catalog = sim_catalog(chips)
     trace = synthetic_trace(seed, n_big=n_big, n_small=n_small)
+    reclaim_trace = elastic_trace(seed)
 
-    def leg(factory) -> tuple[dict, float, float]:
+    def leg(factory, trace) -> tuple[dict, "object"]:
         # both legs score fairness against the SAME entitlements
         report = ClusterSim(
-            catalog, factory, queue_weights=TRACE_QUEUES
+            catalog, factory, queue_weights=TRACE_QUEUES,
+            preempt_exit_s=preempt_exit_s,
         ).run(trace)
         unfinished = [
             o.job_id for o in report.outcomes.values() if o.finish_s is None
@@ -548,53 +557,113 @@ def _measure_sched() -> dict:
             fail("sched bench: jobs never finished", unfinished=unfinished)
         waits = report.waits(max_chips=1)
         lat = report.preempt_resume_latencies_s
-        raw_p95 = percentile(waits, 95)
         out = {
             "makespan_s": round(report.makespan_s, 1),
             "jain_fairness": round(report.jain_fairness, 3),
             "preemptions": report.preemptions,
+            "resizes": report.resizes,
             "small_job_wait_p50_s": round(percentile(waits, 50), 1),
-            "small_job_wait_p95_s": round(raw_p95, 1),
+            "small_job_wait_p95_s": round(percentile(waits, 95), 1),
             "preempt_readmit_p50_s": (
                 round(percentile(lat, 50), 1) if lat else None
             ),
             "preempt_readmit_p95_s": (
                 round(percentile(lat, 95), 1) if lat else None
             ),
+            "progress_lost_chip_s": round(
+                report.progress_lost_chip_seconds, 1
+            ),
+            "replay_lost_chip_s": round(report.replay_lost_chip_seconds, 1),
+            "exit_overhead_chip_s": round(
+                report.exit_overhead_chip_seconds, 1
+            ),
+            "idle_demand_chip_s": round(report.idle_demand_chip_seconds, 1),
         }
-        # gate on the RAW numbers: an improvement smaller than the display
-        # rounding grain must still count as an improvement
-        return out, raw_p95, report.jain_fairness
+        # gating uses the RAW report: an improvement smaller than the
+        # display rounding grain must still count as an improvement
+        return out, report
 
-    fifo, fifo_p95, fifo_jain = leg(lambda clock: GangScheduler(catalog))
-    fair, fair_p95, fair_jain = leg(
-        lambda clock: FairShareScheduler(catalog, TRACE_QUEUES, clock=clock)
+    def p95(report) -> float:
+        return percentile(report.waits(max_chips=1), 95)
+
+    # -- gate 1: fair-share vs FIFO (PR 5, unchanged) -----------------------
+    fifo, fifo_r = leg(lambda clock: GangScheduler(catalog), trace)
+    fair, fair_r = leg(
+        lambda clock: FairShareScheduler(catalog, TRACE_QUEUES, clock=clock),
+        trace,
     )
-    if fair_p95 >= fifo_p95:
+    if p95(fair_r) >= p95(fifo_r):
         fail(
             "sched bench: fair-share did not reduce small-job p95 wait",
             fifo=fifo, fairshare=fair,
         )
-    if fair_jain <= fifo_jain:
+    if fair_r.jain_fairness <= fifo_r.jain_fairness:
         fail(
             "sched bench: fair-share did not improve the Jain index",
             fifo=fifo, fairshare=fair,
         )
+
+    # -- gate 2: resize vs full eviction (ISSUE 7) --------------------------
+    evict, evict_r = leg(
+        lambda clock: FairShareScheduler(
+            catalog, TRACE_QUEUES, clock=clock, resize=False,
+        ),
+        reclaim_trace,
+    )
+    resize, resize_r = leg(
+        lambda clock: FairShareScheduler(
+            catalog, TRACE_QUEUES, clock=clock,
+            resize=True, grow_delay_s=grow_delay,
+        ),
+        reclaim_trace,
+    )
+    if (resize_r.progress_lost_chip_seconds
+            >= evict_r.progress_lost_chip_seconds):
+        fail(
+            "sched bench: resize did not reduce chip-seconds of progress "
+            "lost vs full eviction",
+            evict=evict, resize=resize,
+        )
+    if resize_r.jain_fairness < evict_r.jain_fairness:
+        fail(
+            "sched bench: resize regressed Jain fairness vs eviction",
+            evict=evict, resize=resize,
+        )
+    if p95(resize_r) > p95(evict_r) + 2.0 * preempt_exit_s + 0.5:
+        # resize may pay up to two extra exit graces on the wait tail
+        # (shrink cascades free chips in smaller pieces); more is a
+        # regression
+        fail(
+            "sched bench: resize regressed small-job p95 wait vs eviction",
+            evict=evict, resize=resize,
+        )
+    if resize_r.resizes <= 0:
+        fail("sched bench: the resize leg never resized", resize=resize)
+
     return {
         "metric": (
-            f"sched_small_job_wait_p95[chips{chips},big{n_big},"
-            f"small{n_small},seed{seed}]"
+            f"sched_progress_lost_chip_s[chips{chips},seed{seed},"
+            f"grow{grow_delay:g}]"
         ),
-        "value": fair["small_job_wait_p95_s"],
-        "unit": "s (p95 queue wait, 1-chip jobs, fair-share)",
+        "value": resize["progress_lost_chip_s"],
+        "unit": "chip-seconds of progress lost (resize, reclaim trace)",
         "fifo": fifo,
         "fairshare": fair,
-        "wait_p95_speedup": round(
+        "fairshare_evict": evict,
+        "fairshare_resize": resize,
+        "wait_p95_speedup_vs_fifo": round(
             fifo["small_job_wait_p95_s"]
             / max(fair["small_job_wait_p95_s"], 1e-9), 1,
         ),
-        "jain_delta": round(
+        "jain_delta_vs_fifo": round(
             fair["jain_fairness"] - fifo["jain_fairness"], 3
+        ),
+        "progress_lost_reduction": round(
+            1.0 - resize_r.progress_lost_chip_seconds
+            / max(evict_r.progress_lost_chip_seconds, 1e-9), 3,
+        ),
+        "jain_delta_resize_vs_evict": round(
+            resize["jain_fairness"] - evict["jain_fairness"], 3
         ),
         "queues": TRACE_QUEUES,
     }

@@ -61,11 +61,19 @@ class TrainingBackend(abc.ABC):
         """One job's report, or None if the backend no longer tracks it."""
 
     @abc.abstractmethod
-    async def delete_job(self, job_id: str) -> bool:
+    async def delete_job(self, job_id: str, *,
+                         forget_reservations: bool = False) -> bool:
         """Stop (if needed) and forget a job — used both for post-success
         cluster cleanup (``app/core/monitor.py:182-186``) and user cancel
         (``app/main.py:839-903``). Artifacts already live in the object
-        store, so deletion loses nothing."""
+        store, so deletion loses nothing.
+
+        ``forget_reservations=True`` (terminal deletions: success cleanup,
+        user cancel) additionally drops any scheduler resize reservation the
+        job holds — it is not coming back at a new size.  The default keeps
+        reservations alive: the retry supervisor's teardown of a mid-resize
+        victim must NOT release the chips fenced for its own resubmit
+        (docs/elasticity.md)."""
 
     @abc.abstractmethod
     async def read_logs(

@@ -949,7 +949,11 @@ class K8sJobSetBackend(TrainingBackend):
         obj = await self.client.get(self._jobsets_path, job_id)
         return self._report(obj) if obj else None
 
-    async def delete_job(self, job_id: str) -> bool:
+    async def delete_job(self, job_id: str, *,
+                         forget_reservations: bool = False) -> bool:
+        # forget_reservations is part of the backend contract (base.py) but
+        # moot here: Kueue owns admission, this backend holds no in-process
+        # scheduler reservations
         await self.client.delete(self._configmaps_path, f"{job_id}-spec")
         return await self.client.delete(self._jobsets_path, job_id)
 

@@ -73,6 +73,16 @@ class _JobHandle:
         #: supervisor requeues it with resume (docs/scheduling.md)
         self.preempted = False
         self.preempted_by = ""
+        #: scheduler resize (docs/elasticity.md): the supervisor resubmits
+        #: the job at this slice count instead of its current topology
+        self.resize_to: int | None = None
+        self.resize_kind = ""  # "shrink" | "grow" ("" = plain eviction)
+        #: topology bookkeeping for elastic admission / resize re-renders
+        self.requested_slices = 1
+        self.granted_slices = 1
+        self.spec_obj: BaseFineTuneJob | None = None
+        self.flavor_obj: DeviceFlavor | None = None
+        self.dataset_path: str | None = None
         self.exit_code: int | None = None  # last attempt's exit code
         self.restored_checkpoints = 0  # files staged back from the store
         self.start_time: float | None = None
@@ -114,6 +124,8 @@ class LocalProcessBackend(TrainingBackend):
         warm_workers: int = 0,
         sched_policy: str = "fairshare",
         sched_queues: dict[str, float] | None = None,
+        sched_resize: bool = True,
+        sched_grow_delay_s: float = 60.0,
     ):
         self.root = Path(root_dir).expanduser()
         self.root.mkdir(parents=True, exist_ok=True)
@@ -125,7 +137,10 @@ class LocalProcessBackend(TrainingBackend):
         if sched_policy == "fifo":
             self.scheduler = GangScheduler(catalog)
         elif sched_policy == "fairshare":
-            self.scheduler = FairShareScheduler(catalog, sched_queues)
+            self.scheduler = FairShareScheduler(
+                catalog, sched_queues,
+                resize=sched_resize, grow_delay_s=sched_grow_delay_s,
+            )
         else:
             raise ValueError(f"unknown sched_policy {sched_policy!r}")
         self.sync_interval_s = sync_interval_s
@@ -195,9 +210,18 @@ class LocalProcessBackend(TrainingBackend):
 
             handle.queue = job.queue
             handle.priority = job.priority
+            # elastic-admission context (docs/elasticity.md): the scheduler
+            # may grant FEWER slices than asked — the spec/env must then be
+            # re-rendered at the granted topology before spawn
+            handle.spec_obj = spec
+            handle.flavor_obj = flavor
+            handle.dataset_path = dataset_path
+            handle.requested_slices = job.requested_num_slices or job.num_slices
+            handle.granted_slices = job.num_slices
             self.scheduler.submit(
                 job.job_id, flavor.name, job.num_slices,
                 queue=job.queue, priority=job.priority,
+                requested_slices=handle.requested_slices,
             )
             self._lost.pop(job.job_id, None)  # resubmit clears any tombstone
             handle.set_state(BackendJobState.SUSPENDED)
@@ -425,31 +449,98 @@ class LocalProcessBackend(TrainingBackend):
                     metadata={"exit_code": None, "restarts": 0},
                 )
                 continue
+            granted = getattr(w, "num_slices", handle.granted_slices)
+            if granted != handle.granted_slices:
+                # elastic admission: the scheduler granted a smaller
+                # topology than the spec was rendered for — re-render the
+                # mesh/env at the granted size (topology-portable
+                # checkpoints make the resumed state land on it cleanly)
+                try:
+                    self._rerender_topology(handle, granted)
+                except Exception as exc:
+                    logger.exception(
+                        "re-rendering %s at %d slices failed", w.job_id, granted
+                    )
+                    handle.set_state(
+                        BackendJobState.FAILED, f"elastic re-render failed: {exc}"
+                    )
+                    self.scheduler.release(w.job_id)
+                    continue
             handle.set_state(BackendJobState.CREATED)
             handle.event(
-                "Admitted", f"queue={w.queue} priority={handle.priority}"
+                "Admitted",
+                f"queue={w.queue} priority={handle.priority} "
+                f"slices={granted}/{handle.requested_slices}",
             )
             handle.run_task = asyncio.get_running_loop().create_task(self._run(handle))
         self._execute_preemptions()
 
+    def _rerender_topology(self, handle: _JobHandle, num_slices: int) -> None:
+        """Rewrite the trainer spec + runtime env for a new slice count
+        (elastic admission granted less than asked).  The global batch stays
+        in the spec untouched — ``train/elastic.py`` recomputes the
+        microstructure at resume/start time."""
+        spec, flavor = handle.spec_obj, handle.flavor_obj
+        if spec is None or flavor is None:
+            raise RuntimeError("no render context on the handle")
+        mesh = default_mesh_for(flavor, num_slices, policy=spec.mesh_policy)
+        trainer_spec = spec.build_trainer_spec(
+            handle.job_id,
+            str(handle.artifacts_dir),
+            dataset_path=handle.dataset_path,
+            mesh=mesh,
+        )
+        handle.spec_path.write_text(json.dumps(trainer_spec, indent=2))
+        handle.env = self._runtime_env(flavor, num_slices)
+        handle.granted_slices = num_slices
+        handle.event(
+            "ElasticAdmission",
+            f"granted {num_slices}/{handle.requested_slices} slices",
+        )
+
     def _execute_preemptions(self) -> None:
-        """Deliver the scheduler's eviction decisions: SIGTERM each victim so
-        the trainer checkpoints and exits 143; the run loop then reports
-        FAILED without burning local restarts, and the resilience supervisor
-        requeues the victim with resume.  The victim's chips stay reserved
-        for the preemptor inside the scheduler until they actually free."""
+        """Deliver the scheduler's eviction/resize decisions: SIGTERM each
+        victim so the trainer checkpoints and exits 143; the run loop then
+        reports FAILED without burning local restarts, and the resilience
+        supervisor requeues the victim with resume — at ``to_slices`` when
+        the decision is a resize (docs/elasticity.md).  The victim's chips
+        stay reserved (for the preemptor, and for the victim's own shrunk
+        resubmit) inside the scheduler until they actually free."""
         take = getattr(self.scheduler, "take_preemptions", None)
         if take is None:
             return
-        for victim_id, preemptor_id in take():
+        for decision in take():
+            victim_id = decision.job_id
+            preemptor_id = decision.preemptor_id or ""
             handle = self._handles.get(victim_id)
             if handle is None:
-                self.scheduler.release(victim_id)
+                # no backend half to resize: drop the workload AND any
+                # reservation the decision just created — nothing will
+                # resubmit to consume it
+                getattr(self.scheduler, "forget", self.scheduler.release)(
+                    victim_id
+                )
                 continue
             handle.preempted = True
             handle.preempted_by = preemptor_id
-            handle.event("Preempted", f"evicted for {preemptor_id}")
-            logger.info("preempting job %s for %s", victim_id, preemptor_id)
+            if decision.kind == "evict":
+                handle.event("Preempted", f"evicted for {preemptor_id}")
+                logger.info("preempting job %s for %s", victim_id, preemptor_id)
+            else:
+                handle.resize_to = decision.to_slices
+                handle.resize_kind = decision.kind
+                handle.event(
+                    "Resizing",
+                    f"{decision.kind} {decision.from_slices}->"
+                    f"{decision.to_slices} slices"
+                    + (f" for {preemptor_id}" if preemptor_id else ""),
+                )
+                logger.info(
+                    "resizing job %s: %s %d->%d slices%s",
+                    victim_id, decision.kind, decision.from_slices,
+                    decision.to_slices,
+                    f" for {preemptor_id}" if preemptor_id else "",
+                )
             if handle.proc is not None:
                 with contextlib.suppress(ProcessLookupError):
                     handle.proc.terminate()
@@ -480,18 +571,31 @@ class LocalProcessBackend(TrainingBackend):
                     # the job trained to completion and must be SUCCEEDED,
                     # not spuriously failed-and-requeued
                     handle.preempted = False
+                    handle.resize_to = None
+                    handle.resize_kind = ""
                     outcome = BackendJobState.SUCCEEDED
                     break
                 if handle.preempted:
-                    # scheduler eviction: do NOT restart locally — the chips
-                    # are reserved for the preemptor.  Report FAILED with the
+                    # scheduler eviction/resize: do NOT restart locally — the
+                    # chips are reserved (for the preemptor and, on a resize,
+                    # for this job's own resubmit).  Report FAILED with the
                     # SIGTERM exit code so the supervisor classifies it as a
-                    # preemption and requeues it with resume.
+                    # preemption and requeues it with resume — at the resize
+                    # topology when one is set.
                     outcome = BackendJobState.FAILED
-                    message = (
-                        f"preempted by scheduler for {handle.preempted_by} "
-                        f"(exit code {rc})"
-                    )
+                    if handle.resize_to is not None:
+                        message = (
+                            f"resized by scheduler ({handle.resize_kind} to "
+                            f"{handle.resize_to} slices"
+                            + (f" for {handle.preempted_by}"
+                               if handle.preempted_by else "")
+                            + f"; exit code {rc})"
+                        )
+                    else:
+                        message = (
+                            f"preempted by scheduler for {handle.preempted_by} "
+                            f"(exit code {rc})"
+                        )
                     break
                 attempt += 1
                 handle.restarts = attempt
@@ -648,12 +752,28 @@ class LocalProcessBackend(TrainingBackend):
         }
         if handle.restored_checkpoints:
             metadata["restored_checkpoints"] = handle.restored_checkpoints
+        # the topology this attempt actually runs at: the supervisor's
+        # elastic-restore accounting compares successive attempts against
+        # it, and an elastic ADMISSION (granted < asked on the very first
+        # attempt) would otherwise be invisible to it
+        metadata["last_ran_num_slices"] = handle.granted_slices
+        if handle.granted_slices != handle.requested_slices:
+            # running elastically below its requested topology
+            metadata["current_num_slices"] = handle.granted_slices
+            metadata["requested_num_slices"] = handle.requested_slices
         if handle.preempted:
             # persisted by the monitor's metadata merge -> the preemption
             # event survives in the job document (crash-safe, like
             # retry_next_at)
             metadata["preempted"] = True
-            metadata["preempted_by"] = handle.preempted_by
+            if handle.preempted_by:
+                metadata["preempted_by"] = handle.preempted_by
+        if handle.resize_to is not None:
+            # the supervisor resubmits at this topology (crash-safe: the
+            # monitor merges it into the job document before the RETRYING
+            # transition)
+            metadata["resize_to_num_slices"] = handle.resize_to
+            metadata["resize_kind"] = handle.resize_kind
         return BackendJobReport(
             job_id=handle.job_id,
             state=handle.state,
@@ -683,16 +803,24 @@ class LocalProcessBackend(TrainingBackend):
 
     # ---------------------------------------------------------------- control
 
-    async def delete_job(self, job_id: str) -> bool:
+    async def delete_job(self, job_id: str, *,
+                         forget_reservations: bool = False) -> bool:
         """Kill + forget (cluster-delete equivalent; DB record survives).
 
         Escalates SIGTERM → SIGKILL: a trainer hung hard enough to trip the
         liveness lease may ignore SIGTERM, and the supervisor resubmits into
         the SAME sandbox — two writers on one artifacts dir would corrupt
         the checkpoints the resumed attempt depends on, so the old process
-        must be dead before this returns."""
+        must be dead before this returns.
+
+        ``forget_reservations`` (terminal deletions only) also drops the
+        job's scheduler resize reservation — see the base-class contract."""
+        release = self.scheduler.release
+        if forget_reservations:
+            release = getattr(self.scheduler, "forget", release)
         if self._lost.pop(job_id, None) is not None:
             # tombstone of a job that never started: nothing to kill
+            release(job_id)
             return True
         handle = self._handles.pop(job_id, None)
         if handle is None:
@@ -719,7 +847,7 @@ class LocalProcessBackend(TrainingBackend):
                     proc.kill()
                 with contextlib.suppress(Exception):
                     await proc.wait()
-        self.scheduler.release(job_id)
+        release(job_id)
         self._admit_pending()
         return True
 
@@ -802,7 +930,7 @@ class LocalProcessBackend(TrainingBackend):
         self._closing = True
         self._lost.clear()
         for job_id in list(self._handles):
-            await self.delete_job(job_id)
+            await self.delete_job(job_id, forget_reservations=True)
         for pool in self._warm.values():
             for proc in pool:
                 if proc.returncode is None:

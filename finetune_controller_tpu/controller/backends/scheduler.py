@@ -63,14 +63,16 @@ class GangScheduler:
         *,
         queue: str | None = None,
         priority: object | None = None,
+        requested_slices: int | None = None,
     ) -> Workload:
         """Register a suspended workload (``runPolicy.suspend: true`` until
         admitted — ``PyTorchJobDeployer.py:179-185``).
 
-        ``queue``/``priority`` are accepted for signature parity with the
-        fair-share scheduler (``finetune_controller_tpu/sched/``) and
-        deliberately ignored: this is the documented FIFO escape hatch
-        (``FTC_SCHED_POLICY=fifo``), which has no tenant semantics.
+        ``queue``/``priority``/``requested_slices`` are accepted for
+        signature parity with the fair-share scheduler
+        (``finetune_controller_tpu/sched/``) and deliberately ignored: this
+        is the documented FIFO escape hatch (``FTC_SCHED_POLICY=fifo``),
+        which has no tenant semantics and never resizes.
         """
         if job_id in self._workloads:
             raise ValueError(f"workload {job_id!r} already queued")

@@ -101,6 +101,16 @@ class Settings(BaseModel):
     #: tenant queue weights as a JSON object, e.g. '{"prod": 4, "batch": 1}'.
     #: Unknown queues named at submit auto-register with weight 1.0.
     sched_queues: str = ""
+    #: resize-instead-of-evict (docs/elasticity.md): shrink multi-slice
+    #: victims to their fair share (and admit blocked multi-slice jobs
+    #: shrunk) instead of full eviction, growing them back when chips free.
+    #: false restores the PR-5 evict-only behavior.
+    sched_resize: bool = True
+    #: how long a flavor must be free of other tenants' demand before the
+    #: scheduler grows a shrunk job back (a grow costs a checkpoint
+    #: restart, so this debounces thrash); also the per-job floor between
+    #: consecutive resizes of the same job
+    sched_grow_delay_s: float = 60.0
 
     # --- Backend selection ---
     backend: str = "local"  # local | k8s

@@ -230,14 +230,15 @@ async def cmd_queue(client: Client, ns: argparse.Namespace) -> int:
         print(f"no tenant queues (policy={snap.get('policy')})")
         return 0
     header = (f"{'QUEUE':<16} {'WEIGHT':>6} {'RUN':>4} {'PEND':>5} "
-              f"{'CHIPS':>6} {'SHARE':>7} {'BORROW':>7} {'PREEMPT':>8}")
+              f"{'CHIPS':>6} {'SHARE':>7} {'BORROW':>7} {'PREEMPT':>8} "
+              f"{'RESIZE':>7}")
     print(header)
     for name, q in sorted(queues.items()):
         print(
             f"{name:<16} {q['weight']:>6.1f} {q['running']:>4} "
             f"{q['depth']:>5} {q['used_chips_total']:>6} "
             f"{q['dominant_share']:>7.3f} {q['borrowed_chips']:>7.1f} "
-            f"{q['preemptions']:>8}"
+            f"{q['preemptions']:>8} {q.get('resizes', 0):>7}"
         )
     pending = [
         (p["position"], p["job_id"], name)
@@ -246,8 +247,21 @@ async def cmd_queue(client: Client, ns: argparse.Namespace) -> int:
     ]
     for pos, job_id, qname in sorted(pending):
         print(f"  #{pos}  {job_id}  ({qname})")
+    # workloads currently running below their requested topology
+    for job_id, s in sorted((snap.get("shrunk_workloads") or {}).items()):
+        print(
+            f"  ~{job_id}  {s['num_slices']}/{s['requested_slices']} slices "
+            f"({s['queue']}, shrunk)"
+        )
     if snap.get("preemptions_total") is not None:
-        print(f"(preemptions total: {snap['preemptions_total']})")
+        print(f"(preemptions total: {snap['preemptions_total']}, "
+              f"resizes total: {snap.get('resizes_total', 0)})")
+    # the recent resize decisions (docs/elasticity.md)
+    history = snap.get("resize_history") or []
+    for h in history[-5:]:
+        who = f" for {h['preemptor']}" if h.get("preemptor") else ""
+        print(f"  [{h['kind']}] {h['job_id']} "
+              f"{h['from_slices']}->{h['to_slices']} slices{who}")
     return 0
 
 

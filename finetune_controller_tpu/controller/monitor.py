@@ -132,9 +132,12 @@ class JobMonitor:
                 continue
             if job.status.is_final:
                 # skip already-final jobs (core/monitor.py:150-155); a job the
-                # user cancelled still needs its backend half cleaned up
+                # user cancelled still needs its backend half cleaned up —
+                # including any resize reservation (it is not coming back)
                 if job.status is DatabaseStatus.CANCELLED:
-                    await self.backend.delete_job(report.job_id)
+                    await self.backend.delete_job(
+                        report.job_id, forget_reservations=True
+                    )
                 continue
             if job.status is DatabaseStatus.RETRYING:
                 # waiting out its backoff: the supervisor owns this job and
@@ -159,8 +162,11 @@ class JobMonitor:
                 await self._process_job_metrics(job)
             if report.state is BackendJobState.SUCCEEDED:
                 # artifacts are in the object store; free the substrate
-                # (core/monitor.py:182-186)
-                await self.backend.delete_job(report.job_id)
+                # (core/monitor.py:182-186), reservations included — a
+                # finished job's pending grow/shrink is moot
+                await self.backend.delete_job(
+                    report.job_id, forget_reservations=True
+                )
             elif report.state is BackendJobState.FAILED:
                 await self._handle_failed(job, report)
             elif report.state is BackendJobState.RUNNING:
@@ -173,7 +179,10 @@ class JobMonitor:
         exit_code = report.metadata.get("exit_code")
         if self.supervisor is not None:
             await self.supervisor.on_job_failed(
-                job, exit_code=exit_code, message=report.message
+                job, exit_code=exit_code, message=report.message,
+                # a scheduler resize rides the failure path (SIGTERM → 143)
+                # but resubmits at a DIFFERENT topology (docs/elasticity.md)
+                resize_to=report.metadata.get("resize_to_num_slices"),
             )
             return
         # no supervisor: still persist the failure class so users (and a

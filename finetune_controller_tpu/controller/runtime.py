@@ -106,6 +106,8 @@ def build_runtime(
             warm_workers=settings.warm_workers,
             sched_policy=settings.sched_policy,
             sched_queues=sched_queues,
+            sched_resize=settings.sched_resize,
+            sched_grow_delay_s=settings.sched_grow_delay_s,
         )
     elif settings.backend == "k8s":
         from .backends.k8s import K8sJobSetBackend

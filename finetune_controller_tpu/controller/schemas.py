@@ -200,6 +200,11 @@ class JobInput(BaseModel):
     #: against sched.queues.parse_priority in the API layer
     queue: str = "default"
     priority: str | int = "normal"
+    #: the topology the job ORIGINALLY asked for, when ``num_slices`` is a
+    #: resized (shrunk) resubmission — the scheduler's grow pass restores
+    #: the job toward this when chips free (docs/elasticity.md).  None on a
+    #: fresh submission (= num_slices).
+    requested_num_slices: int | None = None
 
 
 class PaginatedTableResponse(BaseModel):

@@ -841,6 +841,10 @@ async def admin_resilience(request: web.Request) -> web.Response:
             "retries_scheduled": supervisor.retries_scheduled,
             "resubmits": supervisor.resubmits,
             "terminal_failures": supervisor.terminal_failures,
+            # elasticity (docs/elasticity.md)
+            "resizes": supervisor.resizes,
+            "elastic_restores": supervisor.elastic_restores,
+            "topology_downgrades": supervisor.topology_downgrades,
         }
         body["pending_retries"] = await supervisor.pending_retries()
     if lease is not None:
@@ -925,15 +929,35 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
             ("ftc_sched_queue_dominant_share", "gauge", "dominant_share"),
             ("ftc_sched_queue_borrowed_chips", "gauge", "borrowed_chips"),
             ("ftc_sched_queue_preemptions_total", "counter", "preemptions"),
+            ("ftc_sched_queue_resizes_total", "counter", "resizes"),
         )
         for metric, kind, stat_key in sched_gauges:
             lines.append(f"# TYPE {metric} {kind}")
             for qname, q in sorted(snap["queues"].items()):
                 lines.append(
-                    f'{metric}{{queue="{prom_escape(qname)}"}} {q[stat_key]}'
+                    f'{metric}{{queue="{prom_escape(qname)}"}} '
+                    f"{q.get(stat_key, 0)}"
                 )
         lines.append("# TYPE ftc_sched_preemptions_total counter")
         lines.append(f"ftc_sched_preemptions_total {snap['preemptions_total']}")
+        # resize-instead-of-evict (docs/elasticity.md)
+        lines.append("# TYPE ftc_sched_resizes_total counter")
+        lines.append(f"ftc_sched_resizes_total {snap.get('resizes_total', 0)}")
+        lines.append("# TYPE ftc_sched_shrunk_workloads gauge")
+        lines.append(
+            f"ftc_sched_shrunk_workloads {len(snap.get('shrunk_workloads') or {})}"
+        )
+    supervisor = rt.monitor.supervisor
+    if supervisor is not None:
+        # cross-topology restores executed by the retry loop
+        lines.append("# TYPE ftc_elastic_restores_total counter")
+        lines.append(
+            f"ftc_elastic_restores_total {supervisor.elastic_restores}"
+        )
+        lines.append("# TYPE ftc_topology_downgrades_total counter")
+        lines.append(
+            f"ftc_topology_downgrades_total {supervisor.topology_downgrades}"
+        )
     if rt.serve is not None:
         sessions = rt.serve.stats()
         serve_gauges = (

@@ -27,6 +27,24 @@ class PartitionRules:
     def __init__(self, rules: list[tuple[str, P]]):
         self._rules = [(re.compile(pat), spec) for pat, spec in rules]
 
+    def fingerprint(self) -> str:
+        """Stable digest of the ordered rule table.
+
+        Stamped into every checkpoint manifest (``train/elastic.py``): a
+        restore onto a model whose rule table differs — reordered rules, a
+        changed spec, a new carve-out — would silently mis-shard the state,
+        so elastic restore refuses a checkpoint whose fingerprint does not
+        match the live table.  Patterns AND specs both feed the digest;
+        order matters (first match wins at lookup time).
+        """
+        import hashlib
+
+        parts = [
+            f"{pat.pattern}\x00{tuple(spec)!r}" for pat, spec in self._rules
+        ]
+        digest = hashlib.sha256("\x01".join(parts).encode()).hexdigest()
+        return f"sha256:{digest}"
+
     def spec_for(self, path: str, value: Any = None) -> P:
         for pat, spec in self._rules:
             if pat.search(path):

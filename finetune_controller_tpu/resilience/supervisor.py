@@ -59,6 +59,14 @@ class RetrySupervisor:
         self.retries_scheduled = 0
         self.resubmits = 0
         self.terminal_failures = 0
+        #: scheduler resizes routed through the retry loop (shrink + grow)
+        self.resizes = 0
+        #: resubmissions at a topology different from the previous attempt's
+        #: — each one is a cross-topology (elastic) restore downstream
+        self.elastic_restores = 0
+        #: topologies downgraded because the recorded size no longer fits
+        #: the device catalog (catalog shrank across a controller restart)
+        self.topology_downgrades = 0
 
     # -- failure intake -------------------------------------------------------
 
@@ -68,12 +76,23 @@ class RetrySupervisor:
         *,
         exit_code: int | None = None,
         message: str = "",
+        resize_to: int | None = None,
     ) -> bool:
         """Classify one failed attempt; schedule a retry or record the
-        terminal failure.  Returns True when a retry was scheduled."""
+        terminal failure.  Returns True when a retry was scheduled.
+
+        ``resize_to`` marks a scheduler resize (docs/elasticity.md): the
+        exit is deliberate (shrink or grow), so it neither burns the retry
+        budget nor waits out a backoff — the resubmit topology is recorded
+        crash-safe in ``metadata.current_num_slices`` and the job re-enters
+        the queue immediately (its chips are reserved scheduler-side).
+        """
         failure = self.policy.classify(exit_code, message)
         history = list(job.metadata.get("attempt_history") or [])
-        attempt = len(history) + 1
+        # resizes are scheduler-initiated restarts, not failures: exempt
+        # them from the attempt budget or steady contention churn would
+        # terminally fail a healthy job
+        attempt = 1 + sum(1 for h in history if not h.get("resize"))
         prev_delay = history[-1].get("delay_s") if history else None
         entry: dict[str, Any] = {
             "attempt": attempt,
@@ -82,7 +101,10 @@ class RetrySupervisor:
             "failure_class": failure.value,
             "message": message,
         }
-        if not self.policy.should_retry(failure, attempt):
+        if resize_to is not None:
+            entry["resize"] = True
+            entry["resize_to_num_slices"] = int(resize_to)
+        if resize_to is None and not self.policy.should_retry(failure, attempt):
             entry["delay_s"] = None
             history.append(entry)
             # compare-and-set from the status the caller snapshotted: a user
@@ -112,18 +134,26 @@ class RetrySupervisor:
                 self.policy.max_attempts, message,
             )
             return False
-        delay = self.policy.next_delay(prev_delay)
+        if resize_to is not None:
+            # deliberate resize: chips are reserved for the resubmit, so a
+            # backoff would only idle them — resume on the next tick
+            delay = 0.0
+        else:
+            delay = self.policy.next_delay(prev_delay)
         entry["delay_s"] = delay
         history.append(entry)
+        retry_metadata: dict[str, Any] = {
+            "attempt_history": history,
+            "failure_class": failure.value,
+            "retry_next_at": self._clock() + delay,
+        }
+        if resize_to is not None:
+            retry_metadata["current_num_slices"] = int(resize_to)
         ok = await self.state.transition_job_status(
             job.job_id,
             job.status,
             DatabaseStatus.RETRYING,
-            metadata={
-                "attempt_history": history,
-                "failure_class": failure.value,
-                "retry_next_at": self._clock() + delay,
-            },
+            metadata=retry_metadata,
             queue_position=None,
         )
         if not ok:
@@ -133,6 +163,8 @@ class RetrySupervisor:
             )
             return False
         self.retries_scheduled += 1
+        if resize_to is not None:
+            self.resizes += 1
         # clear the substrate half now so the backoff window starts from a
         # clean slate (artifacts — including checkpoints — are already in
         # the object store; the final sync ran before FAILED became visible)
@@ -202,13 +234,56 @@ class RetrySupervisor:
         try:
             spec = cls(training_arguments=job.arguments)
             flavor = self.catalog.get_worker(job.device)
+            # topology selection (docs/elasticity.md): resume at the
+            # resized topology when one is recorded, else the original ask
+            target = int(job.metadata.get("current_num_slices") or job.num_slices)
+            downgraded_from: int | None = None
+            quota = self.catalog.quota_for(flavor.name)
+            if flavor.total_chips * target > quota:
+                # the recorded topology no longer fits the device catalog
+                # (catalog shrank across a controller restart): requeue at
+                # the largest feasible size instead of stranding the job in
+                # a submit-reject loop (ISSUE 7 satellite)
+                from ..train.elastic import largest_feasible_slices
+
+                feasible = largest_feasible_slices(
+                    flavor.total_chips, target, quota
+                )
+                if feasible < 1:
+                    await self.state.update_job_status(
+                        job.job_id,
+                        DatabaseStatus.FAILED,
+                        metadata={
+                            "failure_class": FailureClass.USER.value,
+                            "retry_next_at": None,
+                            "backend_message": (
+                                f"device {flavor.name!r} quota ({quota} chips)"
+                                f" no longer fits even one slice "
+                                f"({flavor.total_chips} chips)"
+                            ),
+                        },
+                        queue_position=None,
+                    )
+                    self.terminal_failures += 1
+                    return False
+                downgraded_from = target
+                self.topology_downgrades += 1
+                logger.warning(
+                    "job %s: recorded topology %d slices of %s (%d chips) no "
+                    "longer fits the quota (%d); downgrading to %d slices",
+                    job.job_id, target, flavor.name,
+                    flavor.total_chips * target, quota, feasible,
+                )
+                target = feasible
+            prev_ran = int(job.metadata.get("last_ran_num_slices") or job.num_slices)
             await self.backend.submit(
                 JobInput(
                     job_id=job.job_id,
                     user_id=job.user_id,
                     model_name=job.model_name,
                     device=job.device,
-                    num_slices=job.num_slices,
+                    num_slices=target,
+                    requested_num_slices=job.num_slices,
                     arguments=job.arguments,
                     # a retried (or preempted) job re-enters its tenant
                     # queue at its original priority (docs/scheduling.md)
@@ -228,6 +303,17 @@ class RetrySupervisor:
                 job, exit_code=None, message=f"resubmit failed: {exc}"
             )
             return False
+        resub_metadata: dict[str, Any] = {
+            "retry_next_at": None,
+            "current_num_slices": target,
+            "last_ran_num_slices": target,
+        }
+        if downgraded_from is not None:
+            resub_metadata["topology_downgraded"] = {
+                "from_num_slices": downgraded_from,
+                "to_num_slices": target,
+                "at": self._clock(),
+            }
         # compare-and-set: a user cancel can land inside submit's await
         # window, and resurrecting a job the user was told is cancelled
         # would be a silent override — on a lost race, roll the fresh
@@ -236,7 +322,7 @@ class RetrySupervisor:
             job.job_id,
             DatabaseStatus.RETRYING,
             DatabaseStatus.QUEUED,
-            metadata={"retry_next_at": None},
+            metadata=resub_metadata,
             submitted_at=self._clock(),
             start_time=None,
             end_time=None,
@@ -254,6 +340,14 @@ class RetrySupervisor:
                 logger.exception("rollback of %s failed", job.job_id)
             return False
         self.resubmits += 1
+        if target != prev_ran:
+            # the next attempt restores the checkpoint onto a different
+            # topology — the elastic-restore path (train/elastic.py)
+            self.elastic_restores += 1
+            logger.info(
+                "job %s resubmitted at %d slices (previous attempt ran %d): "
+                "elastic restore", job.job_id, target, prev_ran,
+            )
         logger.info(
             "job %s resubmitted (attempt %d)", job.job_id,
             len(job.metadata.get("attempt_history") or []) + 1,

@@ -12,17 +12,19 @@ Modules:
 - :mod:`.queues` — tenant queues, priority classes, the Workload record;
 - :mod:`.fairshare` — the :class:`FairShareScheduler` itself plus the
   weighted-DRF share math and the Jain fairness index;
-- :mod:`.preemption` — victim selection (lowest priority, most-over-share,
-  youngest first);
+- :mod:`.preemption` — the resize-before-evict planner (shrink to fair
+  share first, full eviction as the fallback; docs/elasticity.md) and the
+  victim ordering (lowest priority, most-over-share, youngest first);
 - :mod:`.backfill` — the reservation-protected backfill gate;
 - :mod:`.sim` — a seeded, clock-injected cluster simulator so fairness /
-  starvation / preemption properties are provable in fast deterministic
-  tests (and ``BENCH_MODE=sched`` comparisons against the FIFO baseline).
+  starvation / preemption / progress-loss properties are provable in fast
+  deterministic tests (and ``BENCH_MODE=sched`` comparisons against the
+  FIFO and evict-only baselines).
 """
 
 from .backfill import backfill_capacity
 from .fairshare import FairShareScheduler, jain_index
-from .preemption import select_victims
+from .preemption import ResizeDecision, plan_preemption, select_victims
 from .queues import (
     DEFAULT_QUEUE,
     PRIORITY_CLASSES,
@@ -39,8 +41,10 @@ __all__ = [
     "QueueConfig",
     "QueueSet",
     "Workload",
+    "ResizeDecision",
     "backfill_capacity",
     "jain_index",
     "parse_priority",
+    "plan_preemption",
     "select_victims",
 ]

@@ -132,9 +132,34 @@ class Workload:
     priority: int = PRIORITY_CLASSES[DEFAULT_PRIORITY]
     seq: int = 0
     admitted: bool = False
-    #: victim of an in-flight preemption: SIGTERM sent, chips still held
-    #: until the process exits and the backend releases the workload
+    #: victim of an in-flight preemption/resize: SIGTERM sent, chips still
+    #: held until the process exits and the backend releases the workload
     preempting: bool = False
     #: clock reading at submit (scheduler-injected clock; sim uses virtual time)
     submitted_at: float = 0.0
     admitted_at: float | None = None
+    #: slice count this workload currently runs at (chips = num_slices *
+    #: chips_per_slice); changes across shrink/grow resubmits
+    num_slices: int = 1
+    #: slice count the job originally asked for — the grow pass restores a
+    #: shrunk workload toward this when chips free (docs/elasticity.md)
+    requested_slices: int = 1
+    #: slice count an in-flight resize will resubmit this workload at
+    #: (None = full eviction or no resize pending)
+    resize_to: int | None = None
+
+    @property
+    def chips_per_slice(self) -> int:
+        return self.chips // max(1, self.num_slices)
+
+    @property
+    def shrunk(self) -> bool:
+        return self.admitted and self.num_slices < self.requested_slices
+
+    def freed_chips(self) -> int:
+        """Chips this (preempting) workload hands to its preemptor when it
+        exits: everything on a full eviction, the shed slices on a shrink
+        (the rest is reserved for the workload's own resubmit)."""
+        if self.resize_to is None:
+            return self.chips
+        return self.chips - self.resize_to * self.chips_per_slice

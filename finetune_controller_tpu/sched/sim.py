@@ -51,12 +51,14 @@ class SimJob:
 class JobOutcome:
     job_id: str
     queue: str
-    chips: int
+    chips: int  # at the REQUESTED size (the small-job filter keys off this)
     arrival_s: float
     first_admit_s: float | None = None
     finish_s: float | None = None
     preempted_at: list[float] = dataclasses.field(default_factory=list)
     resumed_at: list[float] = dataclasses.field(default_factory=list)
+    #: slice-count trajectory across resizes (for debugging/assertions)
+    sizes: list[int] = dataclasses.field(default_factory=list)
 
     @property
     def queue_wait_s(self) -> float | None:
@@ -70,10 +72,36 @@ class SimReport:
     makespan_s: float
     outcomes: dict[str, JobOutcome]
     preemptions: int
+    resizes: int
     preempt_resume_latencies_s: list[float]
     #: per-queue chip-seconds integrated while >= 2 queues had live demand
     contention_chip_seconds: dict[str, float]
     jain_fairness: float
+    #: chip-seconds of completed work discarded at preemption/resize exits
+    #: (progress since the victim's last periodic checkpoint; 0 under the
+    #: save-on-SIGTERM model — see ``ClusterSim.preempt_saves``)
+    replay_lost_chip_seconds: float
+    #: chip-seconds spent inside exit graces (SIGTERM → checkpoint → exit):
+    #: the chips are held but produce no progress — every extra restart a
+    #: policy causes pays this, which is what keeps resize churn honest
+    exit_overhead_chip_seconds: float
+    #: chip-seconds of capacity that sat idle while some job wanted chips it
+    #: did not have (pending, or running shrunk below its request) — under
+    #: eviction this is dominated by anti-starvation reservations holding
+    #: partial capacity for a big readmit; resize keeps those chips training
+    idle_demand_chip_seconds: float
+
+    @property
+    def progress_lost_chip_seconds(self) -> float:
+        """The ISSUE 7 gated metric: chip-seconds of progress the cluster
+        lost to capacity churn — work discarded to checkpoint replay, exit-
+        grace overhead, and demanded-but-idle capacity.  Resize must beat
+        full eviction on this."""
+        return (
+            self.replay_lost_chip_seconds
+            + self.exit_overhead_chip_seconds
+            + self.idle_demand_chip_seconds
+        )
 
     def waits(self, *, max_chips: int | None = None) -> list[float]:
         """Queue waits (s), optionally only for jobs at most ``max_chips``."""
@@ -105,6 +133,8 @@ class ClusterSim:
         preempt_exit_s: float = 1.0,
         requeue_delay_s: float = 2.0,
         queue_weights: dict[str, float] | None = None,
+        preempt_saves: bool = True,
+        tick_interval_s: float = 5.0,
     ):
         self.catalog = catalog
         self.now = 0.0
@@ -117,6 +147,18 @@ class ClusterSim:
         #: entitlements — a weight-blind scheduler must not get its fairness
         #: scored against flat weights while the other leg uses the trace's.
         self.queue_weights = queue_weights
+        #: True models the PR-3 SIGTERM contract: the victim CHECKPOINTS AT
+        #: ITS CURRENT STEP before exiting (save-on-preempt, proven
+        #: step-continuous in tests/test_sched_e2e.py), so a scheduler-driven
+        #: exit replays nothing — its cost is the exit grace itself plus the
+        #: requeue window.  False is the legacy pessimistic model (progress
+        #: rounds down to the last periodic checkpoint — the SIGKILL-
+        #: escalation/crash shape).
+        self.preempt_saves = preempt_saves
+        #: periodic reconcile cadence (the monitor's ``scheduler_tick``):
+        #: without it the grow pass would only run on job arrival/exit edges
+        #: and a drained queue could leave shrunk jobs small forever
+        self.tick_interval_s = tick_interval_s
 
     def run(self, jobs: list[SimJob], *, horizon_s: float = 10_000_000.0) -> SimReport:
         jobs_by_id = {j.job_id: j for j in jobs}
@@ -129,7 +171,16 @@ class ClusterSim:
             )
             for j in jobs
         }
-        remaining = {j.job_id: j.duration_s for j in jobs}
+        #: remaining work in CHIP-SECONDS: a job's duration is defined at its
+        #: requested size, so work = duration * requested_chips; running at
+        #: c chips finishes the remainder in remaining/c seconds (the linear
+        #: scaling a data-parallel trainer actually gets)
+        remaining_cs = {j.job_id: j.duration_s * self._chips(j) for j in jobs}
+        #: slice count each job runs (or will resubmit) at; shrinks/grows
+        #: rewrite it when the decision is taken
+        cur_slices = {j.job_id: max(1, j.num_slices) for j in jobs}
+        #: chips the live attempt actually occupies (for integration)
+        cur_chips: dict[str, int] = {}
         started_at: dict[str, float] = {}
         #: per-job attempt generation; bumped on every (re)start AND on
         #: preemption so stale finish events are recognisably dead
@@ -155,19 +206,87 @@ class ClusterSim:
         preempt_latencies: list[float] = []
         first_arrival = min((j.arrival_s for j in jobs), default=0.0)
         makespan_end = first_arrival
+        replay_lost = 0.0
+        exit_overhead = 0.0
+        idle_demand = 0.0
+        resizes = 0
+        evictions = 0
+        total_quota = sum(
+            self.catalog.quota_for(f.name) for f in self.catalog.flavors
+        )
+        req_chips = {j.job_id: self._chips(j) for j in jobs}
 
         def integrate(to_t: float) -> None:
-            nonlocal last_t
+            nonlocal last_t, idle_demand
             dt = to_t - last_t
             if dt > 0:
                 live = {q for q, ids in live_by_queue.items() if ids}
-                if len(live) >= 2:  # contention window only
+                # Jain window: >= 2 queues with live demand (PR-5 semantics)
+                if len(live) >= 2:
                     contended_queues.update(live)
                     for q in live:
-                        contention_cs[q] = contention_cs.get(q, 0.0) + (
-                            running_chips.get(q, 0.0) * dt
-                        )
+                        r = running_chips.get(q, 0.0)
+                        contention_cs[q] = contention_cs.get(q, 0.0) + r * dt
+                # idle-under-demand: some live job wants chips it does not
+                # have (pending, or running below its requested size) while
+                # capacity sits free — counted up to the unmet amount
+                unmet = sum(
+                    max(0, req_chips[jid] - cur_chips.get(jid, 0))
+                    for ids in live_by_queue.values() for jid in ids
+                )
+                if unmet > 0:
+                    idle = max(0.0, total_quota - sum(running_chips.values()))
+                    idle_demand += min(idle, float(unmet)) * dt
             last_t = to_t
+
+        def on_decisions() -> None:
+            nonlocal resizes, evictions
+            take = getattr(self.scheduler, "take_preemptions", None)
+            if take is None:
+                return
+            for d in take():
+                victim_id, to_slices = self._decision(d)
+                o = outcomes[victim_id]
+                o.preempted_at.append(self.now)
+                if to_slices:
+                    resizes += 1
+                    cur_slices[victim_id] = to_slices
+                else:
+                    evictions += 1
+                # bump the generation so the victim's in-flight finish is
+                # dead; the exit event carries the new generation
+                attempt[victim_id] += 1
+                push(self.now + self.preempt_exit_s, "exit", victim_id,
+                     attempt[victim_id])
+
+        def schedule() -> None:
+            for w in self.scheduler.try_admit():
+                j = jobs_by_id[w.job_id]
+                o = outcomes[w.job_id]
+                if o.first_admit_s is None:
+                    o.first_admit_s = self.now
+                if len(o.resumed_at) < len(o.preempted_at):
+                    o.resumed_at.append(self.now)
+                    preempt_latencies.append(self.now - o.preempted_at[-1])
+                started_at[w.job_id] = self.now
+                attempt[w.job_id] += 1
+                cur_chips[w.job_id] = w.chips
+                # the FIFO scheduler's minimal Workload has no slice count
+                o.sizes.append(getattr(w, "num_slices", 1))
+                running_chips[j.queue] = (
+                    running_chips.get(j.queue, 0.0) + w.chips
+                )
+                push(self.now + remaining_cs[w.job_id] / max(w.chips, 1),
+                     "finish", w.job_id, attempt[w.job_id])
+            on_decisions()
+
+        # the monitor's periodic reconcile: without ticks, a drained queue
+        # would leave the grow pass (and reservation TTLs) waiting for the
+        # next job edge that may never come.  Only schedulers that resize
+        # need it — FIFO/evict replays stay identical to PR 5 event-for-event.
+        ticking = bool(getattr(self.scheduler, "resize", False))
+        if ticking and jobs:
+            push(first_arrival + self.tick_interval_s, "tick", jobs[0].job_id)
 
         while heap:
             t, _, kind, job_id, att = heapq.heappop(heap)
@@ -178,6 +297,11 @@ class ClusterSim:
                 )
             integrate(t)
             self.now = t
+            if kind == "tick":
+                if any(o.finish_s is None for o in outcomes.values()):
+                    push(t + self.tick_interval_s, "tick", job_id)
+                    schedule()
+                continue
             j = jobs_by_id[job_id]
             o = outcomes[job_id]
             if kind == "arrive":
@@ -187,36 +311,47 @@ class ClusterSim:
                     queue=j.queue, priority=j.priority,
                 )
             elif kind == "resubmit":
-                self.scheduler.submit(
-                    job_id, j.flavor, j.num_slices,
-                    queue=j.queue, priority=j.priority,
-                )
+                self._resubmit(j, cur_slices[job_id])
             elif kind == "finish":
                 if att != attempt[job_id]:
                     continue  # stale: this attempt was preempted
                 self.scheduler.release(job_id)
-                running_chips[j.queue] = running_chips.get(j.queue, 0.0) - o.chips
-                remaining[job_id] = 0.0
+                running_chips[j.queue] = (
+                    running_chips.get(j.queue, 0.0) - cur_chips.pop(job_id, 0)
+                )
+                remaining_cs[job_id] = 0.0
                 live_by_queue[j.queue].discard(job_id)
                 o.finish_s = t
                 makespan_end = max(makespan_end, t)
             elif kind == "exit":
                 # the victim's process exited: progress rounds down to the
                 # last checkpoint BEFORE the SIGTERM, chips free, and the job
-                # requeues after its retry backoff
+                # requeues after its retry backoff (a resized victim at its
+                # new size — the reservation inside the scheduler holds its
+                # chips through this window)
                 if att != attempt[job_id]:
                     continue
+                chips = cur_chips.pop(job_id, 0)
                 run_s = max(0.0, o.preempted_at[-1] - started_at[job_id])
-                ckpt = max(j.checkpoint_every_s, 1e-9)
-                saved = min(run_s, (run_s // ckpt) * ckpt)
-                remaining[job_id] = max(0.0, remaining[job_id] - saved)
+                if self.preempt_saves:
+                    # PR-3 SIGTERM contract: checkpoint AT the current step,
+                    # then exit — nothing replays
+                    saved_s = run_s
+                else:
+                    ckpt = max(j.checkpoint_every_s, 1e-9)
+                    saved_s = min(run_s, (run_s // ckpt) * ckpt)
+                remaining_cs[job_id] = max(
+                    0.0, remaining_cs[job_id] - saved_s * chips
+                )
+                replay_lost += (run_s - saved_s) * chips
+                # the exit grace holds the chips while saving/tearing down
+                exit_overhead += max(0.0, t - o.preempted_at[-1]) * chips
                 self.scheduler.release(job_id)
-                running_chips[j.queue] = running_chips.get(j.queue, 0.0) - o.chips
+                running_chips[j.queue] = (
+                    running_chips.get(j.queue, 0.0) - chips
+                )
                 push(t + self.requeue_delay_s, "resubmit", job_id)
-            self._schedule(
-                jobs_by_id, outcomes, remaining, started_at, attempt,
-                running_chips, preempt_latencies, push,
-            )
+            schedule()
 
         alloc = [
             contention_cs.get(q, 0.0) / max(self._queue_weight(q), 1e-9)
@@ -225,10 +360,14 @@ class ClusterSim:
         return SimReport(
             makespan_s=makespan_end - first_arrival,
             outcomes=outcomes,
-            preemptions=getattr(self.scheduler, "preemptions_total", 0),
+            preemptions=evictions + resizes,
+            resizes=resizes,
             preempt_resume_latencies_s=preempt_latencies,
             contention_chip_seconds=contention_cs,
             jain_fairness=jain_index(alloc),
+            replay_lost_chip_seconds=replay_lost,
+            exit_overhead_chip_seconds=exit_overhead,
+            idle_demand_chip_seconds=idle_demand,
         )
 
     # -- internals -----------------------------------------------------------
@@ -237,39 +376,30 @@ class ClusterSim:
         flavor = self.catalog.get_worker(j.flavor)
         return flavor.total_chips * max(1, j.num_slices)
 
+    @staticmethod
+    def _decision(d) -> tuple[str, int]:
+        """Normalise a scheduler decision to ``(victim_id, to_slices)`` —
+        accepts both ResizeDecision objects and legacy (victim, preemptor)
+        pairs (to_slices 0 = full eviction)."""
+        to = getattr(d, "to_slices", None)
+        if to is not None:
+            return d.job_id, int(to)
+        victim_id, _preemptor = d
+        return victim_id, 0
+
+    def _resubmit(self, j: SimJob, slices: int) -> None:
+        kwargs = dict(queue=j.queue, priority=j.priority)
+        if slices != max(1, j.num_slices):
+            # only resized resubmits pass requested_slices (the FIFO
+            # scheduler never resizes, so it never sees the kwarg)
+            kwargs["requested_slices"] = max(1, j.num_slices)
+        self.scheduler.submit(j.job_id, j.flavor, slices, **kwargs)
+
     def _queue_weight(self, queue: str) -> float:
         if self.queue_weights is not None:
             return self.queue_weights.get(queue, 1.0)
         queues = getattr(self.scheduler, "queues", None)
         return queues.weight(queue) if queues is not None else 1.0
-
-    def _schedule(self, jobs_by_id, outcomes, remaining, started_at, attempt,
-                  running_chips, preempt_latencies, push) -> None:
-        for w in self.scheduler.try_admit():
-            j = jobs_by_id[w.job_id]
-            o = outcomes[w.job_id]
-            if o.first_admit_s is None:
-                o.first_admit_s = self.now
-            if len(o.resumed_at) < len(o.preempted_at):
-                o.resumed_at.append(self.now)
-                preempt_latencies.append(self.now - o.preempted_at[-1])
-            started_at[w.job_id] = self.now
-            attempt[w.job_id] += 1
-            running_chips[j.queue] = (
-                running_chips.get(j.queue, 0.0) + o.chips
-            )
-            push(self.now + remaining[w.job_id], "finish", w.job_id,
-                 attempt[w.job_id])
-        take = getattr(self.scheduler, "take_preemptions", None)
-        if take is None:
-            return
-        for victim_id, _preemptor in take():
-            outcomes[victim_id].preempted_at.append(self.now)
-            # bump the generation so the victim's in-flight finish is dead;
-            # the exit event carries the new generation
-            attempt[victim_id] += 1
-            push(self.now + self.preempt_exit_s, "exit", victim_id,
-                 attempt[victim_id])
 
 
 # ---------------------------------------------------------------------------
@@ -323,3 +453,46 @@ def synthetic_trace(
 
 #: queue weights for the canonical trace (prod is the paying tenant)
 TRACE_QUEUES = {"batch": 1.0, "research": 2.0, "prod": 4.0}
+
+
+def elastic_trace(
+    seed: int = 0,
+    *,
+    flavor: str = "sim-chip",
+    xl_slices: int = 8,
+    n_small: int = 16,
+) -> list[SimJob]:
+    """The capacity-reclaim trace — the scenario resize exists for (ISSUE 7
+    motivation: "losing chips means a job either waits for the original
+    topology or loses all progress").
+
+    A whole-cluster XL batch job saturates the quota; then a high-priority
+    4-slice reclaim (the quota-reclaim / maintenance shape) and a stream of
+    1-chip tenant jobs arrive.  Under full eviction the XL job cannot run
+    again until ALL of its chips are simultaneously free, so its
+    anti-starvation reservation idles every chip that frees before the last
+    arrival drains; under resize it degrades onto the leftovers and grows
+    back.  ``BENCH_MODE=sched`` gates resize-vs-evict progress loss here.
+    """
+    rng = random.Random(seed)
+    jobs: list[SimJob] = [
+        SimJob(
+            job_id="xl-0", flavor=flavor, num_slices=xl_slices,
+            duration_s=600.0, arrival_s=0.0,
+            queue="batch", priority="low", checkpoint_every_s=60.0,
+        ),
+        SimJob(
+            job_id="reclaim-0", flavor=flavor, num_slices=4,
+            duration_s=rng.uniform(150.0, 200.0), arrival_s=20.0,
+            queue="prod", priority="high", checkpoint_every_s=60.0,
+        ),
+    ]
+    for i in range(n_small):
+        q, prio = (("prod", "high") if i % 2 == 0 else ("research", "normal"))
+        jobs.append(SimJob(
+            job_id=f"small-{i}", flavor=flavor, num_slices=1,
+            duration_s=rng.uniform(20.0, 45.0),
+            arrival_s=10.0 + i * rng.uniform(4.0, 10.0),
+            queue=q, priority=prio, checkpoint_every_s=30.0,
+        ))
+    return jobs

@@ -22,10 +22,38 @@ logger = logging.getLogger(__name__)
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 #: uncommitted save staging: ``_save_msgpack`` writes ``step_N.tmp`` then
-#: renames; Orbax stages ``step_N.orbax-checkpoint-tmp-<ts>`` — a SIGKILL
-#: mid-save strands either shape (observed in the chaos tests), and the
-#: strays match the artifact-sync globs, shipping garbage with every sync
-_TMP_RE = re.compile(r"^step_\d+(\.tmp|\.orbax-checkpoint-tmp-.*)$")
+#: renames; Orbax stages ``step_N.orbax-checkpoint-tmp-<ts>``; the manifest
+#: writer stages ``step_N.manifest.tmp`` — a SIGKILL mid-save strands any of
+#: these (observed in the chaos tests), and the strays match the
+#: artifact-sync globs, shipping garbage with every sync
+_TMP_RE = re.compile(
+    r"^step_\d+(\.tmp|\.manifest\.tmp|\.orbax-checkpoint-tmp-.*)$"
+)
+
+MANIFEST_NAME = "manifest.json"
+
+
+def _shape_desc(node: object) -> str:
+    if isinstance(node, dict):
+        return "a subtree"
+    shape = tuple(getattr(node, "shape", ()) or ())
+    return f"shape {shape}"
+
+
+class CheckpointShapeError(ValueError):
+    """A restore target (``like`` tree) does not match the checkpoint.
+
+    Raised BEFORE deserialization with the first offending leaf path and
+    both shapes — the alternative is a raw msgpack/XLA error from deep
+    inside the stack that names neither."""
+
+    def __init__(self, path: str, ckpt: object, like: object):
+        self.path = path
+        super().__init__(
+            f"checkpoint/template mismatch at {path!r}: checkpoint has "
+            f"{ckpt}, restore template has {like} — wrong model config or "
+            "training mode for this checkpoint"
+        )
 
 
 class CheckpointManager:
@@ -61,7 +89,13 @@ class CheckpointManager:
             if not _TMP_RE.match(name):
                 continue
             path = os.path.join(self.directory, name)
-            shutil.rmtree(path, ignore_errors=True)
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                try:
+                    os.remove(path)
+                except OSError:
+                    logger.warning("could not remove stale staging %s", name)
             logger.warning("swept stale uncommitted checkpoint staging %s", name)
 
     def _path(self, step: int) -> str:
@@ -98,23 +132,34 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def _save_sync(self, path: str, tree: Any) -> None:
+    def _save_sync(self, path: str, tree: Any, manifest: dict | None) -> None:
         try:
             if jax.process_count() > 1:
                 # Orbax's save is itself a cross-process collective
                 # (sync_global_processes barriers); on multi-host only rank 0
                 # calls save with an already-gathered host tree, so use a
-                # non-collective msgpack writer (atomic tmp-dir rename).
-                self._save_msgpack(path, tree)
+                # non-collective msgpack writer (atomic tmp-dir rename — the
+                # manifest rides inside the staging dir, so commit is atomic
+                # for both).
+                self._save_msgpack(path, tree, manifest)
             else:
                 self._ckptr.save(path, tree)
                 self._ckptr.wait_until_finished()
+                if manifest is not None:
+                    self._write_manifest(path, manifest)
         except BaseException as exc:  # noqa: BLE001 — re-raised from wait()
             logger.exception("background checkpoint save to %s failed", path)
             # ftc: ignore[shared-mutable-without-lock] -- single in-flight writer thread (save() waits before starting another); list.append is GIL-atomic and drained only after join() in wait()
             self._pending_error.append(exc)
 
-    def save(self, step: int, tree: Any, force: bool = False, blocking: bool = False) -> None:
+    def save(
+        self,
+        step: int,
+        tree: Any,
+        force: bool = False,
+        blocking: bool = False,
+        manifest: dict | None = None,
+    ) -> None:
         self.wait()  # one in-flight save at a time (raises on a prior failure)
         path = self._path(step)
         if os.path.exists(path):
@@ -128,31 +173,125 @@ class CheckpointManager:
         # the whole point is overlapping serialization + IO with training
         self._gc()
         self._pending = threading.Thread(
-            target=self._save_sync, args=(path, tree), daemon=False
+            target=self._save_sync, args=(path, tree, manifest), daemon=False
         )
         self._pending.start()
         if blocking:
             self.wait()
 
     @staticmethod
-    def _save_msgpack(path: str, tree: Any) -> None:
+    def _save_msgpack(path: str, tree: Any, manifest: dict | None = None) -> None:
+        import json
+
         from flax import serialization
 
         tmp = path + ".tmp"
         os.makedirs(tmp, exist_ok=True)
         with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
             f.write(serialization.to_bytes(tree))
+        if manifest is not None:
+            with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f)
         os.replace(tmp, path)
+
+    def _write_manifest(self, path: str, manifest: dict) -> None:
+        """Stage-and-rename the manifest into an already-committed step dir
+        (the Orbax path commits the tree itself, so the manifest lands right
+        after; a kill in the gap leaves a manifest-less checkpoint, which
+        restore treats as legacy, and the ``.manifest.tmp`` stray is swept
+        at the next init)."""
+        import json
+
+        tmp = path + ".manifest.tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+
+    def load_manifest(self, step: int) -> dict | None:
+        """The step's ``manifest.json`` (``train/elastic.py`` schema), or
+        None for a pre-manifest (legacy) checkpoint."""
+        import json
+
+        self.wait()
+        path = os.path.join(self._path(step), MANIFEST_NAME)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    @staticmethod
+    def _validate_like(path_prefix: str, ckpt_node: Any, like_node: Any) -> None:
+        """Walk checkpoint/template state-dicts together; raise
+        :class:`CheckpointShapeError` at the first structural or shape
+        mismatch instead of letting msgpack/XLA fail opaquely later."""
+        ckpt_is_map = isinstance(ckpt_node, dict)
+        like_is_map = isinstance(like_node, dict)
+        if ckpt_is_map != like_is_map:
+            raise CheckpointShapeError(
+                path_prefix or "<root>",
+                "a subtree" if ckpt_is_map else _shape_desc(ckpt_node),
+                "a subtree" if like_is_map else _shape_desc(like_node),
+            )
+        if not ckpt_is_map:
+            cs = tuple(getattr(ckpt_node, "shape", ()) or ())
+            ls = tuple(getattr(like_node, "shape", ()) or ())
+            if cs != ls:
+                raise CheckpointShapeError(
+                    path_prefix or "<root>", f"shape {cs}", f"shape {ls}"
+                )
+            return
+        for key in sorted(set(ckpt_node) | set(like_node)):
+            sub = f"{path_prefix}/{key}" if path_prefix else str(key)
+            if key not in ckpt_node:
+                raise CheckpointShapeError(sub, "<missing>", _shape_desc(like_node[key]))
+            if key not in like_node:
+                raise CheckpointShapeError(sub, _shape_desc(ckpt_node[key]), "<missing>")
+            CheckpointManager._validate_like(sub, ckpt_node[key], like_node[key])
+
+    def _validate_manifest_like(self, step: int, like: Any) -> bool:
+        """Validate ``like`` against the step's manifest leaf map; returns
+        False when no manifest exists (legacy checkpoint)."""
+        manifest = self.load_manifest(step)
+        leaves = (manifest or {}).get("leaves")
+        if not leaves:
+            return False
+        from .elastic import leaf_entries
+
+        like_leaves = leaf_entries(like)
+        for path in sorted(set(leaves) | set(like_leaves)):
+            if path not in leaves:
+                raise CheckpointShapeError(
+                    path, "<missing>", f"shape {tuple(like_leaves[path]['shape'])}"
+                )
+            if path not in like_leaves:
+                raise CheckpointShapeError(
+                    path, f"shape {tuple(leaves[path]['shape'])}", "<missing>"
+                )
+            cs = tuple(leaves[path]["shape"])
+            ls = tuple(like_leaves[path]["shape"])
+            if cs != ls:
+                raise CheckpointShapeError(path, f"shape {cs}", f"shape {ls}")
+        return True
 
     def restore(self, step: int, like: Any | None = None) -> Any:
         self.wait()
         path = self._path(step)
+        if like is not None:
+            self._validate_manifest_like(step, like)
         msgpack_file = os.path.join(path, "state.msgpack")
         if os.path.exists(msgpack_file):
             from flax import serialization
 
             with open(msgpack_file, "rb") as f:
-                return serialization.from_bytes(like, f.read())
+                data = f.read()
+            if like is None:
+                return serialization.msgpack_restore(data)
+            # validate against the raw bytes too (covers manifest-less
+            # checkpoints): a mismatched template must name the leaf, not
+            # die in from_bytes with a msgpack structure error
+            raw = serialization.msgpack_restore(data)
+            self._validate_like("", raw, serialization.to_state_dict(like))
+            return serialization.from_state_dict(like, raw)
         return self._ckptr.restore(path, target=like)
 
     def restore_latest(self, like: Any | None = None) -> tuple[int, Any] | None:

@@ -1,0 +1,230 @@
+"""Topology-portable checkpoints: the manifest and the elastic-resume plan.
+
+The VirtualFlow idea (PAPERS.md) on our substrate: decouple the persisted
+model state from the hardware shape so a job checkpointed on one mesh can
+resume on another — fewer chips after a capacity loss, more chips when the
+scheduler grows it back.  The state itself has been portable since PR 3
+(``state_to_host`` gathers full global arrays; restore re-shards via
+``sharding_for_tree`` on whatever mesh is live), so what this module adds is
+the *contract* that makes cross-topology restore safe instead of accidental:
+
+- every committed checkpoint carries a ``manifest.json`` describing the mesh
+  it was written from, the partition-rule fingerprint, the global batch
+  semantics, and the per-leaf shape/dtype map;
+- restore validates the manifest against the live trainer (rule fingerprint,
+  leaf shapes) and *recomputes the batch microstructure* — per-device batch
+  and ``grad_accum_steps`` — so the optimizer sees the same global batch
+  decomposed over the same row-shards, whatever the new chip count.
+
+Numerics contract (docs/elasticity.md): restoring onto a different mesh
+preserves every state leaf bit-for-bit, and the global batch semantics are
+identical, but gradient *reductions* cross device boundaries differently on
+a different topology, so trajectories match to reduction-order tolerance —
+not bit-for-bit the way same-shape resume does (``tests/test_chaos.py``).
+Same-shape resume through this path stays bit-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Any, Mapping
+
+logger = logging.getLogger(__name__)
+
+#: manifest schema version (bump on incompatible changes)
+MANIFEST_FORMAT = 1
+
+#: mesh axes whose product shards the batch dimension (mirrors
+#: ``parallel.mesh.AxisNames.BATCH_AXES`` without importing jax here — this
+#: module must stay importable by the control plane, which has no device)
+_BATCH_AXES = ("dp", "fsdp")
+
+
+class ElasticManifestError(ValueError):
+    """A checkpoint manifest is incompatible with the live trainer (rule
+    fingerprint mismatch, unsatisfiable batch decomposition, ...)."""
+
+
+def leaf_entries(host_tree: Any) -> dict[str, dict[str, Any]]:
+    """``path -> {shape, dtype}`` over a host state tree.
+
+    Paths are the ``/``-joined state-dict keys — the same addressing the
+    msgpack/orbax serialization uses, so restore-time validation speaks the
+    format's own language when it names an offending leaf.
+    """
+    from flax import serialization
+
+    out: dict[str, dict[str, Any]] = {}
+
+    def walk(prefix: str, node: Any) -> None:
+        if isinstance(node, Mapping):
+            for k in sorted(node):
+                walk(f"{prefix}/{k}" if prefix else str(k), node[k])
+            return
+        shape = tuple(getattr(node, "shape", ()) or ())
+        dtype = str(getattr(node, "dtype", type(node).__name__))
+        out[prefix] = {"shape": list(shape), "dtype": dtype}
+
+    walk("", serialization.to_state_dict(host_tree))
+    return out
+
+
+def build_manifest(
+    *,
+    step: int,
+    mesh_axes: Mapping[str, int],
+    rule_fingerprint: str,
+    global_batch_size: int,
+    grad_accum_steps: int,
+    seq_len: int,
+    seed: int,
+    host_tree: Any,
+) -> dict[str, Any]:
+    """Assemble the manifest dict the :class:`CheckpointManager` persists
+    alongside the state (``manifest.json`` in the committed step dir)."""
+    axes = {k: int(v) for k, v in mesh_axes.items()}
+    shards = _batch_shards(axes, grad_accum_steps)
+    return {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "mesh_axes": axes,
+        "rule_fingerprint": rule_fingerprint,
+        "global_batch_size": int(global_batch_size),
+        "grad_accum_steps": int(grad_accum_steps),
+        #: row-shards the global batch was reduced over — the quantity
+        #: elastic resume preserves (see :func:`plan_elastic_resume`)
+        "batch_shards": shards,
+        "seq_len": int(seq_len),
+        "seed": int(seed),
+        "leaves": leaf_entries(host_tree),
+    }
+
+
+def _batch_shards(mesh_axes: Mapping[str, int], grad_accum_steps: int) -> int:
+    """Row-groups the global batch is decomposed into: one per batch-axis
+    device shard per accumulation microstep."""
+    devs = math.prod(int(mesh_axes.get(a, 1)) for a in _BATCH_AXES)
+    return max(1, devs) * max(1, int(grad_accum_steps))
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPlan:
+    """How to resume a checkpoint on the live mesh."""
+
+    #: axis sizes of the mesh the checkpoint was written from
+    source_axes: dict[str, int]
+    #: axis sizes of the mesh we are restoring onto
+    target_axes: dict[str, int]
+    #: grad_accum_steps to run with on the target mesh
+    grad_accum_steps: int
+    #: True when the target mesh differs from the source (a real reshard)
+    topology_changed: bool
+    #: True when the recomputed microstructure preserves the checkpoint's
+    #: exact row-shard decomposition (gradient semantics carry over exactly;
+    #: False means the batch had to be re-decomposed — semantics preserved,
+    #: microstructure not)
+    microstructure_preserved: bool
+
+
+def check_fingerprint(manifest: Mapping[str, Any], rule_fingerprint: str) -> None:
+    """Refuse a manifest whose partition-rule fingerprint doesn't match the
+    live model's rule table — restoring through a different table would
+    silently mis-shard the state."""
+    have = manifest.get("rule_fingerprint")
+    if have and have != rule_fingerprint:
+        raise ElasticManifestError(
+            f"checkpoint partition-rule fingerprint {have} does not match "
+            f"the model's rule table {rule_fingerprint}: the checkpoint was "
+            "written under different sharding rules — restore refused "
+            "(docs/elasticity.md)"
+        )
+
+
+def plan_elastic_resume(
+    manifest: Mapping[str, Any],
+    target_mesh_axes: Mapping[str, int],
+    *,
+    batch_size: int,
+    grad_accum_steps: int,
+) -> ElasticPlan:
+    """Recompute the batch microstructure for the target mesh.
+
+    Invariant: the *global* batch (``batch_size`` rows per optimizer step)
+    never changes — the optimizer sees the same data whatever the topology.
+    The knob that absorbs a chip-count change is ``grad_accum_steps``: we
+    keep ``batch_shards = (dp·fsdp) · grad_accum`` equal to the
+    checkpoint's whenever the target's batch-device count divides it, so
+    each row-shard (the grain a gradient contraction runs over on one
+    device) holds exactly the same rows as before.  Shrinking dp=2→dp=1
+    turns a 2-device step into a 2-microbatch accumulated step; growing
+    back restores the original decomposition.
+
+    Falls back to the smallest feasible ``grad_accum`` (divisibility of the
+    global batch over shards still enforced) when the shard count doesn't
+    divide — global batch semantics still hold, only the microstructure is
+    re-decomposed.
+    """
+    source_axes = {k: int(v) for k, v in manifest.get("mesh_axes", {}).items()}
+    target_axes = {k: int(v) for k, v in target_mesh_axes.items()}
+    # normalise for comparison: an absent axis is a size-1 axis
+    axis_names = set(source_axes) | set(target_axes)
+    src_norm = {a: source_axes.get(a, 1) for a in axis_names}
+    tgt_norm = {a: target_axes.get(a, 1) for a in axis_names}
+    man_batch = int(manifest.get("global_batch_size") or batch_size)
+    if man_batch != batch_size:
+        # not fatal — the job spec is the source of truth for the CURRENT
+        # run — but a changed global batch means the trajectory is a new
+        # experiment, not a continuation; say so loudly
+        logger.warning(
+            "elastic resume: global batch_size changed %d -> %d; the loss "
+            "trajectory will not continue the checkpointed run's",
+            man_batch, batch_size,
+        )
+    shards = int(manifest.get("batch_shards") or 0)
+    if shards <= 0:
+        shards = _batch_shards(source_axes, int(manifest.get("grad_accum_steps", 1)))
+    target_devs = math.prod(int(target_axes.get(a, 1)) for a in _BATCH_AXES)
+    target_devs = max(1, target_devs)
+
+    preserved = True
+    if shards % target_devs == 0 and batch_size % shards == 0:
+        accum = shards // target_devs
+    else:
+        # shard count not representable on this mesh: re-decompose with the
+        # requested accumulation, clamped to divisibility
+        preserved = False
+        accum = max(1, int(grad_accum_steps))
+        while accum > 1 and (
+            batch_size % accum or (batch_size // accum) % target_devs
+        ):
+            accum -= 1
+    if batch_size % (target_devs * accum):
+        raise ElasticManifestError(
+            f"global batch_size {batch_size} cannot be decomposed over "
+            f"{target_devs} batch-axis devices x {accum} accumulation steps "
+            f"on the target mesh {target_axes} — adjust batch_size or the "
+            "mesh policy"
+        )
+    topology_changed = bool(source_axes) and src_norm != tgt_norm
+    return ElasticPlan(
+        source_axes=source_axes,
+        target_axes=target_axes,
+        grad_accum_steps=accum,
+        topology_changed=topology_changed,
+        microstructure_preserved=preserved,
+    )
+
+
+def largest_feasible_slices(
+    total_chips_per_slice: int, num_slices: int, quota: int
+) -> int:
+    """Largest slice count ``<= num_slices`` that fits a chip quota; 0 when
+    even one slice does not fit.  Used by the retry supervisor to downgrade
+    a recorded topology that no longer fits the device catalog (e.g. the
+    catalog shrank across a controller restart) instead of stranding the
+    job."""
+    if total_chips_per_slice <= 0:
+        return 0
+    return max(0, min(num_slices, quota // total_chips_per_slice))

@@ -280,6 +280,9 @@ class Trainer:
         self._state_shardings = None
         self._init_jit = None
         self._warned_eval_unsplit = False
+        #: stamped into every checkpoint manifest; elastic restore refuses a
+        #: checkpoint written under a different rule table (train/elastic.py)
+        self._rule_fingerprint = rules.fingerprint()
         self._build()
 
     # ---- construction ----------------------------------------------------
@@ -869,6 +872,93 @@ class Trainer:
             tree = multihost_utils.process_allgather(tree, tiled=True)
         return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
+    @property
+    def mesh_axes(self) -> dict[str, int]:
+        """Live mesh axis sizes (``{"dp": 2, "fsdp": 1, ...}``) — what the
+        checkpoint manifest records and elastic restore compares against."""
+        return {
+            name: int(size)
+            for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
+        }
+
+    def _build_manifest(self, step: int, host_state: dict) -> dict:
+        from .elastic import build_manifest
+
+        return build_manifest(
+            step=step,
+            mesh_axes=self.mesh_axes,
+            rule_fingerprint=self._rule_fingerprint,
+            global_batch_size=self.cfg.batch_size,
+            grad_accum_steps=self.cfg.grad_accum_steps,
+            seq_len=self.cfg.seq_len,
+            seed=self.cfg.seed,
+            host_tree=host_state,
+        )
+
+    def _plan_elastic_resume(self, ckpt: CheckpointManager, latest: int,
+                             multi: bool) -> None:
+        """Cross-topology resume contract (``train/elastic.py``): verify the
+        checkpoint's partition-rule fingerprint against the live rule table
+        and recompute ``grad_accum_steps`` so the global batch decomposes
+        into the same row-shards on the live mesh.  Legacy (manifest-less)
+        checkpoints restore as before — same-shape only.
+
+        Multi-host: the manifest lives on rank 0's storage; rank 0 plans and
+        the outcome (or the refusal) is broadcast so every host mutates its
+        config identically — divergent ``grad_accum_steps`` would compile
+        different step graphs and deadlock on collectives.
+        """
+        from .elastic import (
+            ElasticManifestError,
+            check_fingerprint,
+            plan_elastic_resume,
+        )
+
+        plan = None
+        error: str | None = None
+        if not multi or jax.process_index() == 0:
+            manifest = ckpt.load_manifest(latest)
+            if manifest is not None:
+                try:
+                    check_fingerprint(manifest, self._rule_fingerprint)
+                    plan = plan_elastic_resume(
+                        manifest,
+                        self.mesh_axes,
+                        batch_size=self.cfg.batch_size,
+                        grad_accum_steps=self.cfg.grad_accum_steps,
+                    )
+                except ElasticManifestError as exc:
+                    error = str(exc)
+        if multi:
+            from jax.experimental import multihost_utils
+
+            # (-2 = refusal, -1 = no manifest, >=1 = planned accumulation)
+            code = -2 if error else (-1 if plan is None else plan.grad_accum_steps)
+            code = int(multihost_utils.broadcast_one_to_all(
+                np.asarray(code, np.int64)
+            ))
+            if code == -2:
+                raise ElasticManifestError(
+                    error or "rank 0 refused the checkpoint manifest"
+                )
+            if code >= 1 and plan is None:
+                # non-zero rank: apply rank 0's planned accumulation
+                self.cfg.grad_accum_steps = code
+                return
+        if error:
+            raise ElasticManifestError(error)
+        if plan is None:
+            return
+        if plan.topology_changed or plan.grad_accum_steps != self.cfg.grad_accum_steps:
+            logger.info(
+                "elastic restore: checkpoint mesh %s -> live mesh %s "
+                "(grad_accum_steps %d -> %d, batch shards %s)",
+                plan.source_axes, plan.target_axes,
+                self.cfg.grad_accum_steps, plan.grad_accum_steps,
+                "preserved" if plan.microstructure_preserved else "re-decomposed",
+            )
+        self.cfg.grad_accum_steps = plan.grad_accum_steps
+
     @staticmethod
     def _sync_preemption(local_flag: bool) -> bool:
         """OR a per-host preemption flag across all hosts (one tiny allgather
@@ -930,6 +1020,12 @@ class Trainer:
             state = self.load_pretrained(state, pretrained_dir)
         if resume:
             if latest is not None:
+                # Topology-portable resume (train/elastic.py): verify the
+                # manifest and recompute the batch microstructure BEFORE any
+                # step function traces — the state itself is host-gathered
+                # full arrays, so the reshard below lands it on whatever
+                # mesh is live now.
+                self._plan_elastic_resume(ckpt, latest, multi)
                 # Only rank 0 is guaranteed to hold the checkpoint bytes, so
                 # rank 0 restores and the tree is broadcast; other hosts feed
                 # the broadcast a structure-matching template.
@@ -1138,9 +1234,13 @@ class Trainer:
                         # with — commit it synchronously so no background
                         # save thread races the teardown below (prefetch
                         # close / profiler stop), a race observed as a rare
-                        # interpreter crash on fast CPU test runs.
+                        # interpreter crash on fast CPU test runs.  Every
+                        # committed checkpoint carries its topology manifest
+                        # (train/elastic.py) so ANY later mesh can restore it.
                         ckpt.save(step_idx + 1, host_state,
-                                  blocking=last or preempt)
+                                  blocking=last or preempt,
+                                  manifest=self._build_manifest(
+                                      step_idx + 1, host_state))
                 if preempt:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
                     raise SystemExit(143)

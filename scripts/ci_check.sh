@@ -32,6 +32,23 @@ if [ "${1:-}" = "--lint-only" ]; then
     exit 0
 fi
 
+echo "== elastic-fast (topology-portable checkpoints + resize) ==" >&2
+# manifest round-trips, cross-topology (dp=2<->dp=1) restore bit-identity,
+# resize planner/reservations/grow pass, supervisor topology handling, the
+# resize-beats-evict sim gate, AND the slow-marked shrink->resume->grow e2e
+# on real subprocesses (docs/elasticity.md) — the elastic layer fails in
+# minutes here, before the sched/serve/chaos stages.  No 'not slow' filter:
+# the e2e is excluded from tier-1 only to protect that stage's wall-clock.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_elastic_restore.py tests/test_resize.py \
+    "tests/test_sched_e2e.py::test_resize_shrinks_resumes_and_grows_back" -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+elastic_rc=$?
+if [ "$elastic_rc" -ne 0 ]; then
+    echo "ci_check: elastic-fast failed (exit $elastic_rc)" >&2
+    exit "$elastic_rc"
+fi
+
 echo "== sched-fast (fair-share properties on the simulator) ==" >&2
 # pure control-flow (no trainer subprocesses): quota safety under
 # preemption/backfill, victims-always-resume, Jain >= 0.8, FIFO starvation

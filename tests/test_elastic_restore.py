@@ -1,0 +1,255 @@
+"""Topology-portable checkpoints (train/elastic.py, docs/elasticity.md).
+
+Proof layers, all on the 8-virtual-device CPU mesh of the test process:
+
+* every committed checkpoint carries a ``manifest.json`` (mesh axes,
+  partition-rule fingerprint, global-batch microstructure, per-leaf
+  shape/dtype map) — satellite: manifest round-trip;
+* a checkpoint written on dp=2 restores onto dp=1 and back onto dp=2 with
+  every state leaf BIT-IDENTICAL and ``grad_accum_steps`` recomputed so the
+  global batch decomposes into the same row-shards;
+* the dp=2 → dp=1 → dp=2 resumed loss trajectory matches an uninterrupted
+  dp=2 twin within reduction-order tolerance, and the elastic run itself is
+  deterministically replayable bit-for-bit.  (Bit-identity ACROSS topologies
+  is out of reach by construction: gradient contractions cross device
+  boundaries differently on a different mesh, so bf16/f32 reduction order
+  differs — docs/elasticity.md spells this out.  Same-shape resume stays
+  bit-identical: tests/test_chaos.py.)
+* restore refuses a manifest whose partition-rule fingerprint differs from
+  the live rule table, and a mismatched ``like`` tree raises
+  ``CheckpointShapeError`` naming the first offending path (satellites).
+"""
+
+import csv
+import json
+import logging
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from finetune_controller_tpu.data.synthetic import synthetic_batches
+from finetune_controller_tpu.models.llama import PRESETS
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.parallel.mesh import MeshSpec
+from finetune_controller_tpu.parallel.sharding import LLAMA_RULES, PartitionRules
+from finetune_controller_tpu.train.checkpoint import (
+    CheckpointManager,
+    CheckpointShapeError,
+)
+from finetune_controller_tpu.train.elastic import (
+    ElasticManifestError,
+    build_manifest,
+    plan_elastic_resume,
+)
+from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+MODEL = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=2))
+TOTAL, CADENCE, BATCH = 9, 3, 4
+
+
+def _config(total_steps):
+    # constant LR: the schedule must not depend on a segment's total_steps,
+    # or the per-segment configs would train different trajectories
+    return TrainConfig(
+        mode="lora", learning_rate=0.01, schedule="constant", warmup_steps=1,
+        total_steps=total_steps, batch_size=BATCH, seq_len=16,
+        log_every=1, checkpoint_every=CADENCE, heartbeat_interval_s=0,
+    )
+
+
+def _trainer(dp, total_steps):
+    mesh = MeshSpec(dp=dp, fsdp=1).build(jax.devices()[:dp])
+    return Trainer(MODEL, _config(total_steps), mesh=mesh)
+
+
+def _fit(dp, total_steps, art, resume=True):
+    trainer = _trainer(dp, total_steps)
+    batches = synthetic_batches(BATCH, 16, MODEL.vocab_size, seed=0)
+    state = trainer.fit(batches, str(art), resume=resume)
+    return trainer, state
+
+
+def _rows(art):
+    with open(Path(art) / "metrics.csv", newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def _run_elastic(art):
+    """dp=2 to step 3, RESUME on dp=1 to step 6, resume back on dp=2 to 9."""
+    _fit(2, 3, art, resume=False)
+    t1, _ = _fit(1, 6, art)
+    assert t1.cfg.grad_accum_steps == 2  # microstructure preserved on dp=1
+    t2, state = _fit(2, TOTAL, art)
+    assert t2.cfg.grad_accum_steps == 1  # restored on the way back up
+    return state
+
+
+@pytest.fixture(scope="module")
+def runs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("elastic")
+    elastic = root / "elastic"
+    state_elastic = _run_elastic(elastic)
+    twin = root / "twin"
+    _run_elastic(twin)
+    straight = root / "straight"
+    _, state_straight = _fit(2, TOTAL, straight, resume=False)
+    return {
+        "root": root,
+        "elastic": elastic,
+        "twin": twin,
+        "straight": straight,
+        "state_elastic": state_elastic,
+        "state_straight": state_straight,
+    }
+
+
+def test_every_committed_checkpoint_carries_a_manifest(runs):
+    ckpts = sorted((runs["elastic"] / "checkpoints").glob("step_*"))
+    assert [p.name for p in ckpts] == ["step_3", "step_6", "step_9"]
+    for p in ckpts:
+        manifest = json.loads((p / "manifest.json").read_text())
+        assert manifest["format"] == 1
+        assert manifest["rule_fingerprint"] == LLAMA_RULES.fingerprint()
+        assert manifest["global_batch_size"] == BATCH
+        assert manifest["batch_shards"] == 2  # invariant across topologies
+        assert manifest["leaves"]  # per-leaf shape/dtype map present
+    # step_6 was written on the dp=1 mesh, step_9 on dp=2 after the grow
+    m6 = json.loads((ckpts[1] / "manifest.json").read_text())
+    m9 = json.loads((ckpts[2] / "manifest.json").read_text())
+    assert (m6["mesh_axes"]["dp"], m6["grad_accum_steps"]) == (1, 2)
+    assert (m9["mesh_axes"]["dp"], m9["grad_accum_steps"]) == (2, 1)
+
+
+def test_cross_topology_restore_is_bitwise_on_state(runs):
+    """The same committed step restores bit-identically through a dp=1 and
+    a dp=2 trainer's template — the state is mesh-free."""
+    ck = CheckpointManager(str(runs["elastic"] / "checkpoints"))
+    t1 = _trainer(1, TOTAL)
+    t2 = _trainer(2, TOTAL)
+    host1 = ck.restore(9, like=t1.state_to_host(t1.init_state()))
+    host2 = ck.restore(9, like=t2.state_to_host(t2.init_state()))
+    leaves1, leaves2 = jax.tree.leaves(host1), jax.tree.leaves(host2)
+    assert len(leaves1) == len(leaves2)
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_elastic_run_is_deterministically_replayable(runs):
+    """Two elastic dp=2->1->2 runs are bit-identical to each other, row for
+    row — the resharding path adds no nondeterminism (cache on or off:
+    conftest enables the persistent XLA cache, so the twin leg typically
+    replays through cached executables)."""
+    rows_a, rows_b = _rows(runs["elastic"]), _rows(runs["twin"])
+    assert [r["step"] for r in rows_a] == [str(s) for s in range(1, TOTAL + 1)]
+    for ra, rb in zip(rows_a, rows_b):
+        for col in ("loss", "accuracy", "grad_norm"):
+            assert float(ra[col]) == float(rb[col]), (ra["step"], col)
+
+
+def test_elastic_trajectory_tracks_uninterrupted_run(runs):
+    """The dp=2->1->2 run continues the uninterrupted dp=2 trajectory:
+    step-continuous rows, same step count, loss within reduction-order
+    tolerance at every logged step (see module docstring for why tolerance,
+    not bit-identity, is the cross-topology contract)."""
+    rows_e, rows_s = _rows(runs["elastic"]), _rows(runs["straight"])
+    assert [r["step"] for r in rows_e] == [r["step"] for r in rows_s]
+    for re_, rs in zip(rows_e, rows_s):
+        dl = abs(float(re_["loss"]) - float(rs["loss"]))
+        assert dl <= 5e-2, (re_["step"], re_["loss"], rs["loss"])
+    # the dp=2 segments BEFORE the first topology change are bit-identical
+    for re_, rs in zip(rows_e[:3], rows_s[:3]):
+        assert float(re_["loss"]) == float(rs["loss"]), re_["step"]
+
+
+def test_elastic_restore_is_logged(runs, caplog, tmp_path):
+    art = tmp_path / "logcheck"
+    _fit(2, 3, art, resume=False)
+    with caplog.at_level(logging.INFO):
+        _fit(1, 6, art)
+    assert any("elastic restore" in r.message for r in caplog.records)
+
+
+def test_fingerprint_mismatch_is_refused(runs, tmp_path):
+    """Restore through a DIFFERENT partition-rule table must refuse the
+    checkpoint with a clear error, not silently mis-shard (satellite)."""
+    art = tmp_path / "fp"
+    _fit(1, 3, art, resume=False)
+    other_rules = PartitionRules([(r".*", P())])
+    mesh = MeshSpec(dp=1, fsdp=1).build(jax.devices()[:1])
+    trainer = Trainer(MODEL, _config(6), mesh=mesh, rules=other_rules)
+    batches = synthetic_batches(BATCH, 16, MODEL.vocab_size, seed=0)
+    with pytest.raises(ElasticManifestError, match="fingerprint"):
+        trainer.fit(batches, str(art), resume=True)
+
+
+def test_shape_mismatch_names_first_offending_path(runs):
+    """A mismatched ``like`` tree (wrong lora rank) surfaces as a
+    CheckpointShapeError naming the path and both shapes — not a raw
+    msgpack/XLA error (satellite)."""
+    ck = CheckpointManager(str(runs["elastic"] / "checkpoints"))
+    other = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=4))
+    mesh = MeshSpec(dp=1, fsdp=1).build(jax.devices()[:1])
+    trainer = Trainer(other, _config(TOTAL), mesh=mesh)
+    template = trainer.state_to_host(trainer.init_state())
+    with pytest.raises(CheckpointShapeError) as exc:
+        ck.restore(9, like=template)
+    assert "lora" in str(exc.value)
+    assert "shape" in str(exc.value)
+
+
+def test_legacy_manifestless_checkpoint_still_restores(runs, tmp_path):
+    """Pre-manifest checkpoints (or a crash between tree-commit and
+    manifest write) restore as before — same-shape only, no refusal."""
+    art = tmp_path / "legacy"
+    _fit(1, 3, art, resume=False)
+    for m in (art / "checkpoints").glob("step_*/manifest.json"):
+        m.unlink()
+    t, state = _fit(1, 6, art)
+    assert int(state.step) == 6
+    assert t.cfg.grad_accum_steps == 1
+
+
+# ---------------------------------------------------------------------------
+# plan_elastic_resume unit coverage (no trainer)
+# ---------------------------------------------------------------------------
+
+
+def _manifest(dp, fsdp=1, ga=1, batch=8):
+    return build_manifest(
+        step=1,
+        mesh_axes={"dp": dp, "fsdp": fsdp, "ep": 1, "pp": 1, "sp": 1, "tp": 1},
+        rule_fingerprint="sha256:x",
+        global_batch_size=batch,
+        grad_accum_steps=ga,
+        seq_len=16,
+        seed=0,
+        host_tree={"step": np.zeros(())},
+    )
+
+
+def test_plan_preserves_row_shards_across_topologies():
+    m = _manifest(dp=4, ga=1, batch=8)  # 4 shards of 2 rows
+    down = plan_elastic_resume(m, {"dp": 1}, batch_size=8, grad_accum_steps=1)
+    assert down.grad_accum_steps == 4 and down.microstructure_preserved
+    half = plan_elastic_resume(m, {"dp": 2}, batch_size=8, grad_accum_steps=1)
+    assert half.grad_accum_steps == 2 and half.microstructure_preserved
+    same = plan_elastic_resume(m, {"dp": 4}, batch_size=8, grad_accum_steps=1)
+    assert same.grad_accum_steps == 1 and not same.topology_changed
+
+
+def test_plan_redecomposes_when_shards_do_not_divide():
+    m = _manifest(dp=3, ga=1, batch=6)  # 3 shards
+    plan = plan_elastic_resume(m, {"dp": 2}, batch_size=6, grad_accum_steps=1)
+    assert not plan.microstructure_preserved
+    assert plan.grad_accum_steps >= 1
+    assert 6 % (2 * plan.grad_accum_steps) == 0
+
+
+def test_plan_rejects_indivisible_batch():
+    m = _manifest(dp=2, ga=1, batch=2)
+    with pytest.raises(ElasticManifestError, match="decomposed"):
+        plan_elastic_resume(m, {"dp": 4}, batch_size=2, grad_accum_steps=1)

@@ -61,7 +61,7 @@ class ScriptedBackend(TrainingBackend):
     async def get_job(self, job_id):
         return self.reports.get(job_id)
 
-    async def delete_job(self, job_id):
+    async def delete_job(self, job_id, *, forget_reservations=False):
         self.deleted.append(job_id)
         return self.reports.pop(job_id, None) is not None
 

@@ -93,7 +93,7 @@ def test_metrics_sched_gauges(tmp_path):
         sched.try_admit()
         sched.submit("m-hi", "chip-1", 2, queue="prod", priority="high")
         sched.try_admit()
-        assert sched.take_preemptions() == [("m-lo", "m-hi")]
+        assert [d.pair for d in sched.take_preemptions()] == [("m-lo", "m-hi")]
         rt.backend.scheduler = sched
 
         body = await (await client.get("/metrics")).text()
@@ -103,6 +103,10 @@ def test_metrics_sched_gauges(tmp_path):
         assert "ftc_sched_preemptions_total 1" in body
         assert 'ftc_sched_queue_dominant_share{queue="batch"}' in body
         assert 'ftc_sched_queue_borrowed_chips{queue="batch"}' in body
+        # elasticity counters (docs/elasticity.md)
+        assert 'ftc_sched_queue_resizes_total{queue="batch"} 0' in body
+        assert "ftc_sched_resizes_total 0" in body
+        assert "ftc_sched_shrunk_workloads 0" in body
         await client.close()
 
     run_async(main())

@@ -101,8 +101,12 @@ def test_gang_scheduler_fifo_starvation_pinned():
 def test_fairshare_reserves_for_blocked_head_no_starvation():
     """The fix for the pin above: once the big job is head-of-line, free
     chips are reserved for it — small jobs stop slipping past, and the big
-    job admits as soon as its reservation is satisfied."""
-    sched = FairShareScheduler(_catalog(quota=2))
+    job admits as soon as its reservation is satisfied.
+
+    Pinned in evict mode (``resize=False``, the FTC_SCHED_RESIZE=false
+    behavior): with resize on, the blocked head ELASTICALLY ADMITS at one
+    slice instead of starving — pinned in tests/test_resize.py."""
+    sched = FairShareScheduler(_catalog(quota=2), resize=False)
     sched.submit("s0", "chip")
     sched.submit("s1", "chip")
     assert {w.job_id for w in sched.try_admit()} == {"s0", "s1"}
@@ -186,7 +190,7 @@ def test_high_priority_preempts_lowest_youngest_first():
     assert sched.try_admit() == []  # full: hi blocks as head
     victims = sched.take_preemptions()
     # exactly the shortfall: one victim, lowest priority, youngest first
-    assert victims == [("lo-young", "hi")]
+    assert [d.pair for d in victims] == [("lo-young", "hi")]
     sched.release("lo-young")  # the backend reports the exit
     assert [w.job_id for w in sched.try_admit()] == ["hi"]
 
@@ -213,7 +217,9 @@ def test_reserved_chips_not_stolen_by_later_submit():
     sched.try_admit()
     sched.submit("hi", "chip", num_slices=2, priority="high")
     sched.try_admit()
-    assert sched.take_preemptions() == [("lo", "hi")]
+    # a 2-slice victim for a 2-chip shortfall: shrinking to 1 would cover
+    # only half, so the planner escalates to a full eviction
+    assert [d.pair for d in sched.take_preemptions()] == [("lo", "hi")]
     # a normal-priority 1-chip job arrives mid-eviction
     sched.submit("sneak", "chip", priority="normal")
     assert sched.try_admit() == []  # nothing is free yet
@@ -225,14 +231,17 @@ def test_reserved_chips_not_stolen_by_later_submit():
 
 def test_backfill_rides_preemption_excess():
     """A 1-chip job may ride along when a preemption frees more than the
-    head needs — but only the excess, and only chips physically free."""
-    sched = FairShareScheduler(_catalog(quota=4))
+    head needs — but only the excess, and only chips physically free.
+
+    Pinned in evict mode: with resize on the 4-slice victim SHRINKS to 2
+    instead (tests/test_resize.py pins that path)."""
+    sched = FairShareScheduler(_catalog(quota=4), resize=False)
     sched.submit("lo", "chip", num_slices=4, priority="low")
     sched.try_admit()
     sched.submit("hi", "chip", num_slices=2, priority="high")
     sched.submit("small", "chip", num_slices=1, priority="normal")
     sched.try_admit()
-    assert sched.take_preemptions() == [("lo", "hi")]
+    assert [d.pair for d in sched.take_preemptions()] == [("lo", "hi")]
     # victim still holds its chips: nothing admits while it exits
     assert sched.try_admit() == []
     sched.release("lo")
@@ -252,7 +261,7 @@ def test_same_priority_reclaim_only_no_thrash():
     sched.submit("b0", "chip", queue="b")
     sched.try_admit()
     victims = sched.take_preemptions()
-    assert victims == [("a3", "b0")]  # youngest borrower evicted
+    assert [d.pair for d in victims] == [("a3", "b0")]  # youngest borrower evicted
     sched.release("a3")
     assert [w.job_id for w in sched.try_admit()] == ["b0"]
     # the displaced a-job requeues: a is now AT its nominal share (2 used of

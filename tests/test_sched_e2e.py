@@ -15,6 +15,8 @@ import csv
 import re
 import time
 
+import pytest
+
 from conftest import one_chip_catalog
 from conftest import run_async as run
 
@@ -167,6 +169,126 @@ def test_preemption_evicts_checkpoints_and_resumes(tmp_path):
         assert snap["preemptions_total"] == 1
         assert snap["reservations"] == {}
         assert sup.retries_scheduled == 1 and sup.resubmits == 1
+        await backend.close()
+        await state.close()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_resize_shrinks_resumes_and_grows_back(tmp_path):
+    """ISSUE 7 acceptance: a 2-slice borrower past its first checkpoint is
+    SHRUNK (not evicted) when a high-priority job arrives — it lands
+    RETRYING classified as a resize (zero backoff, no attempt burned),
+    resumes STEP-CONTINUOUS at dp=1 through the elastic-restore path, and
+    is grown back to 2 slices after the preemptor finishes.  Real
+    subprocesses, real SIGTERMs, real cross-topology checkpoint restores."""
+
+    async def main():
+        registry.reset()
+        registry.load_builtin_models()
+        root = tmp_path / "plane"
+        state = StateStore(root / "state")
+        store = LocalObjectStore(root / "objects")
+        catalog = one_chip_catalog(quota=2)
+        backend = LocalProcessBackend(
+            root / "sandboxes", store, catalog,
+            sync_interval_s=0.2, backoff_limit=0,
+            sched_queues={"batch": 1.0, "prod": 4.0},
+            sched_grow_delay_s=1.0,
+        )
+        supervisor = RetrySupervisor(
+            state, backend, catalog,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                               max_delay_s=0.5, seed=0),
+        )
+        monitor = JobMonitor(state, store, backend, interval_s=0.1,
+                             supervisor=supervisor)
+        await state.connect()
+
+        total, cadence = 2000, 100
+        # the victim saturates the 2-chip cluster at dp=2 (batch_size 2
+        # divides both the dp=2 and the shrunk dp=1 topology)
+        victim_args = _arguments(total, cadence)
+        spec = TinyTestLoRA(training_arguments=victim_args)
+        await task_builder(
+            JobInput(job_id="borrower", user_id="u",
+                     model_name="tiny-test-lora", device="chip-1",
+                     num_slices=2, arguments=victim_args.model_dump(),
+                     queue="batch", priority="low"),
+            spec, DatasetInput(),
+            state=state, store=store, backend=backend, catalog=catalog,
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        victim = backend._handles["borrower"]
+        ckpt_dir = victim.artifacts_dir / "checkpoints"
+        committed = re.compile(r"^step_\d+$")
+        deadline = time.monotonic() + 240
+        while not (ckpt_dir.is_dir()
+                   and any(committed.match(p.name) for p in ckpt_dir.iterdir())):
+            assert time.monotonic() < deadline, "no checkpoint within 240s"
+            await asyncio.sleep(0.1)
+
+        # -- a high-priority 1-chip job arrives: SHRINK, not evict ---------
+        await _submit(state, store, backend, catalog, _arguments(4, 2),
+                      "urgent", queue="prod", priority="high")
+        assert backend.scheduler.preemptions_total == 0  # nobody evicted
+        assert backend.scheduler.shrinks_total == 1
+
+        # -- drive the plane to completion ---------------------------------
+        saw_shrunk_running = False
+        grown = False
+        deadline = time.monotonic() + 420
+        while True:
+            await monitor.tick()
+            vrec = await state.get_job("borrower")
+            meta = vrec.metadata
+            if (vrec.status is DatabaseStatus.RUNNING
+                    and meta.get("current_num_slices") == 1):
+                saw_shrunk_running = True
+            if backend.scheduler.grows_total >= 1:
+                grown = True
+            urec = await state.get_job("urgent")
+            if vrec.status.is_final and urec.status.is_final:
+                break
+            assert time.monotonic() < deadline, (
+                vrec.status, meta, urec.status,
+            )
+            await asyncio.sleep(0.05)
+
+        assert urec.status is DatabaseStatus.SUCCEEDED, urec.metadata
+        assert vrec.status is DatabaseStatus.SUCCEEDED, vrec.metadata
+        # the victim ran at dp=1 while the preemptor held the other chip,
+        # and was grown back once the chips freed
+        assert saw_shrunk_running
+        assert grown
+        history = vrec.metadata["attempt_history"]
+        assert len(history) == 2, history  # shrink, then grow — no failures
+        for entry, to_slices in zip(history, (1, 2)):
+            assert entry["resize"] is True
+            assert entry["resize_to_num_slices"] == to_slices
+            assert entry["delay_s"] == 0.0   # resizes skip the backoff
+            assert entry["attempt"] == 1     # ... and the retry budget
+            assert entry["failure_class"] == "preemption"
+            assert entry["exit_code"] == 143
+        assert vrec.metadata["last_ran_num_slices"] == 2
+        assert supervisor.resizes == 2
+        assert supervisor.elastic_restores == 2
+
+        # resume proof: BOTH restarts resumed from a checkpoint, through the
+        # cross-topology (elastic) restore path
+        log_text = (victim.sandbox / "logs.txt").read_text()
+        assert log_text.count("resumed from checkpoint step") == 2
+        assert "elastic restore: checkpoint mesh" in log_text
+        # metrics are step-continuous across dp=2 -> dp=1 -> dp=2
+        steps = _metric_steps(victim.artifacts_dir)
+        assert steps == list(range(cadence, total + 1, cadence)), steps
+
+        snap = backend.scheduler.snapshot()
+        assert snap["resizes_total"] >= 2
+        assert snap["shrinks_total"] == 1 and snap["grows_total"] == 1
+        assert [h["kind"] for h in snap["resize_history"]] == ["shrink", "grow"]
+        assert snap["resize_reservations"] == {}
         await backend.close()
         await state.close()
 
