@@ -669,6 +669,163 @@ def _measure_sched() -> dict:
     }
 
 
+def _measure_dpo() -> dict:
+    """BENCH_MODE=dpo: the preference-optimization gates (ISSUE 8).
+
+    Two gated legs on the tiny CPU-runnable config:
+
+    1. **DPO** — train on the seeded synthetic preference set
+       (``data/preference.py``): the reward margin must STRICTLY increase
+       over the run (last-quarter mean > first-quarter mean) and final DPO
+       accuracy on HELD-OUT pairs (disjoint seed region) must reach >= 0.7.
+    2. **Actor/learner smoke** — the rlhf loop (``prefs/learner.py``) over
+       two checkpoint commits: the actor must generate from checkpoint N,
+       the learner commit N+1, and the actor reload N+1 within one rollout
+       round — all inside the serve engine's existing compile budget (the
+       armed RecompileGuard raises otherwise).
+
+    Knobs: BENCH_STEPS (DPO optimizer steps), BENCH_BATCH, BENCH_SEQ,
+    BENCH_DPO_BETA, BENCH_DPO_EVAL_BATCHES.
+    """
+    import numpy as np
+
+    import jax
+
+    from finetune_controller_tpu.data.preference import (
+        synthetic_preference_batches,
+    )
+    from finetune_controller_tpu.models.llama import PRESETS
+    from finetune_controller_tpu.models.lora import LoRAConfig
+    from finetune_controller_tpu.prefs.dpo_trainer import DPOTrainer
+    from finetune_controller_tpu.train.trainer import TrainConfig
+
+    preset = os.environ.get("BENCH_PRESET", "tiny-test")
+    steps = int(os.environ.get("BENCH_STEPS", "80"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "32"))
+    beta = float(os.environ.get("BENCH_DPO_BETA", "0.2"))
+    eval_batches = int(os.environ.get("BENCH_DPO_EVAL_BATCHES", "8"))
+
+    model_cfg = PRESETS[preset].replace(lora=LoRAConfig(rank=8))
+    train_cfg = TrainConfig(
+        task="dpo", dpo_beta=beta, batch_size=batch, seq_len=seq,
+        total_steps=steps, warmup_steps=2, learning_rate=1e-3,
+        eval_steps=eval_batches,
+        log_every=10**9, checkpoint_every=10**9, prefetch=0,
+        recompile_budget=int(os.environ.get("BENCH_RECOMPILE_BUDGET", "4")),
+        recompile_action="raise",
+    )
+    trainer = DPOTrainer(model_cfg, train_cfg)
+    state = trainer.init_state()
+    batches = synthetic_preference_batches(
+        batch, seq, model_cfg.vocab_size, seed=0
+    )
+    margins: list[float] = []
+    pair_tput: list[float] = []
+    for _ in range(steps):
+        b = next(batches)
+        t0 = time.perf_counter()
+        state, metrics = trainer.step(state, b)
+        margins.append(float(metrics["reward_margin"]))  # syncs the device
+        pair_tput.append(batch / (time.perf_counter() - t0))
+    if not all(np.isfinite(margins)):
+        fail("dpo bench: non-finite reward margin", margins=margins[:10])
+    q = max(1, steps // 4)
+    margin_first = float(np.mean(margins[:q]))
+    margin_last = float(np.mean(margins[-q:]))
+    if not margin_last > margin_first:
+        fail(
+            "dpo bench: reward margin did not increase over the run",
+            margin_first=round(margin_first, 4),
+            margin_last=round(margin_last, 4),
+        )
+
+    # held-out accuracy via the REAL eval path (disjoint seed region, the
+    # train/cli.py offset) — the same evaluate() a dpo job's eval cadence runs
+    held_out = synthetic_preference_batches(
+        batch, seq, model_cfg.vocab_size, seed=100_003
+    )
+    heldout_acc = float(
+        trainer.evaluate(state, held_out)["eval_dpo_accuracy"]
+    )
+    if heldout_acc < 0.7:
+        fail(
+            "dpo bench: held-out DPO accuracy below the 0.7 gate",
+            heldout_accuracy=round(heldout_acc, 3),
+        )
+
+    # --- actor/learner smoke: generate from N, commit N+1, reload N+1 -----
+    import csv
+    import tempfile
+
+    from finetune_controller_tpu.prefs.learner import (
+        RolloutConfig, build_rlhf_loop,
+    )
+
+    ckpt_every = int(os.environ.get("BENCH_DPO_CKPT_EVERY", "5"))
+    loop_cfg = TrainConfig(
+        task="rlhf", dpo_beta=beta, batch_size=4, seq_len=seq,
+        total_steps=3 * ckpt_every, warmup_steps=1, learning_rate=1e-3,
+        log_every=ckpt_every, checkpoint_every=ckpt_every, prefetch=0,
+        heartbeat_interval_s=0,
+    )
+    learner = DPOTrainer(model_cfg, loop_cfg)
+    with tempfile.TemporaryDirectory(prefix="ftc_dpo_bench_") as d:
+        stream, actor, buffer = build_rlhf_loop(
+            learner, d,
+            rollout=RolloutConfig(
+                pairs_per_round=6, min_fill=6, buffer_capacity=64,
+                max_new_tokens=8, slots=4, temperature=0.9,
+            ),
+        )
+        learner.fit(stream, d, resume=True)
+        with open(os.path.join(d, "metrics.csv"), newline="") as f:
+            rows = list(csv.DictReader(f))
+    versions = [int(float(r["actor_version"])) for r in rows]
+    # the row logged at step k*ckpt_every trained on rollouts from the
+    # checkpoint committed at (k-1)*ckpt_every: reload lag is exactly one
+    # round
+    expected = [max(0, int(float(r["step"])) - ckpt_every) for r in rows]
+    if versions != expected:
+        fail(
+            "dpo bench: actor did not reload each committed checkpoint "
+            "within one round",
+            actor_versions=versions, expected=expected,
+        )
+    if actor.reloads < 2:
+        fail("dpo bench: actor never cycled checkpoints",
+             reloads=actor.reloads)
+    if actor.compilations > actor.compile_budget:
+        fail(  # the armed guard should have raised first
+            "dpo bench: rollout engine exceeded its compile budget",
+            compilations=actor.compilations, budget=actor.compile_budget,
+        )
+    loop_margins = [float(r["reward_margin"]) for r in rows]
+
+    return {
+        "metric": f"dpo_heldout_accuracy[{preset},bs{batch},seq{seq},"
+                  f"steps{steps},beta{beta:g}]",
+        "value": round(heldout_acc, 3),
+        "unit": "held-out pair-ranking accuracy",
+        "margin_first_quarter": round(margin_first, 4),
+        "margin_last_quarter": round(margin_last, 4),
+        "margin_gain": round(margin_last - margin_first, 4),
+        "pairs_per_sec": round(float(np.median(pair_tput)), 1),
+        "rlhf_smoke": {
+            "actor_versions": versions,
+            "reloads": actor.reloads,
+            "bootstrap_pairs": actor.bootstrap_pairs,
+            "rollout_pairs": actor.pairs_generated,
+            "actor_tokens_per_sec": round(actor.tokens_per_sec, 1),
+            "engine_compilations": actor.compilations,
+            "engine_compile_budget": actor.compile_budget,
+            "loop_margins": [round(m, 4) for m in loop_margins],
+            "buffer_depth": buffer.depth,
+        },
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def _measure_serve() -> dict:
     """BENCH_MODE=serve: continuous-batching engine vs sequential decode.
 
@@ -913,6 +1070,14 @@ def main() -> None:
         # scheduler-policy bench: pure simulator, no accelerator at all
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps(_measure_sched()))
+        return
+    if os.environ.get("BENCH_MODE", "").strip().lower() == "dpo":
+        # preference-optimization gates (docs/preference.md): the gates are
+        # scale-free (margin trend + held-out accuracy on the tiny config),
+        # so this runs on CPU by default like chaos/sched — pin
+        # JAX_PLATFORMS=tpu explicitly to measure pair throughput on chips
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(_measure_dpo()))
         return
     _init_backend_with_fallback()
     import jax

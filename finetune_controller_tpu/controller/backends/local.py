@@ -222,6 +222,12 @@ class LocalProcessBackend(TrainingBackend):
                 job.job_id, flavor.name, job.num_slices,
                 queue=job.queue, priority=job.priority,
                 requested_slices=handle.requested_slices,
+                # an atomic gang (RLHF actor+learner) must never run
+                # partially: floor every shrink at the full gang size
+                min_slices=(
+                    job.num_slices if getattr(spec, "atomic_gang", False)
+                    else 1
+                ),
             )
             self._lost.pop(job.job_id, None)  # resubmit clears any tombstone
             handle.set_state(BackendJobState.SUSPENDED)

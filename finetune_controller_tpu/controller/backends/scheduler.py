@@ -64,11 +64,12 @@ class GangScheduler:
         queue: str | None = None,
         priority: object | None = None,
         requested_slices: int | None = None,
+        min_slices: int = 1,
     ) -> Workload:
         """Register a suspended workload (``runPolicy.suspend: true`` until
         admitted — ``PyTorchJobDeployer.py:179-185``).
 
-        ``queue``/``priority``/``requested_slices`` are accepted for
+        ``queue``/``priority``/``requested_slices``/``min_slices`` are accepted for
         signature parity with the fair-share scheduler
         (``finetune_controller_tpu/sched/``) and deliberately ignored: this
         is the documented FIFO escape hatch (``FTC_SCHED_POLICY=fifo``),

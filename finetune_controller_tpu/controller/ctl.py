@@ -9,8 +9,8 @@ an on-cluster deployment).
 
 Commands:
     models                              list submittable models
-    submit MODEL [--arg k=v ...] [--device D] [--queue Q] [--priority P] [--dataset-file F | --dataset-url U | --dataset-id I] [--watch]
-    jobs [--page N]                     paginated job table
+    submit MODEL [--arg k=v ...] [--device D] [--task T] [--queue Q] [--priority P] [--dataset-file F | --dataset-url U | --dataset-id I] [--watch]
+    jobs [--page N]                     paginated job table (incl. task type)
     queue                               tenant queues: usage/share/borrowed + pending
     serve                               serving sessions: slots/queue/tokens + prefix-cache hits
     status JOB_ID [--watch]             one job (``--watch`` polls to final)
@@ -143,6 +143,8 @@ async def cmd_submit(client: Client, ns: argparse.Namespace) -> int:
         form.add_field("model_name", ns.model)
         if ns.device:
             form.add_field("device", ns.device)
+        if ns.task:
+            form.add_field("task", ns.task)
         if ns.queue:
             form.add_field("queue", ns.queue)
         if ns.priority:
@@ -159,6 +161,8 @@ async def cmd_submit(client: Client, ns: argparse.Namespace) -> int:
         body: dict[str, Any] = {"model_name": ns.model, "arguments": arguments}
         if ns.device:
             body["device"] = ns.device
+        if ns.task:
+            body["task"] = ns.task
         if ns.queue:
             body["queue"] = ns.queue
         if ns.priority:
@@ -185,7 +189,11 @@ async def cmd_jobs(client: Client, ns: argparse.Namespace) -> int:
     width = max(len(r["job_id"]) for r in rows)
     for r in rows:
         dur = r.get("duration") or ""
-        print(f"{r['job_id']:<{width}}  {r['status']:<10}  {dur}")
+        # task type rides the job metadata (task_builder): sft jobs predate
+        # the column and show as causal_lm/multimodal; blanks are pre-task
+        # records
+        task = (r.get("metadata") or {}).get("task") or ""
+        print(f"{r['job_id']:<{width}}  {task:<12}  {r['status']:<10}  {dur}")
     print(f"(page {ns.page}, total {page.get('total')})")
     return 0
 
@@ -376,6 +384,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("model")
     s.add_argument("--arg", action="append", metavar="K=V")
     s.add_argument("--device")
+    s.add_argument("--task",
+                   help="expected task type (causal_lm | multimodal | dpo | "
+                        "rlhf ...); the server 400s on unknown values or a "
+                        "model/task mismatch")
     s.add_argument("--queue", help="tenant queue (docs/scheduling.md)")
     s.add_argument("--priority", help="low | normal | high | integer")
     s.add_argument("--dataset-file")

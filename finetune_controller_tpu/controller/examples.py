@@ -67,6 +67,59 @@ class LoRASFTArguments(TrainingArguments):
     )
 
 
+class DPOArguments(LoRASFTArguments):
+    """Hyperparameters of a DPO job (docs/preference.md): the SFT knobs plus
+    the preference-objective β."""
+
+    beta: float = Field(
+        0.1, gt=0, le=100,
+        description="DPO inverse-temperature β — how strongly the implicit "
+                    "KL pins the policy to the frozen reference (the "
+                    "adapter-disabled base)",
+    )
+
+
+class RLHFArguments(DPOArguments):
+    """DPO knobs plus the actor/learner rollout loop's
+    (``prefs/learner.py::RolloutConfig``; ``FTC_RLHF_*`` env vars override
+    per pod)."""
+
+    rollout_pairs_per_round: int = Field(
+        16, ge=1, le=4096,
+        description="Prompts the actor decodes (2 candidates each) per "
+                    "generation round",
+    )
+    rollout_buffer_capacity: int = Field(
+        256, ge=1, le=1_000_000,
+        description="Rollout buffer size (bounded; oldest pairs drop first)",
+    )
+    rollout_min_fill: int = Field(
+        16, ge=1, le=1_000_000,
+        description="Pairs the buffer must hold before the learner samples "
+                    "a batch",
+    )
+    rollout_staleness_checkpoints: int = Field(
+        2, ge=1, le=1000,
+        description="Staleness cap: drop pairs generated more than this "
+                    "many checkpoints behind the newest commit",
+    )
+    rollout_temperature: float = Field(
+        0.8, ge=0, le=10,
+        description="Actor sampling temperature (two candidates per prompt)",
+    )
+    rollout_top_k: int = Field(
+        0, ge=0, le=100_000,
+        description="Actor top-k sampling cutoff (0 = full distribution)",
+    )
+    rollout_max_new_tokens: int = Field(
+        16, ge=1, le=4096, description="Completion length per rollout"
+    )
+    rollout_slots: int = Field(
+        4, ge=1, le=256,
+        description="Decode lanes of the actor's serve engine",
+    )
+
+
 class TinyLlamaLoRA(BaseFineTuneJob):
     """BASELINE config #1 — the CPU-runnable smoke workload and CI workhorse."""
 
@@ -240,6 +293,79 @@ class TinyMMTestLoRA(BaseFineTuneJob):
     training_arguments: LoRASFTArguments
 
 
+class TinyLlamaDPO(BaseFineTuneJob):
+    """TinyLlama preference tuning — the CPU-runnable DPO config
+    (docs/preference.md)."""
+
+    model_name = "tinyllama-1.1b-dpo"
+    description = "TinyLlama-1.1B DPO over preference pairs (LoRA policy, " \
+                  "adapter-disabled reference)"
+    task = TrainingTask.DPO
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "tinyllama-1.1b"
+    default_device = "cpu-test"
+    promotion_path = "models/tinyllama"
+    dataset = TrainingDataset(
+        required=False,
+        description="preference jsonl: {prompt, chosen, rejected} rows "
+                    "(or *_tokens variants); omitted = seeded synthetic pairs",
+    )
+
+    training_arguments: DPOArguments
+
+
+class Llama3_8B_DPO(BaseFineTuneJob):
+    """Llama-3 8B DPO on the v5e-16 FSDP slice — the production-shaped
+    preference-tuning config."""
+
+    model_name = "llama3-8b-dpo"
+    description = "Llama-3 8B DPO, FSDP over a v5e-16 slice"
+    task = TrainingTask.DPO
+    framework = TrainingFramework.JAX_LORA
+    model_preset = "llama3-8b"
+    default_device = "v5e-16"
+    promotion_path = "models/llama3-8b"
+    dataset = TrainingDataset(
+        required=False,
+        description="preference jsonl: {prompt, chosen, rejected} rows",
+    )
+
+    training_arguments: DPOArguments
+
+
+class TinyDPOTest(BaseFineTuneJob):
+    """Milliseconds-scale DPO spec for the e2e lifecycle tests."""
+
+    model_name = "tiny-dpo-test"
+    description = "2-layer test model; DPO e2e smoke spec"
+    task = TrainingTask.DPO
+    model_preset = "tiny-test"
+    default_device = "cpu-test"
+    promotion_path = "models/tiny-test"
+    dataset = TrainingDataset(required=False, description="optional jsonl")
+
+    training_arguments: DPOArguments
+
+
+class TinyRLHFTest(BaseFineTuneJob):
+    """RLHF-lite smoke spec: the actor (serve engine over the latest
+    committed checkpoint) and the DPO learner run as an inseparable gang —
+    ``atomic_gang`` makes the scheduler admit the 2 slices all-or-nothing
+    and never shrink them (a partial gang cannot run)."""
+
+    model_name = "tiny-rlhf-test"
+    description = "2-layer test model; actor/learner RLHF-lite gang smoke spec"
+    task = TrainingTask.RLHF
+    model_preset = "tiny-test"
+    default_device = "cpu-test"
+    default_num_slices = 2  # learner slice + actor slice, admitted as a gang
+    atomic_gang = True
+    promotion_path = "models/tiny-test"
+    dataset = TrainingDataset(required=False, description="optional jsonl")
+
+    training_arguments: RLHFArguments
+
+
 class TinyTestLoRA(BaseFineTuneJob):
     """Milliseconds-scale spec used by the e2e lifecycle tests."""
 
@@ -264,16 +390,23 @@ BUILTIN_JOB_SPECS: list[type[BaseFineTuneJob]] = [
     Mistral7B_QLoRA,
     Mixtral8x7B_MoE_LoRA,
     Llava15LoRA,
+    TinyLlamaDPO,
+    Llama3_8B_DPO,
     TinyTestLoRA,
     TinyMoETestLoRA,
     TinyMMTestLoRA,
+    TinyDPOTest,
+    TinyRLHFTest,
 ]
 
 
 if __name__ == "__main__":
     # executable smoke-validation, the model-author convention
+    import typing as _typing
+
     for cls in BUILTIN_JOB_SPECS:
-        job = cls(training_arguments=LoRASFTArguments())
+        args_cls = _typing.get_type_hints(cls)["training_arguments"]
+        job = cls(training_arguments=args_cls())
         spec = job.build_trainer_spec("smoke-1", "/tmp/artifacts")
         assert spec["model"]["preset"] == cls.model_preset
         print(f"{cls.model_name}: ok ({spec['training']})")

@@ -351,10 +351,25 @@ async def start_job(request: web.Request) -> web.Response:
     # pydantic-validates the typed hyperparameters; ValidationError → 400 list
     spec = cls(training_arguments=arguments)
 
-    # optional task cross-check (reference: app/main.py:455-459)
+    # task validation (reference: app/main.py:455-459, hardened): an unknown
+    # task value is a 400 NAMING the known tasks — previously any string
+    # passed as long as it didn't collide with the model's task
     task = fields.get("task")
-    if task and task != cls.task.value:
-        return _json_error(400, f"model {model_name!r} is a {cls.task.value} model")
+    if task:
+        from .specs import known_tasks
+
+        known_task_values = known_tasks()
+        if task not in known_task_values:
+            return _json_error(
+                400,
+                f"unknown task {task!r}; known tasks: {known_task_values}",
+            )
+        if task != cls.task.value:
+            return _json_error(
+                400,
+                f"model {model_name!r} is a {cls.task.value} model, "
+                f"not {task!r}",
+            )
 
     device = fields.get("device") or cls.default_device
     flavor = rt.catalog.get(device)
@@ -903,7 +918,8 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
         f"ftc_monitor_ticks_total {rt.monitor.ticks}",
     ]
     counts: dict[str, int] = {}
-    for job in await rt.state.get_active_jobs():
+    active_jobs = await rt.state.get_active_jobs()
+    for job in active_jobs:
         counts[job.status.value] = counts.get(job.status.value, 0) + 1
     lines.append("# TYPE ftc_jobs_active gauge")
     for status, n in sorted(counts.items()):
@@ -989,6 +1005,41 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
                     f'{metric}{{job_id="{prom_escape(job_id)}"}} '
                     f"{stats[stat_key]}"
                 )
+    # preference-optimization gauges (docs/preference.md): surfaced from the
+    # newest synced metrics row of every ACTIVE dpo/rlhf job — reward margin
+    # is the number a healthy DPO run drives up, and the rollout triple
+    # (buffer depth, staleness, actor tok/s) is the actor/learner loop's
+    # health check.  Bounded cardinality: active preference jobs only.
+    dpo_jobs = [
+        j for j in active_jobs
+        if (j.metadata or {}).get("task") in ("dpo", "rlhf")
+    ]
+    if dpo_jobs:
+        dpo_gauges = (
+            ("ftc_dpo_reward_margin", "reward_margin"),
+            ("ftc_dpo_accuracy", "dpo_accuracy"),
+            ("ftc_dpo_rollout_buffer_depth", "rollout_buffer_depth"),
+            ("ftc_dpo_rollout_staleness", "rollout_staleness"),
+            ("ftc_dpo_actor_tokens_per_sec", "actor_tokens_per_sec"),
+        )
+        rows: dict[str, dict] = {}
+        for job in dpo_jobs:
+            doc = await rt.state.get_metrics(job.job_id)
+            if doc is not None and doc.records:
+                rows[job.job_id] = doc.records[-1]
+        for metric, column in dpo_gauges:
+            samples = []
+            for job_id, row in sorted(rows.items()):
+                try:
+                    value = float(row.get(column, ""))
+                except (TypeError, ValueError):
+                    continue  # column absent (e.g. rollout_* on a plain DPO job)
+                samples.append(
+                    f'{metric}{{job_id="{prom_escape(job_id)}"}} {value:g}'
+                )
+            if samples:
+                lines.append(f"# TYPE {metric} gauge")
+                lines.extend(samples)
     return web.Response(
         body=("\n".join(lines) + "\n").encode("utf-8"),
         headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},

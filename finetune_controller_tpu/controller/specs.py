@@ -30,11 +30,23 @@ from pydantic import BaseModel, Field
 
 
 class TrainingTask(str, enum.Enum):
-    """Reference: ``TrainingTask`` enum, ``finetuning.py:8-12``."""
+    """Reference: ``TrainingTask`` enum, ``finetuning.py:8-12``; extended
+    with the preference-optimization workloads (docs/preference.md)."""
 
     CAUSAL_LM = "causal_lm"
     CLASSIFICATION = "classification"
     MULTIMODAL = "multimodal"
+    #: Direct Preference Optimization over (chosen, rejected) pairs
+    DPO = "dpo"
+    #: RLHF-lite: actor/learner gang — the serve engine generates on-policy
+    #: rollouts that feed the DPO learner
+    RLHF = "rlhf"
+
+
+def known_tasks() -> list[str]:
+    """Task values accepted at submit — the 400 on an unknown ``task`` names
+    these (``controller/server.py``)."""
+    return sorted(t.value for t in TrainingTask)
 
 
 class TrainingFramework(str, enum.Enum):
@@ -112,6 +124,12 @@ class BaseFineTuneJob(BaseModel):
     #: HF checkpoint directory with the pretrained base weights (staged into
     #: the pod like a dataset); empty = random init (smoke/test specs)
     pretrained_weights_dir: ClassVar[str] = ""
+    #: the job's slices form an inseparable GANG (actor+learner — the RLHF
+    #: specs): the scheduler admits all-or-nothing as usual but additionally
+    #: NEVER shrinks it — a partial gang cannot run, so elastic admission
+    #: and resize-instead-of-evict fall back to full preemption for it
+    #: (docs/preference.md, docs/elasticity.md)
+    atomic_gang: ClassVar[bool] = False
     #: model-config overrides baked into the spec (``LlamaConfig`` field →
     #: value) — how a family spec pins its measured kernel winners
     #: (``flash_block_q``/``flash_block_k``/``flash_exp_dtype``/
@@ -140,6 +158,7 @@ class BaseFineTuneJob(BaseModel):
         "mesh_policy": dict,
         "pretrained_weights_dir": str,
         "model_overrides": dict,
+        "atomic_gang": bool,
     }
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
@@ -183,6 +202,10 @@ class BaseFineTuneJob(BaseModel):
         training = {
             "mode": "lora" if self.framework != TrainingFramework.JAX_FULL else "full",
         }
+        preference = self.task in (TrainingTask.DPO, TrainingTask.RLHF)
+        if preference:
+            # select the DPO/rlhf trainer (prefs/, docs/preference.md)
+            training["task"] = self.task.value
         # Lift known trainer knobs out of the user arguments.
         for key in (
             "learning_rate", "warmup_steps", "total_steps", "schedule",
@@ -192,6 +215,22 @@ class BaseFineTuneJob(BaseModel):
         ):
             if key in args:
                 training[key] = args.pop(key)
+        if "beta" in args:
+            if preference:
+                training["dpo_beta"] = args.pop("beta")
+            else:
+                args.pop("beta")  # meaningless for SFT; don't fail the run
+        rollout: dict[str, Any] = {}
+        if self.task is TrainingTask.RLHF:
+            # actor/learner loop knobs (prefs/learner.py::RolloutConfig)
+            for key in (
+                "rollout_pairs_per_round", "rollout_buffer_capacity",
+                "rollout_min_fill", "rollout_staleness_checkpoints",
+                "rollout_temperature", "rollout_top_k",
+                "rollout_max_new_tokens", "rollout_slots",
+            ):
+                if key in args:
+                    rollout[key[len("rollout_"):]] = args.pop(key)
         model: dict[str, Any] = {"preset": self.model_preset}
         if self.pretrained_weights_dir:
             model["weights_dir"] = self.pretrained_weights_dir
@@ -209,10 +248,17 @@ class BaseFineTuneJob(BaseModel):
             "training": training,
             "artifacts_dir": artifacts_dir,
         }
+        if rollout:
+            spec["rollout"] = rollout
         if mesh:
             spec["mesh"] = mesh
         if dataset_path:
             spec["dataset"] = {"path": dataset_path}
+        elif preference:
+            # DPO trains on the seeded synthetic increment pairs; the rlhf
+            # actor generates its own data, so the dataset section only
+            # drives the held-out eval stream (data/preference.py)
+            spec["dataset"] = {"synthetic": {"task": "preference"}}
         else:
             # multimodal smoke jobs get the vision-wiring probe task; text
             # jobs the increment task (data/synthetic.py)

@@ -123,8 +123,14 @@ async def task_builder(
         # queue/priority live in metadata (crash-safe, like retry_next_at):
         # the retry supervisor rebuilds the JobInput from the record, so a
         # resubmitted job must re-enter the SAME tenant queue at the SAME
-        # priority (docs/scheduling.md)
-        metadata={"queue": job.queue, "priority": job.priority},
+        # priority (docs/scheduling.md).  The task type rides along so the
+        # job table (ftc-ctl jobs) and the ftc_dpo_* gauges can tell a DPO
+        # job from an SFT one without a registry lookup per row.
+        metadata={
+            "queue": job.queue,
+            "priority": job.priority,
+            "task": spec.task.value,
+        },
     )
     try:
         await state.create_job(record)

@@ -1,0 +1,15 @@
+"""Preference optimization (docs/preference.md): DPO as a first-class job
+type plus the RLHF-lite actor/learner loop that closes the train→serve loop.
+"""
+
+from .dpo_trainer import DPOTrainer
+from .losses import dpo_loss, masked_sequence_logprobs
+from .rollout_buffer import PreferencePair, RolloutBuffer
+
+__all__ = [
+    "DPOTrainer",
+    "PreferencePair",
+    "RolloutBuffer",
+    "dpo_loss",
+    "masked_sequence_logprobs",
+]

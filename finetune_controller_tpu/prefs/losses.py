@@ -1,0 +1,81 @@
+"""Preference-optimization objectives (docs/preference.md).
+
+Direct Preference Optimization (Rafailov et al., 2023): given a prompt with a
+*chosen* and a *rejected* completion, push the policy's likelihood ratio over
+the frozen reference toward the chosen side:
+
+    loss = -log sigmoid( beta * [ (pi_c - ref_c) - (pi_r - ref_r) ] )
+
+where each term is a per-sequence MASKED sum of token logprobs (prompt tokens
+excluded — only completion targets count, the same mask convention the SFT
+loss uses).  ``beta`` is the KL inverse-temperature: small beta tolerates a
+policy far from the reference; large beta pins it close.
+
+The reference model costs us nothing extra on device: in LoRA mode the policy
+IS base + adapter, so the reference forward is just the base with the adapter
+branch disabled (``prefs/dpo_trainer.py`` runs a rank-0 twin of the model
+over the frozen ``params`` collection) — no second model copy lives in HBM.
+
+All math in f32, matching ``train/losses.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_sequence_logprobs(
+    logits: jax.Array, tokens: jax.Array, loss_mask: jax.Array | None = None
+) -> jax.Array:
+    """Per-sequence sum of next-token logprobs over masked targets.
+
+    logits: (B, S, V); tokens: (B, S) int; loss_mask: (B, S) — 1 where the
+    *target* token counts (completion tokens; prompt and padding are 0).
+    Returns (B,) f32.  Same shift/mask convention as
+    :func:`train.losses.next_token_loss`: position ``t`` of the mask gates the
+    prediction OF token ``t`` (tested for parity in ``tests/test_prefs.py``).
+    """
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1].astype(jnp.float32)
+    if loss_mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    else:
+        mask = loss_mask[:, 1:].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok_lp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (tok_lp * mask).sum(axis=-1)
+
+
+def dpo_loss(
+    policy_chosen_lp: jax.Array,
+    policy_rejected_lp: jax.Array,
+    ref_chosen_lp: jax.Array,
+    ref_rejected_lp: jax.Array,
+    beta: float,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """The DPO objective over per-sequence logprobs; all inputs (B,) f32.
+
+    The reference logprobs are treated as constants (``stop_gradient`` —
+    belt-and-braces: the trainer's reference forward never touches the
+    trainable tree, so no gradient path exists anyway; tested).
+
+    Metrics: ``reward_margin`` is the mean of beta*[(pi_c-ref_c)-(pi_r-ref_r)]
+    — the number a healthy DPO run drives up — and ``dpo_accuracy`` the
+    fraction of pairs with a positive margin (the implicit reward model
+    ranking the pair correctly).
+    """
+    ref_chosen_lp = jax.lax.stop_gradient(ref_chosen_lp)
+    ref_rejected_lp = jax.lax.stop_gradient(ref_rejected_lp)
+    chosen_reward = beta * (policy_chosen_lp - ref_chosen_lp)
+    rejected_reward = beta * (policy_rejected_lp - ref_rejected_lp)
+    margin = chosen_reward - rejected_reward
+    loss = -jax.nn.log_sigmoid(margin).mean()
+    metrics = {
+        "loss": loss,
+        "reward_margin": margin.mean(),
+        "dpo_accuracy": (margin > 0).astype(jnp.float32).mean(),
+        "reward_chosen": chosen_reward.mean(),
+        "reward_rejected": rejected_reward.mean(),
+    }
+    return loss, metrics

@@ -143,6 +143,7 @@ class FairShareScheduler:
         queue: str | None = None,
         priority: object | None = None,
         requested_slices: int | None = None,
+        min_slices: int = 1,
     ) -> Workload:
         """Register a suspended workload under a tenant queue + priority.
 
@@ -150,6 +151,11 @@ class FairShareScheduler:
         originally asked for; a resized resubmit runs at ``num_slices`` and
         the grow pass restores it toward ``requested_slices`` when chips
         free.  Defaults to ``num_slices`` (a job at its full size).
+
+        ``min_slices`` floors every shrink: an atomic gang (RLHF
+        actor+learner, ``spec.atomic_gang``) submits with
+        ``min_slices == num_slices`` and is then only ever admitted whole
+        or fully evicted — never resized.
         """
         if job_id in self._workloads:
             raise ValueError(f"workload {job_id!r} already queued")
@@ -178,6 +184,7 @@ class FairShareScheduler:
             submitted_at=self._clock(),
             num_slices=num_slices,
             requested_slices=requested,
+            min_slices=max(1, min(min_slices, num_slices)),
         )
         self._workloads[job_id] = w
         return w
@@ -389,7 +396,10 @@ class FairShareScheduler:
                 )
                 share_slices = int(max(0.0, share_room) // cps)
                 fit = min(w.num_slices - 1, avail // cps, share_slices)
-                if fit >= 1:
+                # an atomic gang (min_slices == num_slices) never admits
+                # partially — a gang missing its actor (or learner) slice
+                # cannot make progress at all
+                if fit >= max(1, w.min_slices):
                     d = ResizeDecision(
                         job_id=w.job_id, preemptor_id=None,
                         from_slices=w.num_slices, to_slices=fit,

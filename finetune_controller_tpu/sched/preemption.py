@@ -124,7 +124,11 @@ def plan_preemption(
     excess = {q: max(0.0, v) for q, v in over_share.items()}
     if resize:
         for w in eligible:
-            if w.num_slices <= 1:
+            # a victim already at (or below) its shrink floor can only be
+            # fully evicted — min_slices == num_slices is how atomic gangs
+            # (RLHF actor+learner) opt out of partial shrinks entirely
+            floor = max(1, w.min_slices)
+            if w.num_slices <= floor:
                 continue
             cps = w.chips_per_slice
             if cps <= 0:
@@ -135,7 +139,7 @@ def plan_preemption(
             # borrowed chips too, so the next arrival doesn't cost another
             # checkpoint restart
             fair = int(excess.get(w.queue, 0.0) // cps)
-            take = min(w.num_slices - 1, max(need, fair))
+            take = min(w.num_slices - floor, max(need, fair))
             if take <= 0:
                 continue
             plans[w.job_id] = ResizeDecision(

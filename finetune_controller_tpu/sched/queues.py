@@ -144,6 +144,12 @@ class Workload:
     #: slice count the job originally asked for — the grow pass restores a
     #: shrunk workload toward this when chips free (docs/elasticity.md)
     requested_slices: int = 1
+    #: smallest slice count this workload can RUN at.  1 for ordinary elastic
+    #: jobs; equal to ``requested_slices`` for atomic gangs (the RLHF
+    #: actor+learner pair, docs/preference.md) — the shrink planner and
+    #: elastic admission never go below it, so a gang is only ever admitted
+    #: whole or fully preempted
+    min_slices: int = 1
     #: slice count an in-flight resize will resubmit this workload at
     #: (None = full eviction or no resize pending)
     resize_to: int | None = None

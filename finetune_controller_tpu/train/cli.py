@@ -87,6 +87,39 @@ def build_batches(
 
     ds = spec.get("dataset", {})
     path = ds.get("eval_path") if split == "eval" else ds.get("path")
+    if train_cfg.task in ("dpo", "rlhf"):
+        # preference-pair streams (data/preference.py): chosen/rejected
+        # token+mask leaves instead of the SFT tokens/loss_mask pair
+        from ..data.preference import (
+            preference_jsonl_batches,
+            synthetic_preference_batches,
+        )
+
+        if path:
+            return preference_jsonl_batches(
+                path,
+                batch_size=local_batch_size,
+                seq_len=train_cfg.seq_len,
+                tokenizer_file=ds.get("tokenizer_file"),
+                seed=train_cfg.seed,
+                shard_index=shard_index,
+                shard_count=shard_count,
+            )
+        if split == "eval" and ds.get("path"):
+            # real preference data but no eval split configured: nothing held
+            # out — run_job turns this into the explicit 'no eval split'
+            # error rather than silently evaluating on synthetic pairs
+            return None
+        # eval holds out a disjoint seed region, like the SFT synthetic path
+        seed = train_cfg.seed + shard_index + (
+            100_003 if split == "eval" else 0
+        )
+        return synthetic_preference_batches(
+            batch_size=local_batch_size,
+            seq_len=train_cfg.seq_len,
+            vocab_size=model_cfg.vocab_size,
+            seed=seed,
+        )
     if path and model_cfg.image_size:
         # image-bearing rows: one sample per row, pixels resized to the
         # model's vision tower (data/mm_loader.py)
@@ -171,12 +204,33 @@ def run_job(spec: dict) -> None:
         with open(os.path.join(artifacts_dir, "resolved_config.json"), "w") as f:
             json.dump(spec, f, indent=2, default=str)
 
-    trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
-    batches = build_batches(
-        spec, model_cfg, train_cfg,
-        local_batch_size=trainer.local_batch_size,
-        shard_index=jax.process_index(), shard_count=jax.process_count(),
-    )
+    if train_cfg.task == "sft":
+        trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
+    elif train_cfg.task in ("dpo", "rlhf"):
+        from ..prefs.dpo_trainer import DPOTrainer
+
+        # rlhf forces prefetch=0 inside DPOTrainer (the actor runs inline)
+        trainer = DPOTrainer(model_cfg, train_cfg, mesh=mesh)
+    else:
+        raise ValueError(
+            f"unknown training task {train_cfg.task!r}; one of "
+            "['sft', 'dpo', 'rlhf']"
+        )
+    if train_cfg.task == "rlhf":
+        from ..prefs.learner import RolloutConfig, build_rlhf_loop
+
+        rollout_spec = dict(spec.get("rollout", {}))
+        batches, actor, _buffer = build_rlhf_loop(
+            trainer, artifacts_dir,
+            rollout=RolloutConfig(**rollout_spec),
+            pretrained_dir=spec.get("model", {}).get("weights_dir"),
+        )
+    else:
+        batches = build_batches(
+            spec, model_cfg, train_cfg,
+            local_batch_size=trainer.local_batch_size,
+            shard_index=jax.process_index(), shard_count=jax.process_count(),
+        )
     eval_batches = None
     if train_cfg.eval_every > 0:
         eval_batches = build_batches(

@@ -52,6 +52,13 @@ class TrainState:
 @dataclasses.dataclass
 class TrainConfig:
     mode: str = "lora"            # "lora" | "full"
+    #: training objective: "sft" (next-token cross-entropy, this class) |
+    #: "dpo" (preference pairs through ``prefs.dpo_trainer.DPOTrainer``) |
+    #: "rlhf" (actor/learner loop, ``prefs/learner.py`` — DPO over on-policy
+    #: rollouts).  ``train/cli.py`` selects the trainer class from this.
+    task: str = "sft"
+    #: DPO inverse-temperature (KL strength) — used by the dpo/rlhf tasks only
+    dpo_beta: float = 0.1
     learning_rate: float = 2e-4
     warmup_steps: int = 10
     total_steps: int = 100
@@ -280,6 +287,11 @@ class Trainer:
         self._state_shardings = None
         self._init_jit = None
         self._warned_eval_unsplit = False
+        #: commit EVERY checkpoint synchronously (not just the final one).
+        #: Async saves are the throughput default; the rlhf learner flips
+        #: this so the actor's next rollout round deterministically sees the
+        #: just-committed step (prefs/learner.py)
+        self._blocking_checkpoints = False
         #: stamped into every checkpoint manifest; elastic restore refuses a
         #: checkpoint written under a different rule table (train/elastic.py)
         self._rule_fingerprint = rules.fingerprint()
@@ -959,6 +971,22 @@ class Trainer:
             )
         self.cfg.grad_accum_steps = plan.grad_accum_steps
 
+    def _writer_extra_fields(self, eval_enabled: bool) -> tuple[str, ...]:
+        """Metrics-CSV columns that may appear only on later rows and must be
+        declared up front (``MetricsWriter`` pins the header at first write).
+        Subclass hook: ``prefs.dpo_trainer.DPOTrainer`` adds its eval and
+        rollout columns here."""
+        fields: tuple[str, ...] = ("input_ms", "input_fraction")
+        if eval_enabled:
+            fields += ("eval_loss", "eval_accuracy", "eval_input_ms")
+        return fields
+
+    def _row_extras(self) -> dict:
+        """Host-side metrics merged into every logged row (subclass hook —
+        the rlhf learner reports rollout-buffer depth/staleness and actor
+        throughput through this)."""
+        return {}
+
     @staticmethod
     def _sync_preemption(local_flag: bool) -> bool:
         """OR a per-host preemption flag across all hosts (one tiny allgather
@@ -1076,10 +1104,7 @@ class Trainer:
         # the header union instead of silently dropping the new columns
         writer = MetricsWriter(
             artifacts_dir, append=start_step > 0,
-            extra_fields=("input_ms", "input_fraction") + (
-                ("eval_loss", "eval_accuracy", "eval_input_ms")
-                if eval_it is not None else ()
-            ),
+            extra_fields=self._writer_extra_fields(eval_it is not None),
             # a crash AFTER a logged row but BEFORE its checkpoint committed
             # makes this run replay those steps — drop their rows so the
             # replay doesn't duplicate them
@@ -1197,6 +1222,7 @@ class Trainer:
                     )
                     metrics["input_fraction"] = window_input_s / max(dt, 1e-9)
                     metrics.update(eval_metrics)
+                    metrics.update(self._row_extras())
                     row = {"step": step_idx + 1, **metrics}
                     writer.write(row)
                     if on_metrics:
@@ -1238,7 +1264,8 @@ class Trainer:
                         # committed checkpoint carries its topology manifest
                         # (train/elastic.py) so ANY later mesh can restore it.
                         ckpt.save(step_idx + 1, host_state,
-                                  blocking=last or preempt,
+                                  blocking=(last or preempt
+                                            or self._blocking_checkpoints),
                                   manifest=self._build_manifest(
                                       step_idx + 1, host_state))
                 if preempt:

@@ -32,6 +32,23 @@ if [ "${1:-}" = "--lint-only" ]; then
     exit 0
 fi
 
+echo "== dpo-fast (preference optimization: losses, data, actor/learner) ==" >&2
+# DPO loss math (hand-computed logits, beta monotonicity, stop-gradient),
+# seeded preference-pair round trips, rollout buffer/actor/learner loop,
+# AND the slow-marked DPO preemption->resume e2e (docs/preference.md) —
+# the prefs/ subsystem fails in minutes here, before everything else.
+# No 'not slow' filter: the e2e is excluded from tier-1 only to protect
+# that stage's wall-clock.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_prefs.py tests/test_preference_data.py \
+    tests/test_dpo_e2e.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+dpo_rc=$?
+if [ "$dpo_rc" -ne 0 ]; then
+    echo "ci_check: dpo-fast failed (exit $dpo_rc)" >&2
+    exit "$dpo_rc"
+fi
+
 echo "== elastic-fast (topology-portable checkpoints + resize) ==" >&2
 # manifest round-trips, cross-topology (dp=2<->dp=1) restore bit-identity,
 # resize planner/reservations/grow pass, supervisor topology handling, the

@@ -196,6 +196,14 @@ def test_submit_validation_errors(tmp_path):
         r = await client.post("/api/v1/jobs", json=bad)
         assert r.status == 400
 
+        # an UNKNOWN task value 400s naming the known tasks (ISSUE 8
+        # satellite — previously any string passed the cross-check)
+        bad = dict(SUBMIT_BODY, task="reinforcement")
+        r = await client.post("/api/v1/jobs", json=bad)
+        assert r.status == 400
+        detail = (await r.json())["detail"]
+        assert "known tasks" in detail and "dpo" in detail and "rlhf" in detail
+
         # unknown top-level field rejected, not silently defaulted — a typo'd
         # "training_arguments" must not train 100 default steps
         bad = {"model_name": "tiny-test-lora",

@@ -29,15 +29,28 @@ def test_ctl_submit_watch_metrics_logs(tmp_path, capsys):
                 "--arg", "seq_len=16", "--arg", "lora_rank=2",
                 "--arg", "warmup_steps=1",
                 "--device", "chip-1",
+                "--task", "causal_lm",  # the optional task cross-check
                 "--watch",
             ]))
             assert rc == 0
             out = capsys.readouterr().out
             job_id = json.loads(out[: out.index("}\n") + 2])["job_id"]
 
+            # an unknown --task is a 400 naming the known tasks (ISSUE 8)
+            import pytest
+
+            with pytest.raises(ctl.ApiError, match="known tasks"):
+                await ctl.amain(ctl.build_parser().parse_args([
+                    "--api", api, "submit", "tiny-test-lora",
+                    "--task", "reinforcement",
+                ]))
+
             assert await ctl.amain(ctl.build_parser().parse_args(
                 ["--api", api, "jobs"])) == 0
-            assert job_id in capsys.readouterr().out
+            jobs_out = capsys.readouterr().out
+            assert job_id in jobs_out
+            # the table carries the task-type column from the job metadata
+            assert "causal_lm" in jobs_out
 
             assert await ctl.amain(ctl.build_parser().parse_args(
                 ["--api", api, "metrics", job_id])) == 0

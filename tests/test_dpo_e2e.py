@@ -1,0 +1,150 @@
+"""DPO jobs inherit the full lifecycle unchanged (ISSUE 8 acceptance).
+
+Mirror of ``tests/test_sched_e2e.py`` with a DPO victim: a tiny-dpo-test job
+saturates a one-chip cluster, trains past its first committed checkpoint, is
+preempted by a high-priority submission (SIGTERM → trainer checkpoints →
+exit 143), lands in RETRYING via the resilience supervisor, RESUMES from its
+checkpoint, and finishes with a step-continuous, still-rising reward-margin
+trajectory.  Real subprocesses, real SIGTERMs.
+"""
+
+import asyncio
+import csv
+import re
+import time
+
+import pytest
+
+from conftest import one_chip_catalog
+from conftest import run_async as run
+
+from finetune_controller_tpu.controller import registry
+from finetune_controller_tpu.controller.backends.local import LocalProcessBackend
+from finetune_controller_tpu.controller.examples import (
+    DPOArguments,
+    LoRASFTArguments,
+    TinyDPOTest,
+    TinyTestLoRA,
+)
+from finetune_controller_tpu.controller.monitor import JobMonitor
+from finetune_controller_tpu.controller.objectstore import LocalObjectStore
+from finetune_controller_tpu.controller.schemas import DatabaseStatus, JobInput
+from finetune_controller_tpu.controller.statestore import StateStore
+from finetune_controller_tpu.controller.task_builder import (
+    DatasetInput,
+    task_builder,
+)
+from finetune_controller_tpu.resilience.policy import RetryPolicy
+from finetune_controller_tpu.resilience.supervisor import RetrySupervisor
+
+
+def _plane(tmp_path):
+    registry.reset()
+    registry.load_builtin_models()
+    root = tmp_path / "plane"
+    state = StateStore(root / "state")
+    store = LocalObjectStore(root / "objects")
+    catalog = one_chip_catalog(quota=1)
+    backend = LocalProcessBackend(
+        root / "sandboxes", store, catalog,
+        sync_interval_s=0.2, backoff_limit=0,
+        sched_queues={"batch": 1.0, "prod": 4.0},
+    )
+    supervisor = RetrySupervisor(
+        state, backend, catalog,
+        policy=RetryPolicy(max_attempts=3, base_delay_s=0.2, max_delay_s=0.5,
+                           seed=0),
+    )
+    monitor = JobMonitor(state, store, backend, interval_s=0.1,
+                         supervisor=supervisor)
+    return state, store, catalog, backend, supervisor, monitor
+
+
+@pytest.mark.slow
+def test_dpo_preemption_resumes_margin_trajectory(tmp_path):
+    async def main():
+        total, cadence = 40, 10
+        state, store, catalog, backend, sup, monitor = _plane(tmp_path)
+        await state.connect()
+
+        dpo_args = DPOArguments(
+            total_steps=total, warmup_steps=1, batch_size=2, seq_len=16,
+            lora_rank=2, learning_rate=5e-3, beta=0.2,
+            log_every=cadence, checkpoint_every=cadence,
+        )
+        await task_builder(
+            JobInput(job_id="dpo-victim", user_id="u",
+                     model_name="tiny-dpo-test", device="chip-1",
+                     arguments=dpo_args.model_dump(),
+                     queue="batch", priority="low"),
+            TinyDPOTest(training_arguments=dpo_args), DatasetInput(),
+            state=state, store=store, backend=backend, catalog=catalog,
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        # the task type rides the job document from submit on
+        assert (await state.get_job("dpo-victim")).metadata["task"] == "dpo"
+
+        victim = backend._handles["dpo-victim"]
+        ckpt_dir = victim.artifacts_dir / "checkpoints"
+        committed = re.compile(r"^step_\d+$")
+        deadline = time.monotonic() + 240
+        while not (ckpt_dir.is_dir()
+                   and any(committed.match(p.name) for p in ckpt_dir.iterdir())):
+            assert time.monotonic() < deadline, "no checkpoint within 240s"
+            await asyncio.sleep(0.1)
+
+        # high-priority SFT submission preempts the DPO job
+        sft_args = LoRASFTArguments(
+            total_steps=4, warmup_steps=1, batch_size=2, seq_len=16,
+            lora_rank=2, log_every=2, checkpoint_every=2,
+        )
+        await task_builder(
+            JobInput(job_id="urgent", user_id="u",
+                     model_name="tiny-test-lora", device="chip-1",
+                     arguments=sft_args.model_dump(),
+                     queue="prod", priority="high"),
+            TinyTestLoRA(training_arguments=sft_args), DatasetInput(),
+            state=state, store=store, backend=backend, catalog=catalog,
+            datasets_bucket="datasets", artifacts_bucket="artifacts",
+        )
+        assert backend.scheduler.preemptions_total == 1
+
+        deadline = time.monotonic() + 300
+        saw_retrying = False
+        while True:
+            await monitor.tick()
+            vrec = await state.get_job("dpo-victim")
+            saw_retrying |= vrec.status is DatabaseStatus.RETRYING
+            urec = await state.get_job("urgent")
+            if vrec.status.is_final and urec.status.is_final:
+                break
+            assert time.monotonic() < deadline, (
+                vrec.status, vrec.metadata, urec.status,
+            )
+            await asyncio.sleep(0.05)
+
+        assert urec.status is DatabaseStatus.SUCCEEDED, urec.metadata
+        assert vrec.status is DatabaseStatus.SUCCEEDED, vrec.metadata
+        assert saw_retrying
+        history = vrec.metadata["attempt_history"]
+        assert len(history) == 1 and history[0]["failure_class"] == "preemption"
+
+        # resume proof: continued, not restarted
+        log_text = (victim.sandbox / "logs.txt").read_text()
+        assert "resumed from checkpoint step" in log_text
+
+        # the reward-margin trajectory is step-continuous ACROSS the
+        # preemption and still rising at the end
+        with open(victim.artifacts_dir / "metrics.csv", newline="") as f:
+            rows = list(csv.DictReader(f))
+        steps = [int(float(r["step"])) for r in rows]
+        assert steps == list(range(cadence, total + 1, cadence)), steps
+        margins = [float(r["reward_margin"]) for r in rows]
+        assert margins[-1] > margins[0], margins
+        accs = [float(r["dpo_accuracy"]) for r in rows]
+        assert accs[-1] >= accs[0]
+
+        await backend.close()
+        await state.close()
+
+    run(main())

@@ -112,6 +112,57 @@ def test_metrics_sched_gauges(tmp_path):
     run_async(main())
 
 
+def test_metrics_dpo_gauges(tmp_path):
+    """Active dpo/rlhf jobs export their newest metrics row as ftc_dpo_*
+    gauges (reward margin + the rollout-loop health triple); SFT jobs and
+    absent columns emit nothing (docs/preference.md)."""
+    from test_api import _client, _runtime
+    from finetune_controller_tpu.controller.schemas import (
+        DatabaseStatus,
+        JobRecord,
+        MetricsDocument,
+    )
+
+    async def main():
+        rt = _runtime(tmp_path)
+        client = await _client(rt, with_monitor=False)
+        await rt.state.create_job(JobRecord(
+            job_id="dpo-1", user_id="dev-user", model_name="tiny-dpo-test",
+            status=DatabaseStatus.RUNNING, metadata={"task": "dpo"},
+        ))
+        await rt.state.create_job(JobRecord(
+            job_id="rlhf-1", user_id="dev-user", model_name="tiny-rlhf-test",
+            status=DatabaseStatus.RUNNING, metadata={"task": "rlhf"},
+        ))
+        await rt.state.create_job(JobRecord(
+            job_id="sft-1", user_id="dev-user", model_name="tiny-test-lora",
+            status=DatabaseStatus.RUNNING, metadata={"task": "causal_lm"},
+        ))
+        await rt.state.upsert_metrics(MetricsDocument(
+            job_id="dpo-1",
+            records=[{"step": 10, "reward_margin": 0.42, "dpo_accuracy": 0.9}],
+        ))
+        await rt.state.upsert_metrics(MetricsDocument(
+            job_id="rlhf-1",
+            records=[{"step": 5, "reward_margin": 0.1, "dpo_accuracy": 0.6,
+                      "rollout_buffer_depth": 12, "rollout_staleness": 5,
+                      "actor_tokens_per_sec": 133.5}],
+        ))
+        body = await (await client.get("/metrics")).text()
+        assert 'ftc_dpo_reward_margin{job_id="dpo-1"} 0.42' in body
+        assert 'ftc_dpo_accuracy{job_id="dpo-1"} 0.9' in body
+        assert 'ftc_dpo_reward_margin{job_id="rlhf-1"} 0.1' in body
+        # the rollout triple only exists for the actor/learner job
+        assert 'ftc_dpo_rollout_buffer_depth{job_id="rlhf-1"} 12' in body
+        assert 'ftc_dpo_rollout_staleness{job_id="rlhf-1"} 5' in body
+        assert 'ftc_dpo_actor_tokens_per_sec{job_id="rlhf-1"} 133.5' in body
+        assert 'ftc_dpo_rollout_buffer_depth{job_id="dpo-1"}' not in body
+        assert 'job_id="sft-1"' not in body
+        await client.close()
+
+    run_async(main())
+
+
 @pytest.mark.slow  # runs on every ci_check gate via the serve-fast stage
 def test_metrics_serve_gauges_after_generate(tmp_path):
     """The serve plane exports queue/slot/token gauges per loaded job

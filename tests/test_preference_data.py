@@ -1,0 +1,149 @@
+"""Seeded preference-pair datasets (ISSUE 8 satellite): determinism,
+prompt-masking, jsonl parsing, and prefetch bit-identity."""
+
+import json
+
+import numpy as np
+import pytest
+
+from finetune_controller_tpu.data.preference import (
+    _pad_pair,
+    load_preference_rows,
+    make_increment_pair,
+    preference_jsonl_batches,
+    synthetic_preference_batches,
+)
+
+BATCH_KEYS = {"chosen_tokens", "chosen_mask", "rejected_tokens", "rejected_mask"}
+
+
+def _take(it, n):
+    return [next(it) for _ in range(n)]
+
+
+def test_same_seed_identical_pairs():
+    a = _take(synthetic_preference_batches(4, 32, 256, seed=7), 3)
+    b = _take(synthetic_preference_batches(4, 32, 256, seed=7), 3)
+    for ba, bb in zip(a, b):
+        assert set(ba) == BATCH_KEYS
+        for k in BATCH_KEYS:
+            np.testing.assert_array_equal(ba[k], bb[k])
+    c = next(synthetic_preference_batches(4, 32, 256, seed=8))
+    assert any(
+        not np.array_equal(a[0][k], c[k]) for k in BATCH_KEYS
+    ), "different seeds produced identical batches"
+
+
+def test_masks_exclude_prompt_tokens_and_padding():
+    batch = next(synthetic_preference_batches(8, 32, 256, seed=0))
+    prompt_len = 16  # prompt_fraction=0.5 of seq 32
+    for key in ("chosen", "rejected"):
+        mask = batch[f"{key}_mask"]
+        # prompt positions never count; every row has completion targets
+        assert not mask[:, :prompt_len].any()
+        assert (mask[:, prompt_len:].sum(axis=1) > 0).all()
+    # shared prompt prefix between the two sides of each pair
+    np.testing.assert_array_equal(
+        batch["chosen_tokens"][:, :prompt_len],
+        batch["rejected_tokens"][:, :prompt_len],
+    )
+    # chosen continues the increment; rejected breaks it at the first target
+    tok = batch["chosen_tokens"]
+    assert (tok[:, prompt_len] == (tok[:, prompt_len - 1] + 1) % 256).all()
+    rej = batch["rejected_tokens"]
+    assert (rej[:, prompt_len] != (rej[:, prompt_len - 1] + 1) % 256).all()
+
+
+def test_make_increment_pair_rewards_separate():
+    rng = np.random.default_rng(0)
+    prompt, chosen, rejected = make_increment_pair(rng, 32, 256)
+    assert chosen != rejected
+    assert chosen[0] == (prompt[-1] + 1) % 256
+
+
+def test_pad_pair_truncation_keeps_full_prompt():
+    tokens, mask = _pad_pair(list(range(10)), list(range(100, 140)), 16)
+    assert tokens.shape == (16,) and mask.shape == (16,)
+    np.testing.assert_array_equal(tokens[:10], np.arange(10))
+    assert mask[:10].sum() == 0 and mask[10:].sum() == 6  # truncated completion
+    # a prompt >= seq_len leaves at least one completion slot
+    tokens, mask = _pad_pair(list(range(40)), [7, 8], 16)
+    assert mask.sum() >= 1
+
+
+def test_jsonl_rows_text_and_tokens(tmp_path):
+    path = tmp_path / "prefs.jsonl"
+    rows = [
+        {"prompt": "ab", "chosen": "cd", "rejected": "xy"},
+        {"prompt_tokens": [1, 2], "chosen_tokens": [3, 4],
+         "rejected_tokens": [9, 9]},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    loaded = load_preference_rows(str(path))
+    assert loaded[0] == ([97, 98], [99, 100], [120, 121])  # byte tokenizer
+    assert loaded[1] == ([1, 2], [3, 4], [9, 9])
+    batches = preference_jsonl_batches(str(path), batch_size=2, seq_len=8,
+                                       seed=3)
+    a, b = next(batches), next(batches)
+    assert set(a) == BATCH_KEYS and a["chosen_tokens"].shape == (2, 8)
+    # deterministic replay
+    again = preference_jsonl_batches(str(path), batch_size=2, seq_len=8,
+                                     seed=3)
+    np.testing.assert_array_equal(next(again)["chosen_tokens"],
+                                  a["chosen_tokens"])
+    np.testing.assert_array_equal(next(again)["rejected_mask"],
+                                  b["rejected_mask"])
+
+
+def test_jsonl_bad_rows_raise(tmp_path):
+    bad_schema = tmp_path / "bad.jsonl"
+    bad_schema.write_text(json.dumps({"prompt": "a", "completion": "b"}) + "\n")
+    with pytest.raises(ValueError, match="preference jsonl rows"):
+        load_preference_rows(str(bad_schema))
+    empty_side = tmp_path / "empty.jsonl"
+    empty_side.write_text(
+        json.dumps({"prompt": "a", "chosen": "", "rejected": "b"}) + "\n"
+    )
+    with pytest.raises(ValueError, match="non-empty"):
+        load_preference_rows(str(empty_side))
+    with pytest.raises(ValueError, match="no preference pairs"):
+        nothing = tmp_path / "none.jsonl"
+        nothing.write_text("\n")
+        load_preference_rows(str(nothing))
+
+
+def test_prefetch_on_off_bit_identical():
+    """The DPO batch stream rides the existing background-prefetch path
+    unchanged: same seed, prefetch on vs off, bit-identical batches."""
+    from finetune_controller_tpu.data.prefetch import PrefetchIterator
+
+    raw = _take(synthetic_preference_batches(4, 32, 256, seed=11), 6)
+    pre = PrefetchIterator(
+        synthetic_preference_batches(4, 32, 256, seed=11), depth=2
+    )
+    try:
+        fetched = _take(pre, 6)
+    finally:
+        pre.close()
+    for r, f in zip(raw, fetched):
+        for k in BATCH_KEYS:
+            np.testing.assert_array_equal(r[k], f[k])
+
+
+def test_dpo_real_dataset_without_eval_split_yields_none(tmp_path):
+    """A dpo job with a real preference dataset but no eval_path must NOT
+    silently evaluate on synthetic pairs: build_batches returns None for the
+    eval split, which run_job turns into the explicit 'no eval split' error."""
+    from finetune_controller_tpu.models.llama import PRESETS
+    from finetune_controller_tpu.train.cli import build_batches
+    from finetune_controller_tpu.train.trainer import TrainConfig
+
+    path = tmp_path / "prefs.jsonl"
+    path.write_text(json.dumps(
+        {"prompt": "ab", "chosen": "cd", "rejected": "xy"}) + "\n")
+    spec = {"dataset": {"path": str(path)}}
+    cfg = TrainConfig(task="dpo")
+    model_cfg = PRESETS["tiny-test"]
+    train = build_batches(spec, model_cfg, cfg, 2, 0, 1, split="train")
+    assert next(train)["chosen_tokens"].shape == (2, cfg.seq_len)
+    assert build_batches(spec, model_cfg, cfg, 2, 0, 1, split="eval") is None
