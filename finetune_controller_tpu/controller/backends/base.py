@@ -104,6 +104,15 @@ class TrainingBackend(abc.ABC):
         injected)."""
         return False
 
+    async def deliver_file(self, job_id: str, rel_path: str,
+                           data: bytes) -> bool:
+        """Deliver a small control file into a RUNNING job's artifacts dir —
+        the artifact channel in reverse (docs/observability.md: the
+        on-demand ``jax.profiler`` window rides this as
+        ``profile_request.json``).  Optional; backends without sandbox
+        access report False (not delivered)."""
+        return False
+
     async def close(self) -> None:
         """Release resources (subprocesses, watch tasks)."""
         return None

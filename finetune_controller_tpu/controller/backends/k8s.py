@@ -141,6 +141,15 @@ def render_jobset(
     # addition to the jax.distributed FTC_* seam — the coordinator is slice
     # 0's host 0, the slice id is the JobSet replicated-job index. Harmless
     # (and omitted) on single-slice jobs.
+    # trace propagation (docs/observability.md): every pod of every attempt
+    # stamps its spans/events/logs with the job's trace id
+    obs_env: list[dict[str, Any]] = []
+    if job.trace_id:
+        obs_env = [
+            {"name": "FTC_TRACE_ID", "value": job.trace_id},
+            {"name": "FTC_ATTEMPT", "value": str(max(1, job.attempt))},
+        ]
+
     megascale_env: list[dict[str, Any]] = []
     if max(1, job.num_slices) > 1:
         megascale_env = [
@@ -159,6 +168,7 @@ def render_jobset(
         "env": [
             {"name": "FTC_COORDINATOR_ADDRESS", "value": coordinator},
             {"name": "FTC_NUM_PROCESSES", "value": str(total_processes)},
+            *obs_env,
             *megascale_env,
             {"name": "FTC_SLICE_INDEX", "valueFrom": slice_index_ref},
             {

@@ -47,6 +47,11 @@ from .scheduler import GangScheduler
 
 logger = logging.getLogger(__name__)
 
+#: per-job trace identity (docs/observability.md) — scrubbed from warm-pool
+#: spawn env and (re)injected per claim via the request line, so a pooled
+#: worker never carries another job's trace
+_OBS_ENV_KEYS = ("FTC_TRACE_ID", "FTC_ATTEMPT")
+
 
 class _JobHandle:
     """Mutable per-job state (the backend's 'pod')."""
@@ -80,6 +85,10 @@ class _JobHandle:
         #: topology bookkeeping for elastic admission / resize re-renders
         self.requested_slices = 1
         self.granted_slices = 1
+        #: trace propagation (docs/observability.md): threaded into the
+        #: trainer env as FTC_TRACE_ID / FTC_ATTEMPT on every (re)render
+        self.trace_id = ""
+        self.attempt = 1
         self.spec_obj: BaseFineTuneJob | None = None
         self.flavor_obj: DeviceFlavor | None = None
         self.dataset_path: str | None = None
@@ -206,7 +215,10 @@ class LocalProcessBackend(TrainingBackend):
                 handle.spec_path.write_text, json.dumps(trainer_spec, indent=2)
             )
 
+            handle.trace_id = job.trace_id
+            handle.attempt = max(1, job.attempt)
             handle.env = self._runtime_env(flavor, job.num_slices)
+            handle.env.update(self._obs_env(handle))
 
             handle.queue = job.queue
             handle.priority = job.priority
@@ -274,7 +286,17 @@ class LocalProcessBackend(TrainingBackend):
             if not uri.startswith(prefix):
                 continue
             rel = uri[len(prefix):]
-            if not (rel.startswith("checkpoints/") or rel == "metrics.csv"):
+            if not (
+                rel.startswith("checkpoints/")
+                or rel == "metrics.csv"
+                # observability continuity (docs/observability.md): the
+                # trainer APPENDS to events.jsonl / trace/trainer.jsonl, and
+                # the monitor's ingest watermark is the line index — a fresh
+                # sandbox must carry the prior attempts' lines or the synced
+                # file would shrink under the watermark
+                or rel == "events.jsonl"
+                or rel.startswith("trace/")
+            ):
                 continue
             dest = handle.artifacts_dir / rel
             try:
@@ -315,6 +337,18 @@ class LocalProcessBackend(TrainingBackend):
             env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
         return env
 
+    @staticmethod
+    def _obs_env(handle: _JobHandle) -> dict[str, str]:
+        """Trace-propagation env (docs/observability.md): the trainer stamps
+        every span/event/log line with the job's trace id and this dispatch's
+        attempt number."""
+        if not handle.trace_id:
+            return {}
+        return {
+            "FTC_TRACE_ID": handle.trace_id,
+            "FTC_ATTEMPT": str(handle.attempt),
+        }
+
     # ------------------------------------------------------- warm worker pool
 
     def _env_key(self, env: dict[str, str]) -> tuple:
@@ -350,7 +384,10 @@ class LocalProcessBackend(TrainingBackend):
         # pre-claim output (JAX import warnings) goes to a pool log, not any
         # job's log; after the claim the worker re-points itself at the job
         pool_log = await asyncio.to_thread(open, self.root / "warm_workers.log", "ab")
-        env = dict(env)
+        # the pool is replenished with the finished job's env — that job's
+        # trace identity must not ride into whatever job claims this worker
+        # next (each claim injects its own via the request line)
+        env = {k: v for k, v in env.items() if k not in _OBS_ENV_KEYS}
         ready_path = self.root / f".warm_ready_{time.time_ns()}"
         env["FTC_WARM_READY_FILE"] = str(ready_path)
         try:
@@ -498,6 +535,7 @@ class LocalProcessBackend(TrainingBackend):
         )
         handle.spec_path.write_text(json.dumps(trainer_spec, indent=2))
         handle.env = self._runtime_env(flavor, num_slices)
+        handle.env.update(self._obs_env(handle))
         handle.granted_slices = num_slices
         handle.event(
             "ElasticAdmission",
@@ -638,11 +676,14 @@ class LocalProcessBackend(TrainingBackend):
         proc = self._claim_warm(handle.env)
         if proc is not None:
             # warm start: the worker already paid JAX import + backend init;
-            # hand it the spec and let it re-point its output at the job log
+            # hand it the spec and let it re-point its output at the job log.
+            # The obs env rides the request — a pooled process was spawned
+            # before this job existed and cannot inherit its trace identity
             request = json.dumps({
                 "spec": str(handle.spec_path),
                 "log": str(handle.logs_path),
                 "cwd": str(handle.sandbox),
+                "env": self._obs_env(handle),
             })
             try:
                 proc.stdin.write(request.encode() + b"\n")
@@ -866,6 +907,28 @@ class LocalProcessBackend(TrainingBackend):
         handle.event("FaultInjected", f"signal {signum}")
         with contextlib.suppress(ProcessLookupError):
             handle.proc.send_signal(signum)
+        return True
+
+    async def deliver_file(self, job_id: str, rel_path: str,
+                           data: bytes) -> bool:
+        """Artifact channel, reverse direction (docs/observability.md): drop
+        a control file into the job's artifacts dir — atomically, so the
+        trainer polling for it never reads a torn payload."""
+        handle = self._handles.get(job_id)
+        if handle is None:
+            return False
+        dest = (handle.artifacts_dir / rel_path).resolve()
+        if handle.artifacts_dir.resolve() not in dest.parents:
+            raise BackendError(f"refusing delivery outside the sandbox: {rel_path!r}")
+
+        def write() -> None:
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            tmp = dest.with_name(dest.name + ".tmp")
+            tmp.write_bytes(data)
+            os.replace(tmp, dest)
+
+        await asyncio.to_thread(write)
+        handle.event("FileDelivered", rel_path)
         return True
 
     # ------------------------------------------------------------------- logs

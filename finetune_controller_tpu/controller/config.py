@@ -83,6 +83,11 @@ class Settings(BaseModel):
     # --- Monitor / sync cadence (reference: app/core/config.py:50-52) ---
     job_monitor_interval_s: float = 2.0
     artifact_sync_interval_s: float = 60.0
+    #: the standalone monitor daemon's /metrics listener port
+    #: (docs/observability.md: ftc_build_info / ftc_uptime_seconds for BOTH
+    #: control-plane processes); 0 = no listener (in-process monitors are
+    #: already covered by the API server's /metrics)
+    monitor_metrics_port: int = 0
     #: pre-warmed trainer processes per platform env on the local backend —
     #: they pay JAX import + backend init before a job arrives, collapsing
     #: the submit -> first-training-step latency (0 = off)

@@ -16,6 +16,8 @@ Commands:
     status JOB_ID [--watch]             one job (``--watch`` polls to final)
     logs JOB_ID [--follow]              job logs (REST; --follow re-polls)
     metrics JOB_ID                      metrics rows (latest last)
+    timeline JOB_ID                     lifecycle waterfall: where time went
+    profile JOB_ID [--steps N]          arm a jax.profiler window on a live job
     artifacts JOB_ID [-o out.zip]       artifact inventory (or zip download)
     promote JOB_ID / unpromote JOB_ID
     cancel JOB_ID
@@ -303,6 +305,32 @@ async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:
     return 0
 
 
+def _fmt_attrs(attrs: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+async def cmd_timeline(client: Client, ns: argparse.Namespace) -> int:
+    """Waterfall of where the job's time went (docs/observability.md):
+    each lifecycle event with its offset from submit and the gap to the
+    NEXT event — the gap column is the phase duration."""
+    body = await client.get(f"/jobs/{ns.job_id}/timeline")
+    events = body.get("events") or []
+    if not events:
+        print(f"no timeline events for {ns.job_id} "
+              f"(pre-observability job?)")
+        return 0
+    trace = (body.get("trace_id") or "")[:8]
+    print(f"{ns.job_id}  trace={trace or '-'}  status={body.get('status')}")
+    t0 = events[0]["ts"]
+    for i, e in enumerate(events):
+        offset = e["ts"] - t0
+        gap = (events[i + 1]["ts"] - e["ts"]) if i + 1 < len(events) else None
+        gap_s = f"{gap:>9.2f}s" if gap is not None else " " * 10
+        print(f"{offset:>9.2f}s  {gap_s}  {e['event']:<22} "
+              f"{_fmt_attrs(e.get('attrs') or {})}")
+    return 0
+
+
 async def cmd_generate(client: Client, ns: argparse.Namespace) -> int:
     """Hit the serving endpoint of a promoted job: token ids in, tokens out
     (docs/serving.md; the server refuses non-COMPLETED promotions)."""
@@ -357,10 +385,19 @@ async def amain(ns: argparse.Namespace) -> int:
             return await cmd_logs(client, ns)
         if ns.cmd == "metrics":
             return await cmd_metrics(client, ns)
+        if ns.cmd == "timeline":
+            return await cmd_timeline(client, ns)
         if ns.cmd == "artifacts":
             return await cmd_artifacts(client, ns)
         if ns.cmd in ("promote", "unpromote", "cancel"):
             _print_json(await client.post(f"/jobs/{ns.job_id}/{ns.cmd}"))
+            return 0
+        if ns.cmd == "profile":
+            # arm an on-demand jax.profiler window on a LIVE job
+            # (docs/observability.md §On-demand profiler window)
+            _print_json(await client.post(
+                f"/jobs/{ns.job_id}/profile", json={"steps": ns.steps}
+            ))
             return 0
         if ns.cmd == "generate":
             return await cmd_generate(client, ns)
@@ -398,8 +435,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--page", type=int, default=1)
     sub.add_parser("queue")
     sub.add_parser("serve")
-    for name in ("status", "logs", "metrics", "artifacts", "promote",
-                 "unpromote", "cancel"):
+    for name in ("status", "logs", "metrics", "timeline", "artifacts",
+                 "promote", "unpromote", "cancel"):
         s = sub.add_parser(name)
         s.add_argument("job_id")
         if name == "status":
@@ -410,6 +447,11 @@ def build_parser() -> argparse.ArgumentParser:
             s.add_argument("--output", "-o",
                            help="download the artifact zip to this path "
                                 "(default: list the inventory)")
+    s = sub.add_parser("profile")
+    s.add_argument("job_id")
+    s.add_argument("--steps", type=int, default=5,
+                   help="jax.profiler window length in steps "
+                        "(docs/observability.md; trace lands in profile/)")
     s = sub.add_parser("generate")
     s.add_argument("job_id")
     s.add_argument("--tokens", required=True,

@@ -266,6 +266,17 @@ class GCSObjectStore(HttpObjectStore):
         # on this answer (exact-key vs prefix semantics)
         raise IOError(f"GCS head failed ({status}) for {uri}")
 
+    async def size(self, uri: str) -> int | None:
+        status, body = await self._call("GET", self._object_url(uri, media=False))
+        if status == 404:
+            raise FileNotFoundError(uri)
+        if status >= 300:
+            raise IOError(f"GCS head failed ({status}) for {uri}")
+        try:
+            return int(json.loads(body).get("size"))
+        except (ValueError, TypeError):
+            return None
+
     async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
         bucket, key = parse_uri(prefix_uri)
         base = f"{self.endpoint}/storage/v1/b/{self._gcs_bucket(bucket)}/o"

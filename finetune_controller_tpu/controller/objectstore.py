@@ -78,6 +78,14 @@ class ObjectStore:
     async def exists(self, uri: str) -> bool:
         raise NotImplementedError
 
+    async def size(self, uri: str) -> int | None:
+        """Byte size of an object via a cheap stat (os.stat / HEAD), or
+        ``None`` when the backend has no such operation — callers must then
+        fall back to reading.  Raises ``FileNotFoundError`` for a missing
+        object (the ``get_bytes`` convention), so pollers can distinguish
+        "not there yet" from "can't stat"."""
+        return None
+
     async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
         """Return [{"uri", "size", "mtime"}] under a prefix."""
         raise NotImplementedError
@@ -352,6 +360,9 @@ class LocalObjectStore(ObjectStore):
 
     async def exists(self, uri: str) -> bool:
         return await asyncio.to_thread(self.path_for(uri).exists)
+
+    async def size(self, uri: str) -> int | None:
+        return (await asyncio.to_thread(self.path_for(uri).stat)).st_size
 
     async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
         bucket, key = parse_uri(prefix_uri)

@@ -12,11 +12,20 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..obs import events as obs_events
+from ..obs.events import make_event
 from .objectstore import ObjectStore, build_uri
 from .schemas import PromotionStatus
 from .statestore import StateStore
 
 logger = logging.getLogger(__name__)
+
+#: settled promotion state → timeline event (docs/observability.md)
+_SETTLE_EVENTS = {
+    PromotionStatus.COMPLETED: obs_events.PROMOTED,
+    PromotionStatus.FAILED: obs_events.PROMOTION_FAILED,
+    PromotionStatus.NOT_PROMOTED: obs_events.UNPROMOTED,
+}
 
 
 def promotion_destination(deploy_bucket: str, promotion_path: str, job_id: str) -> str:
@@ -47,6 +56,18 @@ class PromotionTask:
                 "promotion state for %s moved concurrently (expected %s); "
                 "leaving the newer transition in place", job_id, expect.value,
             )
+            return
+        event = _SETTLE_EVENTS.get(to)
+        if event is not None:
+            # timeline (docs/observability.md): only the task whose CAS won
+            # records the outcome — a stale task's event would lie
+            try:
+                await self.state.append_job_event(
+                    job_id, make_event(event, destination=uri)
+                )
+            except Exception:
+                logger.debug("timeline append (%s) failed for %s", event,
+                             job_id, exc_info=True)
 
     async def promote_job_task(
         self, job_id: str, artifacts_uri: str, destination_uri: str

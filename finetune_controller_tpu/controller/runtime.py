@@ -13,6 +13,7 @@ import dataclasses
 import logging
 from typing import Any
 
+from ..obs.prom import ObsHub
 from ..resilience.heartbeat import LeaseChecker
 from ..resilience.policy import RetryPolicy
 from ..resilience.supervisor import RetrySupervisor
@@ -42,6 +43,9 @@ class Runtime:
     #: inference sessions over promoted checkpoints (serve/service.py);
     #: lazily populated — nothing loads until a generate/load request
     serve: Any = None
+    #: the process's observability hub (obs/prom.py): latency histograms +
+    #: build info/uptime, rendered by /metrics (docs/observability.md)
+    obs: Any = None
 
     async def start(self, *, with_monitor: bool | None = None) -> None:
         await self.state.connect()
@@ -119,6 +123,9 @@ def build_runtime(
     # closes the failure loop the reference leaves to operators, the lease
     # checker catches silently-stuck jobs. Either can be disabled via
     # settings (reference-parity behavior).
+    # one observability hub per process (docs/observability.md): the monitor,
+    # supervisor and serve batchers observe into it; /metrics renders it
+    obs = ObsHub()
     supervisor = None
     if settings.retry_max_attempts > 0:
         supervisor = RetrySupervisor(
@@ -128,6 +135,7 @@ def build_runtime(
                 base_delay_s=settings.retry_base_delay_s,
                 max_delay_s=settings.retry_max_delay_s,
             ),
+            obs=obs,
         )
     lease = None
     if settings.liveness_lease_s > 0:
@@ -143,7 +151,7 @@ def build_runtime(
     monitor = JobMonitor(
         state, store, backend,
         interval_s=settings.job_monitor_interval_s,
-        supervisor=supervisor, lease=lease,
+        supervisor=supervisor, lease=lease, obs=obs,
     )
     presigner = Presigner(settings.presign_secret, settings.presign_expiry_s)
     from ..serve.service import ServeManager
@@ -156,5 +164,6 @@ def build_runtime(
         backend=backend,
         monitor=monitor,
         presigner=presigner,
-        serve=ServeManager(state, store, settings),
+        serve=ServeManager(state, store, settings, obs=obs),
+        obs=obs,
     )

@@ -373,6 +373,15 @@ class S3ObjectStore(HttpObjectStore):
         # copy_prefix branches on this answer (exact-key vs prefix semantics)
         raise IOError(f"S3 head failed ({status}) for {uri}")
 
+    async def size(self, uri: str) -> int | None:
+        status, _, headers = await self._call("HEAD", self._path(uri))
+        if status == 404:
+            raise FileNotFoundError(uri)
+        if status >= 300:
+            raise IOError(f"S3 head failed ({status}) for {uri}")
+        length = headers.get("Content-Length")
+        return int(length) if length is not None else None
+
     async def list_prefix(self, prefix_uri: str) -> list[dict[str, Any]]:
         bucket, key = parse_uri(prefix_uri)
         path = f"/{self.bucket_prefix}{bucket}"

@@ -153,6 +153,11 @@ class JobRecord(BaseModel):
     end_time: float | None = None
     training_duration: float | None = None
     metadata: dict[str, Any] = Field(default_factory=dict)
+    #: the lifecycle event timeline (docs/observability.md): appended by
+    #: every plane via ``StateStore.append_job_event`` (exactly-once via
+    #: idempotency keys), served by ``GET /jobs/{id}/timeline`` and the
+    #: trace assembly (``obs/trace.py``)
+    events: list[dict[str, Any]] = Field(default_factory=list)
 
 
 class DatasetRecord(BaseModel):
@@ -205,6 +210,14 @@ class JobInput(BaseModel):
     #: the job toward this when chips free (docs/elasticity.md).  None on a
     #: fresh submission (= num_slices).
     requested_num_slices: int | None = None
+    #: observability (docs/observability.md): the job's trace id — minted at
+    #: first submit by ``task_builder``, carried by the job metadata, and
+    #: re-supplied on supervisor resubmissions so every attempt shares one
+    #: trace; backends thread it into the trainer env as ``FTC_TRACE_ID``
+    trace_id: str = ""
+    #: 1-based attempt number of THIS dispatch (``FTC_ATTEMPT`` in the
+    #: trainer env; log streams and trainer events are attributed by it)
+    attempt: int = 1
 
 
 class PaginatedTableResponse(BaseModel):

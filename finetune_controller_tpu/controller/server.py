@@ -25,6 +25,16 @@ from urllib.parse import urlencode
 from aiohttp import web
 from pydantic import ValidationError
 
+from ..obs import events as obs_events
+from ..obs.events import append_event_safe, make_event
+from ..obs.prom import ObsHub, escape_label
+from ..obs.trace import (
+    TRACE_DIRNAME,
+    TRAINER_SPANS_FILENAME,
+    build_trace,
+    export_trace,
+    parse_span_lines,
+)
 from ..sched.queues import parse_priority
 from . import registry
 from .config import Settings
@@ -48,6 +58,9 @@ RUNTIME_KEY = web.AppKey("runtime", Runtime)
 PROMOTION_KEY = web.AppKey("promotion", PromotionTask)
 LIMITER_KEY = web.AppKey("limiter", object)
 BG_TASKS_KEY = web.AppKey("bg_tasks", set)
+#: which process is serving /metrics — "server" here, "monitor" when the
+#: standalone monitor daemon mounts the same handler (monitor_main.py)
+PROCESS_KEY = web.AppKey("process_name", str)
 
 
 # ---------------------------------------------------------------------------
@@ -537,6 +550,92 @@ async def download(request: web.Request) -> web.Response:
 
 
 # ---------------------------------------------------------------------------
+# Handlers — observability (docs/observability.md)
+# ---------------------------------------------------------------------------
+
+
+async def _append_event(rt: Runtime, job_id: str, event: str,
+                        key: str | None = None, **attrs: Any) -> None:
+    """Best-effort timeline append from a request handler."""
+    await append_event_safe(rt.state, job_id, event, key=key, **attrs)
+
+
+async def get_job_timeline(request: web.Request) -> web.Response:
+    """The job's lifecycle event timeline, oldest first — the data behind
+    ``ftc-ctl timeline`` (docs/observability.md §Timeline)."""
+    job = await _owned_job(request, request.match_info["job_id"])
+    events = sorted(job.events, key=lambda e: e.get("ts") or 0)
+    return web.json_response(
+        {
+            "job_id": job.job_id,
+            "trace_id": (job.metadata or {}).get("trace_id"),
+            "status": job.status.value,
+            "events": events,
+        }
+    )
+
+
+async def get_job_trace(request: web.Request) -> web.Response:
+    """The assembled span tree (controller phases derived from the timeline
+    + trainer spans from the artifact channel), OTel-compatible dicts."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    trainer_spans: list[dict[str, Any]] = []
+    if job.artifacts_uri:
+        uri = f"{job.artifacts_uri}/{TRACE_DIRNAME}/{TRAINER_SPANS_FILENAME}"
+        try:
+            if await rt.store.exists(uri):
+                trainer_spans = parse_span_lines(await rt.store.get_bytes(uri))
+        except Exception:
+            logger.debug("trainer span read failed for %s", job.job_id,
+                         exc_info=True)
+    return web.json_response(
+        build_trace(job.model_dump(mode="json"), trainer_spans)
+    )
+
+
+async def request_job_profile(request: web.Request) -> web.Response:
+    """Arm an on-demand ``jax.profiler`` trace window on a LIVE job — no
+    restart: the request rides the artifact channel in reverse
+    (``backend.deliver_file`` → ``profile_request.json`` → the trainer's
+    fit loop polls for it at the preemption-sync cadence and captures N
+    steps into ``profile/``, shipped with the artifacts).  The poll is
+    independent of the tracing kill switch (a ``FTC_TRACE=0`` job still
+    profiles); only ``FTC_PROFILE=0`` in the trainer env opts out, in which
+    case the delivered request is never consumed."""
+    rt = request.app[RUNTIME_KEY]
+    job = await _owned_job(request, request.match_info["job_id"])
+    if job.status is not DatabaseStatus.RUNNING:
+        return _json_error(
+            409, f"job is {job.status.value}; profiling needs a running job"
+        )
+    body = await _json_body(request) if request.can_read_body else {}
+    steps = body.get("steps", 5)
+    if not isinstance(steps, int) or not 1 <= steps <= 1000:
+        return _json_error(400, "steps must be an integer in [1, 1000]")
+    payload = json.dumps(
+        {"steps": steps, "requested_at": time.time()}
+    ).encode()
+    delivered = await rt.backend.deliver_file(
+        job.job_id, "profile_request.json", payload
+    )
+    if not delivered:
+        return _json_error(
+            501, "this backend cannot deliver control files to running jobs"
+        )
+    await _append_event(
+        rt, job.job_id, obs_events.PROFILE_REQUESTED, steps=steps,
+    )
+    return web.json_response(
+        {
+            "message": f"profiler window armed for {steps} steps",
+            "artifact": "profile/ (fetch via GET /jobs/{id}/artifacts?list=1)",
+        },
+        status=202,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Handlers — lifecycle mutations
 # ---------------------------------------------------------------------------
 
@@ -580,6 +679,9 @@ async def promote_job(request: web.Request) -> web.Response:
         return web.json_response(
             {"detail": "promotion already in progress"}, status=202
         )
+    await _append_event(
+        rt, job.job_id, obs_events.PROMOTION_STARTED, destination=destination
+    )
     _spawn_bg(
         request.app,
         promo.promote_job_task(job.job_id, job.artifacts_uri, destination),
@@ -620,9 +722,17 @@ async def cancel_job(request: web.Request) -> web.Response:
     if job.status.is_final:
         return _json_error(400, f"job already {job.status.value}")
     await rt.backend.delete_job(job.job_id)
+    # fixed key: two racing cancel requests must fold into ONE timeline
+    # event, or the second lands outside every span and poisons the
+    # exported trace's gap-free verdict
+    await _append_event(rt, job.job_id, obs_events.CANCELLED, key="cancelled")
     await rt.state.update_job_status(
         job.job_id, DatabaseStatus.CANCELLED, end_time=time.time(), queue_position=None
     )
+    # the backend half is gone, so the monitor's report loop may never see
+    # this job again — export the trace here (docs/observability.md promises
+    # an export for EVERY terminal state, cancels included)
+    _spawn_bg(request.app, export_trace(rt.state, rt.store, job.job_id))
     return web.json_response({"message": "job cancelled", "job_id": job.job_id})
 
 
@@ -864,6 +974,43 @@ async def admin_resilience(request: web.Request) -> web.Response:
         body["pending_retries"] = await supervisor.pending_retries()
     if lease is not None:
         body["lease_s"] = lease.lease_s
+    # per-job progress (docs/observability.md): each RUNNING job's newest
+    # heartbeat now carries last_step/last_step_ms — rate, not just liveness
+    from ..resilience.heartbeat import HEARTBEAT_FILENAME, parse_heartbeat
+
+    async def _job_progress(job) -> dict[str, Any] | None:
+        uri = f"{job.artifacts_uri}/{HEARTBEAT_FILENAME}"
+        try:
+            if not await rt.store.exists(uri):
+                return None
+            hb = parse_heartbeat(await rt.store.get_bytes(uri))
+        except Exception:
+            logger.debug("heartbeat read failed for %s", job.job_id,
+                         exc_info=True)
+            return None
+        if hb is None:
+            return None
+        step_ms = hb.get("last_step_ms")
+        return {
+            "job_id": job.job_id,
+            "last_step": hb.get("last_step", hb.get("step")),
+            "last_step_ms": step_ms,
+            "steps_per_min": (
+                round(60000.0 / step_ms, 2) if step_ms else None
+            ),
+            "heartbeat_age_s": round(max(time.time() - hb["ts"], 0.0), 1),
+        }
+
+    # the per-job reads are independent remote round-trips — run them
+    # concurrently so the endpoint costs the slowest read, not the sum
+    running = [
+        job for job in await rt.state.get_jobs_by_status(DatabaseStatus.RUNNING)
+        if job.artifacts_uri
+    ]
+    body["progress"] = [
+        p for p in await asyncio.gather(*(_job_progress(j) for j in running))
+        if p is not None
+    ]
     return web.json_response(body)
 
 
@@ -897,16 +1044,10 @@ async def mint_dev_token(request: web.Request) -> web.Response:
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def prom_escape(value: str) -> str:
-    """Escape a label VALUE per the exposition format: backslash, double
-    quote, and newline must be escaped or a hostile job_id/flavor name breaks
-    the whole scrape."""
-    return (
-        str(value)
-        .replace("\\", "\\\\")
-        .replace('"', '\\"')
-        .replace("\n", "\\n")
-    )
+# one escaping implementation for the whole /metrics payload: a rule added
+# to one copy but not another would render the same label value differently
+# between the gauge and histogram sections, forking series identity
+prom_escape = escape_label
 
 
 async def prometheus_metrics(request: web.Request) -> web.Response:
@@ -1040,6 +1181,18 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
             if samples:
                 lines.append(f"# TYPE {metric} gauge")
                 lines.extend(samples)
+    # observability layer (docs/observability.md): latency histograms (step
+    # phases, queue wait, retry latency, serve TTFT) + process identity
+    obs = getattr(rt, "obs", None)
+    if obs is not None:
+        from .. import __version__
+
+        lines.extend(obs.render())
+        lines.extend(obs.render_process_info(
+            process=request.app.get(PROCESS_KEY) or "server",
+            version=__version__,
+            backend=rt.settings.backend,
+        ))
     return web.Response(
         body=("\n".join(lines) + "\n").encode("utf-8"),
         headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
@@ -1139,12 +1292,27 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
         },
     )
     app[BG_TASKS_KEY] = set()
+    app[PROCESS_KEY] = "server"
+    # observability hub (docs/observability.md): runtimes assembled outside
+    # build_runtime (tests) get one here, and components constructed without
+    # one adopt it so their observations reach /metrics
+    if getattr(runtime, "obs", None) is None:
+        runtime.obs = ObsHub()
+    if runtime.monitor is not None and getattr(runtime.monitor, "obs", None) is None:
+        runtime.monitor.obs = runtime.obs
+    supervisor = getattr(runtime.monitor, "supervisor", None)
+    if supervisor is not None and getattr(supervisor, "obs", None) is None:
+        supervisor.obs = runtime.obs
     # inference over promoted checkpoints (serve/service.py); runtimes built
     # outside build_runtime (tests) get a manager here so the routes work
     from ..serve.service import SERVE_KEY, ServeManager, add_serve_routes
 
     if runtime.serve is None:
-        runtime.serve = ServeManager(runtime.state, runtime.store, settings)
+        runtime.serve = ServeManager(
+            runtime.state, runtime.store, settings, obs=runtime.obs
+        )
+    elif getattr(runtime.serve, "obs", None) is None:
+        runtime.serve.obs = runtime.obs
     app[SERVE_KEY] = runtime.serve
 
     p = settings.api_prefix
@@ -1155,6 +1323,9 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
     app.router.add_get(f"{p}/jobs", get_jobs_page)
     app.router.add_get(f"{p}/jobs/{{job_id}}", get_job)
     app.router.add_get(f"{p}/jobs/{{job_id}}/metrics", get_job_metrics)
+    app.router.add_get(f"{p}/jobs/{{job_id}}/timeline", get_job_timeline)
+    app.router.add_get(f"{p}/jobs/{{job_id}}/trace", get_job_trace)
+    app.router.add_post(f"{p}/jobs/{{job_id}}/profile", request_job_profile)
     app.router.add_get(f"{p}/jobs/{{job_id}}/artifacts", get_job_artifacts)
     app.router.add_get(f"{p}/jobs/{{job_id}}/logs", get_job_logs)
     app.router.add_post(f"{p}/jobs/{{job_id}}/promote", promote_job)

@@ -113,6 +113,9 @@ class BaseFineTuneJob(BaseModel):
     store_asset_patterns: ClassVar[list[str]] = [
         "*.csv", "*.json", "checkpoints/**/*", "profile/**/*",
         "adapter/**/*", "merged/**/*", "done.txt",
+        # observability (docs/observability.md): the trainer's lifecycle
+        # events + spans ride the artifact channel like heartbeat.json
+        "events.jsonl", "trace/**/*",
     ]
     #: deploy-bucket prefix used on promotion (reference: ``finetuning.py:75-78``)
     promotion_path: ClassVar[str] = "models"

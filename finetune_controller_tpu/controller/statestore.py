@@ -200,6 +200,64 @@ class Collection:
             await asyncio.to_thread(self._append, doc)
             return True
 
+    async def append_to_list(
+        self,
+        key: str,
+        field: str,
+        item: dict[str, Any],
+        dedupe_key: str | None = None,
+    ) -> bool:
+        """Atomic append to a list field.  ``dedupe_key`` makes the append
+        idempotent: when an existing element carries the same ``"key"`` the
+        append is skipped (False) — the exactly-once handle the job event
+        timeline rides (docs/observability.md)."""
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = self._docs.get(key)
+            if doc is None:
+                return False
+            items = list(doc.get(field) or [])
+            if dedupe_key is not None and any(
+                isinstance(e, dict) and e.get("key") == dedupe_key
+                for e in items
+            ):
+                return False
+            items.append(item)
+            doc[field] = items
+            await asyncio.to_thread(self._append, doc)
+            return True
+
+    async def extend_list(
+        self, key: str, field: str, new_items: list[dict[str, Any]]
+    ) -> int:
+        """Batch append: every item is deduped on its ``"key"`` (against the
+        stored list AND within the batch), all survivors land in ONE write —
+        the trainer-event ingest's per-event-RMW fix.  Returns the number
+        appended (0 when the doc is gone or everything was a duplicate)."""
+        async with self._lock:
+            await asyncio.to_thread(self._load)
+            doc = self._docs.get(key)
+            if doc is None:
+                return 0
+            items = list(doc.get(field) or [])
+            seen = {
+                e.get("key") for e in items
+                if isinstance(e, dict) and e.get("key") is not None
+            }
+            added = 0
+            for item in new_items:
+                k = item.get("key")
+                if k is not None and k in seen:
+                    continue
+                if k is not None:
+                    seen.add(k)
+                items.append(item)
+                added += 1
+            if added:
+                doc[field] = items
+                await asyncio.to_thread(self._append, doc)
+            return added
+
     async def delete(self, key: str) -> dict[str, Any] | None:
         async with self._lock:
             await asyncio.to_thread(self._load)
@@ -405,6 +463,57 @@ class SqliteCollection:
             return {**doc, field: sub}
 
         return await asyncio.to_thread(self._rmw, key, mutate)
+
+    async def append_to_list(
+        self,
+        key: str,
+        field: str,
+        item: dict[str, Any],
+        dedupe_key: str | None = None,
+    ) -> bool:
+        """Jsonl-engine parity: transactional list append with idempotency
+        (the read and the deduped write share one ``BEGIN IMMEDIATE``)."""
+
+        def mutate(doc: dict[str, Any]) -> dict[str, Any] | None:
+            items = list(doc.get(field) or [])
+            if dedupe_key is not None and any(
+                isinstance(e, dict) and e.get("key") == dedupe_key
+                for e in items
+            ):
+                return None
+            return {**doc, field: items + [item]}
+
+        return await asyncio.to_thread(self._rmw, key, mutate)
+
+    async def extend_list(
+        self, key: str, field: str, new_items: list[dict[str, Any]]
+    ) -> int:
+        """Jsonl-engine parity: batch list append, per-item ``"key"`` dedupe,
+        one transaction.  Returns the number appended."""
+        added = 0
+
+        def mutate(doc: dict[str, Any]) -> dict[str, Any] | None:
+            nonlocal added
+            added = 0
+            items = list(doc.get(field) or [])
+            seen = {
+                e.get("key") for e in items
+                if isinstance(e, dict) and e.get("key") is not None
+            }
+            for item in new_items:
+                k = item.get("key")
+                if k is not None and k in seen:
+                    continue
+                if k is not None:
+                    seen.add(k)
+                items.append(item)
+                added += 1
+            if not added:
+                return None
+            return {**doc, field: items}
+
+        await asyncio.to_thread(self._rmw, key, mutate)
+        return added
 
     async def delete(self, key: str) -> dict[str, Any] | None:
         def op(conn: sqlite3.Connection) -> dict[str, Any] | None:
@@ -723,6 +832,35 @@ class StateStore:
 
     async def update_job_fields(self, job_id: str, **fields: Any) -> bool:
         return await self.jobs.update(job_id, _jsonify(fields))
+
+    async def append_job_event(self, job_id: str, event: dict[str, Any]) -> bool:
+        """Append one lifecycle event to the job's timeline
+        (docs/observability.md).  Idempotent on ``event["key"]`` — an
+        emitter that retries after a crash converges to exactly one event
+        per transition instance.  False when the job is gone or the key was
+        already recorded."""
+        return await self.jobs.append_to_list(
+            job_id, "events", event, dedupe_key=event.get("key")
+        )
+
+    async def append_job_events(
+        self, job_id: str, events: list[dict[str, Any]]
+    ) -> int:
+        """Batch timeline append — same idempotency per event ``key``, ONE
+        document write for the whole batch (the monitor's trainer-event
+        ingest folds every new ``events.jsonl`` row per tick through this,
+        instead of a doc-rewriting RMW per event).  Returns the number of
+        events actually appended."""
+        if not events:
+            return 0
+        return await self.jobs.extend_list(job_id, "events", events)
+
+    async def merge_job_metadata(self, job_id: str, patch: dict[str, Any]) -> bool:
+        """Metadata-only merge WITHOUT touching the status field — for
+        bookkeeping writers (the monitor's trainer-event ingest watermark)
+        that must never race a concurrent status transition back to a stale
+        value."""
+        return await self.jobs.merge_subdoc(job_id, "metadata", _jsonify(patch))
 
     async def find_jobs_with_promotion_in(
         self, states: list[PromotionStatus | str]

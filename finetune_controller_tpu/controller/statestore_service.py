@@ -122,6 +122,21 @@ async def _update_job_fields(store, p):
     return await store.update_job_fields(p["job_id"], **(p.get("fields") or {}))
 
 
+@_rpc("append_job_event")
+async def _append_job_event(store, p):
+    return await store.append_job_event(p["job_id"], p["event"])
+
+
+@_rpc("append_job_events")
+async def _append_job_events(store, p):
+    return await store.append_job_events(p["job_id"], p.get("events") or [])
+
+
+@_rpc("merge_job_metadata")
+async def _merge_job_metadata(store, p):
+    return await store.merge_job_metadata(p["job_id"], p.get("patch") or {})
+
+
 @_rpc("find_jobs_with_promotion_in")
 async def _find_jobs_with_promotion_in(store, p):
     return [_dump(j) for j in await store.find_jobs_with_promotion_in(p["states"])]
@@ -410,6 +425,21 @@ class RemoteStateStore:
         return await self._call(
             "update_job_fields", job_id=job_id, fields=fields
         )
+
+    async def append_job_event(self, job_id: str, event: dict[str, Any]) -> bool:
+        return await self._call("append_job_event", job_id=job_id, event=event)
+
+    async def append_job_events(
+        self, job_id: str, events: list[dict[str, Any]]
+    ) -> int:
+        if not events:
+            return 0
+        return await self._call(
+            "append_job_events", job_id=job_id, events=events
+        )
+
+    async def merge_job_metadata(self, job_id: str, patch: dict[str, Any]) -> bool:
+        return await self._call("merge_job_metadata", job_id=job_id, patch=patch)
 
     async def find_jobs_with_promotion_in(self, states) -> list[JobRecord]:
         from .schemas import PromotionStatus

@@ -59,6 +59,14 @@ class LogStreamManager:
         self.start_poll_s = start_poll_s
         self.chunk_lines = chunk_lines
         self._gate_open = not search_string
+        #: per-line attribution prefix (docs/observability.md): the job's
+        #: trace id (short form) + attempt number, so a multi-attempt stream
+        #: — retries append to the same log file — stays attributable.  The
+        #: attempt number moves while a follow stream is attached (the
+        #: supervisor resubmits into the same log), so the prefix is
+        #: re-resolved from the DB on a poll cadence, not frozen at start
+        self._prefix = ""
+        self._prefix_at = 0.0  # monotonic time of the last prefix resolve
 
     # -- helpers -------------------------------------------------------------
 
@@ -76,13 +84,41 @@ class LogStreamManager:
 
     def _filter(self, line: str) -> str | None:
         """Search-string gate (reference: ``stream_logger.py:404-433``):
-        swallow everything until the marker appears once, then stream all."""
+        swallow everything until the marker appears once, then stream all.
+        Passed lines gain the trace/attempt attribution prefix."""
         if self._gate_open:
-            return line
+            return self._prefix + line
         if self.search_string in line:
             self._gate_open = True
-            return line
+            return self._prefix + line
         return None
+
+    def _set_prefix(self, job) -> None:
+        self._prefix_at = time.monotonic()
+        trace = ((job.metadata or {}).get("trace_id") or "")[:8]
+        if trace:
+            attempt = 1 + len((job.metadata or {}).get("attempt_history") or [])
+            self._prefix = f"[{trace}#a{attempt}] "
+
+    async def _refresh_prefix(self) -> None:
+        """Re-resolve the attempt number mid-stream (throttled to the start
+        poll cadence): lines appended by a retry attempt must carry ITS
+        number — a frozen prefix would label every post-retry line with the
+        attempt that was live when the stream attached."""
+        if time.monotonic() - self._prefix_at < self.start_poll_s:
+            return
+        # re-arm the throttle BEFORE the lookup: a gone record (or an
+        # erroring store) must not turn every streamed line into a DB query
+        self._prefix_at = time.monotonic()
+        try:
+            job = await self.state.get_job(self.job_id)
+        except Exception:
+            # attribution must not kill a healthy stream
+            logger.debug("prefix refresh failed for %s", self.job_id,
+                         exc_info=True)
+            return
+        if job is not None:
+            self._set_prefix(job)
 
     async def _wait_for_job_start(self) -> DatabaseStatus | None:
         """Poll the DB until the job is running or terminal (reference:
@@ -99,6 +135,7 @@ class LogStreamManager:
                 DatabaseStatus.RESTARTING,
                 *DatabaseStatus.final_states(),
             ):
+                self._set_prefix(job)
                 return job.status
             pos = f" (queue position {job.queue_position})" if job.queue_position else ""
             await self._send(f"waiting: job is {job.status.value}{pos}")
@@ -130,6 +167,10 @@ class LogStreamManager:
         chunk = 1 if follow else self.chunk_lines
         try:
             async for line in lines:
+                if follow:
+                    # a retry lands well after the backoff, so the throttled
+                    # refresh settles on the new attempt before its first line
+                    await self._refresh_prefix()
                 filtered = self._filter(line)
                 if filtered is None:
                     continue

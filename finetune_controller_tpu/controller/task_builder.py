@@ -17,6 +17,8 @@ from __future__ import annotations
 import dataclasses
 import logging
 
+from ..obs.events import SUBMITTED, make_event
+from ..obs.trace import new_trace_id
 from .backends.base import TrainingBackend
 from .datasets import stream_dataset_url, upload_dataset_bytes
 from .devices import DeviceCatalog
@@ -109,6 +111,10 @@ async def task_builder(
     # job nothing tracks. Record-first closes it: a submit failure rolls the
     # record back; the monitor's lost-job sweep covers the reverse crash.
     flavor = catalog.get_worker(job.device)
+    # trace propagation (docs/observability.md): mint the trace id HERE, the
+    # job's birth — it rides the job metadata, the backend env, every
+    # supervisor resubmission, and the serve load, naming the job's whole life
+    job.trace_id = job.trace_id or new_trace_id()
     record = JobRecord(
         job_id=job.job_id,
         user_id=job.user_id,
@@ -130,7 +136,15 @@ async def task_builder(
             "queue": job.queue,
             "priority": job.priority,
             "task": spec.task.value,
+            "trace_id": job.trace_id,
         },
+        # the timeline's first event — every later span/phase hangs off it
+        events=[make_event(
+            SUBMITTED, key="submitted:1",
+            queue=job.queue, priority=str(job.priority),
+            model=job.model_name, device=flavor.name,
+            num_slices=job.num_slices, trace_id=job.trace_id,
+        )],
     )
     try:
         await state.create_job(record)

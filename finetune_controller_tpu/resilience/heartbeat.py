@@ -58,8 +58,13 @@ class HeartbeatWriter:
         self.beats = 0  # observability / tests
         self.write_failures = 0
 
-    def beat(self, step: int, *, force: bool = False) -> bool:
+    def beat(self, step: int, *, step_ms: float | None = None,
+             force: bool = False) -> bool:
         """Record liveness at ``step``; returns True when a write happened.
+
+        ``step_ms`` is the most recent step's wall time — with ``last_step``
+        it gives the monitor a per-job progress RATE, not just "alive"
+        (``GET /admin/resilience`` surfaces both).
 
         Throttled to one write per ``interval_s`` so a milliseconds-scale
         step loop doesn't turn the heartbeat into an I/O hot path.  The write
@@ -75,10 +80,15 @@ class HeartbeatWriter:
             return False
         payload = {
             "step": int(step),
+            # explicit alias: consumers (admin surface, lease kill logs)
+            # read last_step without knowing the writer's vintage
+            "last_step": int(step),
             "ts": now,
             "wall_time_s": now - self._started,
             "pid": os.getpid(),
         }
+        if step_ms is not None:
+            payload["last_step_ms"] = round(float(step_ms), 3)
         tmp = f"{self.path}.tmp"
         try:
             with open(tmp, "w") as f:
@@ -121,6 +131,10 @@ class LeaseChecker:
         self.store = store
         self.lease_s = lease_s
         self._clock = _clock
+        #: the most recent heartbeat document :meth:`expired` parsed — the
+        #: monitor reads ``last_step``/``last_step_ms`` from it when it kills
+        #: a stuck job, and ``GET /admin/resilience`` renders progress from it
+        self.last_heartbeat: dict[str, Any] | None = None
 
     async def expired(self, job, report) -> bool:
         """True when ``job`` (a RUNNING JobRecord) holds an expired lease.
@@ -146,6 +160,7 @@ class LeaseChecker:
         hb = parse_heartbeat(raw)
         if hb is None:
             return False
+        self.last_heartbeat = hb
         start = report.start_time if report.start_time is not None else (
             getattr(job, "start_time", None) or 0.0
         )

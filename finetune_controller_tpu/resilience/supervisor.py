@@ -33,6 +33,8 @@ from typing import Any
 
 from ..controller import registry
 from ..controller.schemas import DatabaseStatus, JobInput, JobRecord
+from ..obs import events as obs_events
+from ..obs.events import append_event_safe
 from .policy import FailureClass, RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -48,12 +50,20 @@ class RetrySupervisor:
         catalog,
         *,
         policy: RetryPolicy | None = None,
+        obs=None,
         _clock=time.time,
     ):
         self.state = state
         self.backend = backend
         self.catalog = catalog
         self.policy = policy or RetryPolicy()
+        #: observability hub (obs/prom.py) for the retry-latency histogram
+        self.obs = obs
+        #: async ``(job_id) -> None`` hook fired after any terminal FAILED
+        #: write — the monitor wires its trace export here, because several
+        #: of these writes happen on paths its report loop never revisits
+        #: (lease-kill/sweep budgets, resubmit failures inside tick)
+        self.on_terminal = None
         self._clock = _clock
         # observability (admin/resilience route)
         self.retries_scheduled = 0
@@ -70,6 +80,21 @@ class RetrySupervisor:
 
     # -- failure intake -------------------------------------------------------
 
+    async def _event(self, job_id: str, event: str, *, key: str,
+                     **attrs) -> None:
+        """Best-effort timeline append (docs/observability.md) — the retry
+        loop must never stall on the timeline."""
+        await append_event_safe(self.state, job_id, event, key=key, **attrs)
+
+    async def _terminal(self, job_id: str) -> None:
+        """Fire the terminal hook (best-effort)."""
+        if self.on_terminal is None:
+            return
+        try:
+            await self.on_terminal(job_id)
+        except Exception:
+            logger.debug("terminal hook failed for %s", job_id, exc_info=True)
+
     async def on_job_failed(
         self,
         job: JobRecord,
@@ -77,6 +102,7 @@ class RetrySupervisor:
         exit_code: int | None = None,
         message: str = "",
         resize_to: int | None = None,
+        report_metadata: dict[str, Any] | None = None,
     ) -> bool:
         """Classify one failed attempt; schedule a retry or record the
         terminal failure.  Returns True when a retry was scheduled.
@@ -107,9 +133,26 @@ class RetrySupervisor:
         if resize_to is None and not self.policy.should_retry(failure, attempt):
             entry["delay_s"] = None
             history.append(entry)
+            # timeline BEFORE the CAS (the monitor's event-before-write
+            # rule): a crash in between re-runs the intake (the report is
+            # still there) and the key folds the retry into one event; an
+            # event appended AFTER a committed CAS would be lost forever on
+            # a crash — the intake never re-runs once the status moved.
+            # Events carry the DISPATCH number (1+prior history entries,
+            # resizes included) — the numbering the monitor's running
+            # event, FTC_ATTEMPT, and the trainer spans all use; `attempt`
+            # above is the budget count, which excludes resizes
+            await self._event(
+                job.job_id, obs_events.FAILED,
+                key=f"failed:i{len(history)}",
+                attempt=len(history), failure_class=failure.value,
+                exit_code=exit_code, message=message or None, terminal=True,
+            )
             # compare-and-set from the status the caller snapshotted: a user
             # cancel interleaving inside the monitor tick's await windows
-            # must win, not be overwritten by the failure transition
+            # must win, not be overwritten by the failure transition (the
+            # pre-appended event then records an intake that lost its race
+            # — the failure itself still happened)
             ok = await self.state.transition_job_status(
                 job.job_id,
                 job.status,
@@ -128,6 +171,7 @@ class RetrySupervisor:
                 )
                 return False
             self.terminal_failures += 1
+            await self._terminal(job.job_id)
             logger.warning(
                 "job %s failed terminally (class=%s attempt=%d/%d): %s",
                 job.job_id, failure.value, attempt,
@@ -142,6 +186,36 @@ class RetrySupervisor:
             delay = self.policy.next_delay(prev_delay)
         entry["delay_s"] = delay
         history.append(entry)
+        # timeline BEFORE the CAS (docs/observability.md): a resize or
+        # preemption instant, then the retrying transition — keyed per
+        # intake so a crash-rerun of the intake stays exactly-once, while a
+        # crash AFTER a committed CAS can no longer lose them (the intake
+        # never re-runs once the job is RETRYING)
+        n = len(history)
+        report_metadata = report_metadata or {}
+        if resize_to is not None:
+            await self._event(
+                job.job_id, obs_events.RESIZED, key=f"resized:i{n}",
+                kind=report_metadata.get("resize_kind") or None,
+                to_slices=int(resize_to),
+                by=report_metadata.get("preempted_by") or None,
+            )
+        elif report_metadata.get("preempted") or job.metadata.get("preempted"):
+            await self._event(
+                job.job_id, obs_events.PREEMPTED, key=f"preempted:i{n}",
+                by=(report_metadata.get("preempted_by")
+                    or job.metadata.get("preempted_by") or None),
+                exit_code=exit_code,
+            )
+        # `attempt=n`: the dispatch that just ended (1+prior history entries,
+        # resizes included) — matches the monitor's running event,
+        # FTC_ATTEMPT, and the trainer spans; the budget count (`attempt`
+        # above, resize-exempt) stays in the log line and attempt_history
+        await self._event(
+            job.job_id, obs_events.RETRYING, key=f"retrying:i{n}",
+            attempt=n, failure_class=failure.value, delay_s=delay,
+            resize=bool(resize_to is not None) or None,
+        )
         retry_metadata: dict[str, Any] = {
             "attempt_history": history,
             "failure_class": failure.value,
@@ -226,6 +300,7 @@ class RetrySupervisor:
                 },
                 queue_position=None,
             )
+            await self._terminal(job.job_id)
             return False
         current = await self.state.get_job(job.job_id)
         if current is None or current.status is not DatabaseStatus.RETRYING:
@@ -265,6 +340,7 @@ class RetrySupervisor:
                         queue_position=None,
                     )
                     self.terminal_failures += 1
+                    await self._terminal(job.job_id)
                     return False
                 downgraded_from = target
                 self.topology_downgrades += 1
@@ -276,6 +352,7 @@ class RetrySupervisor:
                 )
                 target = feasible
             prev_ran = int(job.metadata.get("last_ran_num_slices") or job.num_slices)
+            attempt_no = 1 + len(job.metadata.get("attempt_history") or [])
             await self.backend.submit(
                 JobInput(
                     job_id=job.job_id,
@@ -289,6 +366,10 @@ class RetrySupervisor:
                     # queue at its original priority (docs/scheduling.md)
                     queue=job.metadata.get("queue") or "default",
                     priority=job.metadata.get("priority", "normal"),
+                    # same trace across attempts; the attempt number stamps
+                    # the trainer env/log stream (docs/observability.md)
+                    trace_id=job.metadata.get("trace_id") or "",
+                    attempt=attempt_no,
                 ),
                 spec,
                 flavor,
@@ -314,6 +395,16 @@ class RetrySupervisor:
                 "to_num_slices": target,
                 "at": self._clock(),
             }
+        history = job.metadata.get("attempt_history") or []
+        # event BEFORE the CAS (same rule as the failure intake): a crash in
+        # between re-runs the resubmit and the key dedupes; after a
+        # committed CAS the event could never be recovered
+        await self._event(
+            job.job_id, obs_events.RESUBMITTED,
+            key=f"resubmitted:i{len(history)}",
+            attempt=attempt_no, num_slices=target,
+            downgraded_from=downgraded_from,
+        )
         # compare-and-set: a user cancel can land inside submit's await
         # window, and resurrecting a job the user was told is cancelled
         # would be a silent override — on a lost race, roll the fresh
@@ -340,6 +431,13 @@ class RetrySupervisor:
                 logger.exception("rollback of %s failed", job.job_id)
             return False
         self.resubmits += 1
+        if self.obs is not None and history:
+            # failure-to-resubmission latency (backoff + scheduling)
+            ended = history[-1].get("ended_at")
+            if isinstance(ended, (int, float)):
+                self.obs.retry_latency_seconds.observe(
+                    max(self._clock() - ended, 0.0)
+                )
         if target != prev_ran:
             # the next attempt restores the checkpoint onto a different
             # topology — the elastic-restore path (train/elastic.py)

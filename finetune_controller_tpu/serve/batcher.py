@@ -59,11 +59,17 @@ class Batcher:
         max_queue: int = 64,
         max_wait_ms: float = 1000.0,
         default_timeout_s: float = 60.0,
+        ttft_observe=None,
     ):
         self.engine = engine
         self.max_queue = max_queue
         self.max_wait_ms = max_wait_ms
         self.default_timeout_s = default_timeout_s
+        #: time-to-first-token callback (seconds) — the obs hub's
+        #: ``ftc_serve_ttft_seconds`` histogram (docs/observability.md);
+        #: observed at admission: the prefill that admits a request also
+        #: produces its first token
+        self.ttft_observe = ttft_observe
         self._queue: list[_Pending] = []
         self._inflight: dict[str, _Pending] = {}
         self._wake = asyncio.Event()
@@ -207,6 +213,14 @@ class Batcher:
             admitted, finished, step_err = await asyncio.to_thread(
                 self._admit_and_step, to_admit
             )
+            if self.ttft_observe is not None:
+                now = time.monotonic()
+                for p, _done, exc in admitted:
+                    if exc is None:
+                        try:
+                            self.ttft_observe(now - p.enqueued_at)
+                        except Exception:
+                            logger.debug("ttft observe failed", exc_info=True)
             for p, done, exc in admitted:
                 if exc is not None:
                     if not p.future.done():

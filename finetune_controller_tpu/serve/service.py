@@ -47,13 +47,21 @@ class _Session:
 class ServeManager:
     """Loaded serving sessions, one engine+batcher per promoted job."""
 
-    def __init__(self, state, store, settings):
+    def __init__(self, state, store, settings, *, obs=None):
         self.state = state
         self.store = store
         self.settings = settings
+        #: observability hub (obs/prom.py): serve TTFT histogram + timeline
+        #: events on load/unload (docs/observability.md)
+        self.obs = obs
         self.sessions: dict[str, _Session] = {}
         self._load_lock = asyncio.Lock()
         self.work_dir = Path(settings.state_path) / "serve_cache"
+
+    async def _event(self, job_id: str, event: str, **attrs) -> None:
+        from ..obs.events import append_event_safe
+
+        await append_event_safe(self.state, job_id, event, **attrs)
 
     def _engine_config(self) -> EngineConfig:
         s = self.settings
@@ -90,10 +98,19 @@ class ServeManager:
                 max_queue=self.settings.serve_max_queue,
                 max_wait_ms=self.settings.serve_max_wait_ms,
                 default_timeout_s=self.settings.serve_request_timeout_s,
+                ttft_observe=(
+                    self.obs.serve_ttft_seconds.observe
+                    if self.obs is not None else None
+                ),
             )
             self.sessions[job_id] = _Session(
                 job_id=job_id, batcher=batcher, meta=meta,
                 loaded_at=time.time(),
+            )
+            await self._event(
+                job_id, "serve-loaded",
+                checkpoint_step=meta.get("checkpoint_step"),
+                lora_merged=meta.get("lora_merged"),
             )
             logger.info("serve session loaded for %s: %s", job_id, meta)
             return meta
@@ -103,6 +120,7 @@ class ServeManager:
         if session is None:
             return False
         await session.batcher.close()
+        await self._event(job_id, "serve-unloaded")
         logger.info("serve session unloaded for %s", job_id)
         return True
 

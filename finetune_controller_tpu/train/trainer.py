@@ -10,7 +10,9 @@ only the MeshSpec changes.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
+import os
 import signal
 import sys
 import time
@@ -123,6 +125,12 @@ class TrainConfig:
     #: at most every N seconds; the artifact sync ships it and the monitor's
     #: lease check uses it to catch silently-stuck jobs. 0 disables.
     heartbeat_interval_s: float = 10.0
+    #: observability (docs/observability.md): rank 0 records lifecycle
+    #: events (``events.jsonl``), spans (``trace/trainer.jsonl``), and the
+    #: step-phase split (``phase_*_ms`` CSV columns).  ``FTC_TRACE=0`` in the
+    #: env is the operator kill switch; overhead is gated <2% of step time
+    #: by ``BENCH_MODE=obs``.
+    trace: bool = True
 
 
 class PreemptionGuard:
@@ -988,6 +996,28 @@ class Trainer:
         return {}
 
     @staticmethod
+    def _consume_profile_request(path: str) -> int:
+        """Read + retire an on-demand profiler request delivered through the
+        artifact channel (``POST /jobs/{id}/profile`` →
+        ``backend.deliver_file`` → ``profile_request.json``).  Returns the
+        requested step count (0 = unreadable).  The file is renamed either
+        way so a bad payload can't re-trigger every step."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            steps = max(1, min(int(doc.get("steps", 5)), 1000))
+        except (OSError, ValueError, TypeError):
+            steps = 0
+        try:
+            os.replace(path, path + ".consumed")
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return steps
+
+    @staticmethod
     def _sync_preemption(local_flag: bool) -> bool:
         """OR a per-host preemption flag across all hosts (one tiny allgather
         per step — negligible next to a training step, and required so every
@@ -1022,7 +1052,43 @@ class Trainer:
         ckpt = CheckpointManager(
             f"{artifacts_dir}/checkpoints", keep=self.cfg.keep_checkpoints
         )
-        state = self.init_state()
+        # observability (docs/observability.md): rank 0 records lifecycle
+        # events (events.jsonl) + spans (trace/trainer.jsonl) through the
+        # artifact channel, and the phase clock splits every logging window
+        # into input/compute/checkpoint/sync/eval.  FTC_TRACE=0 is the
+        # operator kill switch; BENCH_MODE=obs gates the overhead <2%.
+        from ..obs.events import EventLogWriter
+        from ..obs.phase import PhaseClock
+        from ..obs.trace import SpanRecorder
+
+        obs_on = (
+            self.cfg.trace
+            and os.environ.get("FTC_TRACE", "1").strip().lower()
+            not in ("0", "false", "no", "off")
+            and jax.process_index() == 0
+        )
+        # on-demand profiling is deliberately NOT gated on tracing: with
+        # FTC_TRACE=0 an operator can still arm a jax.profiler window on a
+        # live job (otherwise POST /jobs/{id}/profile 202s into a request
+        # file nothing ever reads).  FTC_PROFILE=0 is its own kill switch.
+        profile_poll_on = (
+            os.environ.get("FTC_PROFILE", "1").strip().lower()
+            not in ("0", "false", "no", "off")
+            and jax.process_index() == 0
+        )
+        trace_id = os.environ.get("FTC_TRACE_ID", "")
+        obs_attempt = int(os.environ.get("FTC_ATTEMPT", "1") or 1)
+        events_log = EventLogWriter(
+            artifacts_dir, trace_id=trace_id, attempt=obs_attempt,
+            enabled=obs_on,
+        )
+        spans = SpanRecorder(
+            artifacts_dir, trace_id, attempt=obs_attempt, enabled=obs_on
+        )
+        phases = PhaseClock()
+        fit_span = spans.start("fit", total_steps=self.cfg.total_steps)
+        with spans.span("init", parent=fit_span):
+            state = self.init_state()
         start_step = 0
         latest = None
         multi = jax.process_count() > 1
@@ -1046,6 +1112,10 @@ class Trainer:
             # fine-tune the checkpoint holds everything — reloading GBs of
             # safetensors just to overwrite them would waste every resume.
             state = self.load_pretrained(state, pretrained_dir)
+        restore_span = (
+            spans.start("restore", parent=fit_span, step=latest)
+            if resume and latest is not None else None
+        )
         if resume:
             if latest is not None:
                 # Topology-portable resume (train/elastic.py): verify the
@@ -1070,6 +1140,7 @@ class Trainer:
                     opt_state=reshard(host["opt_state"], self._state_shardings.opt_state),
                 )
                 start_step = int(host["step"])
+                spans.finish(restore_span, step=start_step)
                 logger.info("resumed from checkpoint step %d", start_step)
 
         # liveness heartbeat (resilience/heartbeat.py): rank 0 proves forward
@@ -1086,6 +1157,10 @@ class Trainer:
         # evaluate() beats through this handle — an eval pass over many
         # batches must not look like a stall to the liveness lease
         self._heartbeat = heartbeat
+        events_log.emit(
+            "train-started", step=start_step,
+            resumed_from=start_step if start_step else None,
+        )
         # chaos hook (resilience/faults.py): a seeded kill-at-step armed via
         # FTC_FAULT_* env vars — None outside fault-injection runs
         from ..resilience.faults import StepFaultInjector
@@ -1104,7 +1179,8 @@ class Trainer:
         # the header union instead of silently dropping the new columns
         writer = MetricsWriter(
             artifacts_dir, append=start_step > 0,
-            extra_fields=self._writer_extra_fields(eval_it is not None),
+            extra_fields=self._writer_extra_fields(eval_it is not None)
+            + (PhaseClock.columns() if obs_on else ()),
             # a crash AFTER a logged row but BEFORE its checkpoint committed
             # makes this run replay those steps — drop their rows so the
             # replay doesn't duplicate them
@@ -1165,19 +1241,64 @@ class Trainer:
             )
             prof_first = start_step
         prof_last = prof_first + self.cfg.profile_steps  # exclusive
+        prof_start_actual = prof_first  # where the live window really began
+        # on-demand profiler window (docs/observability.md): the controller
+        # delivers profile_request.json through the artifact channel and the
+        # loop picks it up within one poll window — a live job profiles
+        # without restarting.  The stat() is throttled to the preemption-sync
+        # cadence: per-step filesystem polling is exactly the kind of cost
+        # the BENCH_MODE=obs <2% gate exists to keep out of the step loop.
+        profile_req_path = os.path.join(artifacts_dir, "profile_request.json")
+        profile_poll = self._preempt_sync_every
         try:
             for step_idx in range(start_step, self.cfg.total_steps):
-                if want_profile and not profiling and step_idx == prof_first:
+                iter_t0 = time.perf_counter()
+                if want_profile and not profiling and step_idx >= prof_first:
+                    # >= not ==: an on-demand window may span the configured
+                    # start step — the configured trace then begins at the
+                    # first free step instead of silently never firing (and
+                    # never having its end marker clobbered)
                     jax.profiler.start_trace(f"{artifacts_dir}/profile")
                     profiling = True
+                    want_profile = False  # one configured window per run
+                    prof_start_actual = step_idx
+                    # clamp to the run so the in-loop stop (and its
+                    # profile-captured confirmation) always fires — the
+                    # finally-block stop_trace is a silent flush
+                    prof_last = min(
+                        step_idx + self.cfg.profile_steps,
+                        self.cfg.total_steps,
+                    )
+                if (
+                    profile_poll_on and not profiling
+                    and step_idx % profile_poll == 0
+                    and os.path.exists(profile_req_path)
+                ):
+                    steps_req = self._consume_profile_request(profile_req_path)
+                    if steps_req:
+                        jax.profiler.start_trace(f"{artifacts_dir}/profile")
+                        profiling = True
+                        prof_start_actual = step_idx
+                        prof_last = min(
+                            step_idx + steps_req, self.cfg.total_steps
+                        )
                 t_in = time.perf_counter()
                 batch = next(it)
-                window_input_s += time.perf_counter() - t_in
+                dt_in = time.perf_counter() - t_in
+                window_input_s += dt_in
+                if obs_on:
+                    phases.add("input", dt_in)
                 window_steps += 1
                 state, metrics = self.step(state, batch)
                 window_tokens += tokens_per_batch
                 if heartbeat is not None:
-                    heartbeat.beat(step_idx + 1)
+                    t_hb = time.perf_counter()
+                    heartbeat.beat(
+                        step_idx + 1,
+                        step_ms=(t_hb - iter_t0) * 1000.0,
+                    )
+                    if obs_on:
+                        phases.add("sync", time.perf_counter() - t_hb)
                 if fault is not None:
                     # after the step so a SIGTERM's save reflects real progress
                     fault.maybe_fire(step_idx + 1)
@@ -1185,9 +1306,15 @@ class Trainer:
                     jax.block_until_ready(state)
                     jax.profiler.stop_trace()
                     profiling = False
+                    # force: profiling is decoupled from the tracing kill
+                    # switch, so its confirmation must be too — the
+                    # timeline otherwise shows a request with no capture
+                    events_log.emit(
+                        "profile-captured", step=step_idx + 1, force=True
+                    )
                     logger.info(
                         "profiler trace for steps [%d, %d) -> %s/profile",
-                        prof_first, prof_last, artifacts_dir,
+                        prof_start_actual, prof_last, artifacts_dir,
                     )
 
                 last = step_idx + 1 == self.cfg.total_steps
@@ -1202,6 +1329,8 @@ class Trainer:
                     eval_t0 = time.perf_counter()
                     eval_metrics = self.evaluate(state, eval_it)
                     eval_elapsed = time.perf_counter() - eval_t0
+                    if obs_on:
+                        phases.add("eval", eval_elapsed)
                     logger.info(
                         "step %d eval_loss %.4f eval_acc %.3f",
                         step_idx + 1, eval_metrics["eval_loss"],
@@ -1222,6 +1351,13 @@ class Trainer:
                     )
                     metrics["input_fraction"] = window_input_s / max(dt, 1e-9)
                     metrics.update(eval_metrics)
+                    if obs_on:
+                        # step-phase split (docs/observability.md): per-step
+                        # averages over the FULL window wall (eval included —
+                        # it is one of the phases)
+                        metrics.update(phases.window_row(
+                            steps=window_steps, wall_s=dt + eval_elapsed
+                        ))
                     metrics.update(self._row_extras())
                     row = {"step": step_idx + 1, **metrics}
                     writer.write(row)
@@ -1250,8 +1386,17 @@ class Trainer:
                     or (step_idx + 1) % self.cfg.checkpoint_every == 0
                     or last
                 )
+                t_sync = time.perf_counter()
                 preempt = self._sync_preemption(guard.requested) if sync_now else False
+                if obs_on and sync_now:
+                    phases.add("sync", time.perf_counter() - t_sync)
                 if (step_idx + 1) % self.cfg.checkpoint_every == 0 or last or preempt:
+                    blocking_save = last or preempt or self._blocking_checkpoints
+                    ck_span = spans.start(
+                        "checkpoint", parent=fit_span, step=step_idx + 1,
+                        blocking=blocking_save,
+                    )
+                    t_ck = time.perf_counter()
                     # Collective gather on all hosts; rank 0 persists.
                     host_state = self.state_to_host(state)
                     if jax.process_index() == 0:
@@ -1264,12 +1409,21 @@ class Trainer:
                         # committed checkpoint carries its topology manifest
                         # (train/elastic.py) so ANY later mesh can restore it.
                         ckpt.save(step_idx + 1, host_state,
-                                  blocking=(last or preempt
-                                            or self._blocking_checkpoints),
+                                  blocking=blocking_save,
                                   manifest=self._build_manifest(
                                       step_idx + 1, host_state))
+                    if obs_on:
+                        # the host-side cost of this save (gather + write for
+                        # a blocking save; gather + handoff for an async one)
+                        phases.add("checkpoint", time.perf_counter() - t_ck)
+                    spans.finish(ck_span)
+                    events_log.emit(
+                        "checkpoint-committed", step=step_idx + 1,
+                        blocking=blocking_save or None,
+                    )
                 if preempt:
                     logger.warning("exiting on preemption after step %d", step_idx + 1)
+                    events_log.emit("preempt-exit", step=step_idx + 1)
                     raise SystemExit(143)
         finally:
             self._heartbeat = None  # evaluate() outside fit must not beat
@@ -1298,4 +1452,12 @@ class Trainer:
                     raise
             finally:
                 writer.close()
+                spans.finish(
+                    fit_span, status="error" if propagating else "ok",
+                    start_step=start_step,
+                )
+                if not propagating:
+                    events_log.emit(
+                        "train-finished", step=self.cfg.total_steps
+                    )
         return state

@@ -61,6 +61,12 @@ def main() -> int:
         return 0  # pool shutdown
     req = json.loads(line)
 
+    # per-job env (trace identity: FTC_TRACE_ID / FTC_ATTEMPT) arrives with
+    # the request — this process was spawned before the job existed, so the
+    # usual spawn-env channel cannot carry it
+    for k, v in (req.get("env") or {}).items():
+        os.environ[k] = str(v)
+
     fd = os.open(req["log"], os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
     os.dup2(fd, 1)
     os.dup2(fd, 2)

@@ -2,7 +2,7 @@
 # The one-command CI gate: static analysis, the fast serve suite, the fast
 # chaos suite, then the tier-1 test suite.
 #
-#   scripts/ci_check.sh            # lint + serve-fast + chaos-fast + tests
+#   scripts/ci_check.sh            # lint + obs/dpo/elastic/sched/serve/chaos-fast + tests
 #   scripts/ci_check.sh --lint-only
 #
 # Lint: `ftc-lint finetune_controller_tpu/` must exit 0 — every finding is
@@ -30,6 +30,21 @@ fi
 
 if [ "${1:-}" = "--lint-only" ]; then
     exit 0
+fi
+
+echo "== obs-fast (tracing, timelines, histograms, phase profiling) ==" >&2
+# The observability layer (docs/observability.md): span/event recorders,
+# trace assembly + the gap-free validator, histogram exposition, the
+# monitor's event ingest, and the hard-path timeline e2e (preempt ->
+# resize -> retry -> promote).  Runs first among the suites — every later
+# stage's diagnosis leans on these surfaces when IT fails.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_obs.py tests/test_metrics_endpoint.py -q -m "not slow" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+obs_rc=$?
+if [ "$obs_rc" -ne 0 ]; then
+    echo "ci_check: obs-fast failed (exit $obs_rc)" >&2
+    exit "$obs_rc"
 fi
 
 echo "== dpo-fast (preference optimization: losses, data, actor/learner) ==" >&2

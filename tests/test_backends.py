@@ -375,6 +375,27 @@ def test_warm_worker_pool_runs_job(tmp_path):
         events = await backend.job_events("warm-1")
         started = [e for e in events if e["reason"] == "Started"]
         assert started and "warm worker" in started[0]["message"], started
+        # the job's trace identity reached the warm-claimed trainer via the
+        # request line (the pooled process predates the job, so the spawn
+        # env could not carry it): rank 0 recorded spans under the trace
+        from finetune_controller_tpu.obs import (
+            parse_event_lines,
+            parse_span_lines,
+        )
+
+        rec = await state.get_job("warm-1")
+        trace_id = rec.metadata["trace_id"]
+        spans = parse_span_lines(
+            await store.get_bytes(f"{rec.artifacts_uri}/trace/trainer.jsonl")
+        )
+        assert spans and all(s["trace_id"] == trace_id for s in spans)
+        t_events = parse_event_lines(
+            await store.get_bytes(f"{rec.artifacts_uri}/events.jsonl")
+        )
+        assert t_events and all(
+            e["trace_id"] == trace_id and e["attrs"]["attempt"] == 1
+            for e in t_events
+        )
         # the claimed worker is replaced for the next job; the replenish runs
         # in the job task's finally block, so poll rather than assert a race
         deadline = asyncio.get_event_loop().time() + 30

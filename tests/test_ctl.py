@@ -61,6 +61,15 @@ def test_ctl_submit_watch_metrics_logs(tmp_path, capsys):
                 ["--api", api, "logs", job_id])) == 0
             assert "finished" in capsys.readouterr().out
 
+            # timeline waterfall (docs/observability.md): a real run's
+            # lifecycle events with offsets, trace id, and gap columns
+            assert await ctl.amain(ctl.build_parser().parse_args(
+                ["--api", api, "timeline", job_id])) == 0
+            tl = capsys.readouterr().out
+            assert "trace=" in tl and "submitted" in tl
+            assert "running" in tl and "succeeded" in tl
+            assert "train-started" in tl  # trainer events were ingested
+
             # artifacts: inventory listing + zip download
             assert await ctl.amain(ctl.build_parser().parse_args(
                 ["--api", api, "artifacts", job_id])) == 0

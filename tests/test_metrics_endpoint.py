@@ -48,12 +48,23 @@ def test_metrics_format_and_content_type(tmp_path):
         for line in body.strip().split("\n"):
             if line.startswith("# TYPE "):
                 _, _, name, kind = line.split(" ")
-                assert kind in ("counter", "gauge"), line
+                assert kind in ("counter", "gauge", "histogram"), line
                 types_seen.add(name)
+            elif line.startswith("# HELP "):
+                continue
             else:
                 assert _SAMPLE_RE.match(line), f"malformed sample: {line!r}"
         assert "ftc_monitor_ticks_total" in types_seen
         assert "ftc_jobs_active" in types_seen
+        # observability layer (docs/observability.md): histogram families
+        # announce themselves even before any observation, and every process
+        # exports its identity + uptime
+        assert "ftc_step_phase_ms" in types_seen
+        assert "ftc_queue_wait_seconds" in types_seen
+        assert "ftc_serve_ttft_seconds" in types_seen
+        assert "ftc_build_info" in types_seen
+        assert 'ftc_build_info{process="server"' in body
+        assert 'ftc_uptime_seconds{process="server"}' in body
         await client.close()
 
     run_async(main())

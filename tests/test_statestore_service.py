@@ -88,6 +88,29 @@ def test_two_clients_share_one_view(tmp_path):
         doc = await b.get_metrics("j-1")
         assert doc.records[0]["loss"] == 2.0
 
+        # timeline events cross the wire with idempotency intact — single
+        # append, batched append (the monitor ingest path), metadata merge
+        from finetune_controller_tpu.obs import make_event
+
+        assert await a.append_job_event(
+            "j-1", make_event("running", key="running:a1")
+        )
+        assert not await b.append_job_event(
+            "j-1", make_event("running", key="running:a1")
+        )
+        assert await b.append_job_events("j-1", [
+            make_event("checkpoint-committed", key="trainer:a1:0", step=10),
+            make_event("checkpoint-committed", key="trainer:a1:0", step=10),
+            make_event("train-finished", key="trainer:a1:1", step=20),
+        ]) == 2
+        assert await a.append_job_events("j-1", []) == 0
+        assert await a.merge_job_metadata("j-1", {"obs_events_ingested": 2})
+        rec = await b.get_job("j-1")
+        assert [e["event"] for e in rec.events] == [
+            "running", "checkpoint-committed", "train-finished",
+        ]
+        assert rec.metadata["obs_events_ingested"] == 2
+
         # promotion recovery sweep crosses the wire without predicates
         await a.update_job_promotion("j-1", PromotionStatus.IN_PROGRESS, "obj://d/x")
         stuck = await b.find_jobs_with_promotion_in([PromotionStatus.IN_PROGRESS])
