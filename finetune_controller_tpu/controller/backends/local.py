@@ -468,6 +468,12 @@ class LocalProcessBackend(TrainingBackend):
         if self._closing:
             return
         for w in self.scheduler.try_admit():
+            if getattr(w, "owner", "train") != "train":
+                # a serve-tenant replica workload: admission grants it chips,
+                # but its lifecycle (spawn/drain) belongs to the serve plane
+                # (sched/serve_tenant.py polls is_admitted) — there is no
+                # trainer process to start and no handle to miss
+                continue
             handle = self._handles.get(w.job_id)
             if handle is None:
                 # the workload outlived its handle (a submit-path crash
@@ -553,7 +559,10 @@ class LocalProcessBackend(TrainingBackend):
         take = getattr(self.scheduler, "take_preemptions", None)
         if take is None:
             return
-        for decision in take():
+        # train-owned decisions only: a serve replica's preemption routes to
+        # the serve tenant (sched/serve_tenant.py), which DRAINS the replica
+        # instead of SIGTERMing a process that does not exist
+        for decision in take(owner="train"):
             victim_id = decision.job_id
             preemptor_id = decision.preemptor_id or ""
             handle = self._handles.get(victim_id)

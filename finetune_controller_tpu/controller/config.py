@@ -167,6 +167,44 @@ class Settings(BaseModel):
     #: count; int4-quantized bases always serve unmerged)
     serve_merge_lora: bool = True
 
+    # --- Serve fleet (docs/serving.md §Fleet, failover, and drain) ---
+    #: replicas per served job (each a full engine+batcher stack behind the
+    #: router); 1 keeps the single-engine footprint but gains health checks,
+    #: drains, and rollover
+    serve_replicas: int = 1
+    #: fleet health-check cadence (stall/fault detection + due restarts);
+    #: also the autoscale tenant's reconcile cadence
+    serve_health_interval_s: float = 2.0
+    #: a replica with work in flight that completes no decode step for this
+    #: long is stuck: torn down (requests fail over) and restarted with
+    #: backoff.  Must exceed the worst-case single decode step INCLUDING a
+    #: first-use prefill compile (minutes on large configs)
+    serve_replica_stall_s: float = 120.0
+    #: graceful-drain budget: in-flight lanes get this long to finish before
+    #: stragglers fail over (rollover, scale-down, and preemption all drain)
+    serve_drain_timeout_s: float = 30.0
+    #: failover budget: extra replicas a request may be re-enqueued on after
+    #: its replica dies mid-decode (original deadline preserved)
+    serve_failover_retries: int = 2
+    #: restart budget for crashed/stuck replicas per incident streak (the
+    #: backoff schedule rides retry_base_delay_s/retry_max_delay_s)
+    serve_replica_restart_attempts: int = 3
+    #: serve-as-a-scheduler-tenant autoscale (docs/scheduling.md §Serve
+    #: tenant): replica count follows queue-depth pressure, with every
+    #: replica a preemptible low-priority workload; needs the local
+    #: backend's fair-share scheduler
+    serve_autoscale: bool = False
+    serve_min_replicas: int = 1
+    serve_max_replicas: int = 4
+    #: queued requests PER healthy replica that count as pressure
+    serve_scale_up_queue_depth: int = 8
+    #: consecutive pressured health ticks before a grow is submitted
+    serve_scale_sustain_ticks: int = 2
+    #: tenant queue serve workloads land in (weight via FTC_SCHED_QUEUES)
+    serve_queue: str = "serve"
+    #: device flavor for replica workloads ("" = the catalog's default)
+    serve_flavor: str = ""
+
     # --- Resilience (finetune_controller_tpu/resilience/, docs/resilience.md) ---
     #: total run attempts per job before a retryable failure becomes terminal
     #: (0 disables the retry supervisor entirely — reference-parity behavior:

@@ -42,7 +42,14 @@ FINAL_STATES = {"succeeded", "failed", "cancelled", "unknown"}
 
 
 class ApiError(RuntimeError):
-    pass
+    """HTTP-level failure; carries the status and any Retry-After hint so
+    callers (``generate``'s bounded retry) can react without re-parsing."""
+
+    def __init__(self, message: str, status: int = 0,
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
 
 
 class Client:
@@ -67,7 +74,17 @@ class Client:
         url = f"{self.base}/api/v1{path}"
         async with self._session.request(method, url, **kw) as r:
             if r.status >= 400:
-                raise ApiError(f"{method} {path} -> {r.status}: {await r.text()}")
+                retry_after = None
+                raw = r.headers.get("Retry-After")
+                if raw:
+                    try:
+                        retry_after = float(raw)
+                    except ValueError:
+                        pass  # HTTP-date form: ignore, callers fall back
+                raise ApiError(
+                    f"{method} {path} -> {r.status}: {await r.text()}",
+                    status=r.status, retry_after_s=retry_after,
+                )
             if "json" in r.headers.get("Content-Type", ""):
                 return await r.json()
             return await r.text()
@@ -276,26 +293,46 @@ async def cmd_queue(client: Client, ns: argparse.Namespace) -> int:
 
 
 async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
-    """Serving-session table from ``GET /admin/serve``: slot/queue occupancy,
-    token throughput counters, and the prefix-reuse cache's hit economics
-    (docs/serving.md)."""
+    """Serving-fleet table from ``GET /admin/serve``: per-job aggregates
+    (slot/queue occupancy, token throughput, prefix-cache hit economics)
+    plus one indented row per replica — state, generation, load, restarts
+    and failovers (docs/serving.md §Fleet)."""
     sessions = (await client.get("/admin/serve")).get("sessions") or {}
     if not sessions:
         print("no serving sessions loaded")
         return 0
-    header = (f"{'JOB':<24} {'SLOTS':>7} {'QUEUE':>5} {'TOKENS':>8} "
-              f"{'HITS':>5} {'MISS':>5} {'SAVED':>8} {'CACHE_MB':>8}")
+    header = (f"{'JOB':<24} {'REPL':>5} {'SLOTS':>7} {'QUEUE':>5} "
+              f"{'TOKENS':>8} {'HITS':>5} {'MISS':>5} {'SAVED':>8} "
+              f"{'CACHE_MB':>8}")
     print(header)
     for job_id, s in sorted(sessions.items()):
         slots = f"{s['slots_busy']}/{s['slots_total']}"
+        repl = f"{s.get('replicas_healthy', 1)}/{s.get('replicas_total', 1)}"
         cache_mb = s.get("prefix_cache_bytes", 0) / (1 << 20)
         print(
-            f"{job_id:<24} {slots:>7} {s['queue_depth']:>5} "
+            f"{job_id:<24} {repl:>5} {slots:>7} {s['queue_depth']:>5} "
             f"{s['tokens_generated_total']:>8} "
             f"{s.get('prefix_hits_total', 0):>5} "
             f"{s.get('prefix_misses_total', 0):>5} "
             f"{s.get('prefill_tokens_saved_total', 0):>8} {cache_mb:>8.1f}"
         )
+        for rid, r in sorted((s.get("replicas") or {}).items()):
+            print(
+                f"  {rid:<10} gen{r.get('generation', 0):<3} "
+                f"{r.get('state', '?'):<9} "
+                f"slots {r.get('slots_busy', 0)}/{r.get('slots_total', 0)} "
+                f"queue {r.get('queue_depth', 0)} "
+                f"tokens {r.get('tokens_generated_total', 0)}"
+            )
+        extras = []
+        for label, key in (("failovers", "failovers_total"),
+                           ("restarts", "replica_restarts_total"),
+                           ("rollovers", "rollovers_total"),
+                           ("shed", "shed_total")):
+            if s.get(key):
+                extras.append(f"{label} {s[key]}")
+        if extras:
+            print(f"  ({', '.join(extras)})")
     return 0
 
 
@@ -351,7 +388,21 @@ async def cmd_generate(client: Client, ns: argparse.Namespace) -> int:
         body["eos_id"] = ns.eos_id
     if ns.seed is not None:
         body["seed"] = ns.seed
-    _print_json(await client.post(f"/jobs/{ns.job_id}/generate", json=body))
+    try:
+        result = await client.post(f"/jobs/{ns.job_id}/generate", json=body)
+    except ApiError as exc:
+        # the server's 429 carries a Retry-After derived from queue depth
+        # and decode rate (docs/serving.md §Fleet): honor it with ONE
+        # bounded client-side retry — a busy fleet usually drains within
+        # the hint, and more than one retry belongs to the caller's loop
+        if exc.status != 429 or exc.retry_after_s is None:
+            raise
+        wait = min(30.0, max(0.0, exc.retry_after_s))
+        print(f"server busy; retrying once in {wait:.0f}s (Retry-After)",
+              file=sys.stderr)
+        await asyncio.sleep(wait)
+        result = await client.post(f"/jobs/{ns.job_id}/generate", json=body)
+    _print_json(result)
     return 0
 
 

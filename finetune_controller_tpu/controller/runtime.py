@@ -14,6 +14,7 @@ import logging
 from typing import Any
 
 from ..obs.prom import ObsHub
+from ..sched import FairShareScheduler
 from ..resilience.heartbeat import LeaseChecker
 from ..resilience.policy import RetryPolicy
 from ..resilience.supervisor import RetrySupervisor
@@ -164,6 +165,18 @@ def build_runtime(
         backend=backend,
         monitor=monitor,
         presigner=presigner,
-        serve=ServeManager(state, store, settings, obs=obs),
+        # the fair-share scheduler handle (local backend) makes serve an
+        # autoscaling preemptible tenant when FTC_SERVE_AUTOSCALE is on
+        # (docs/scheduling.md §Serve tenant); FIFO/k8s backends serve
+        # statically-sized fleets
+        serve=ServeManager(
+            state, store, settings, obs=obs,
+            scheduler=(
+                backend.scheduler
+                if isinstance(getattr(backend, "scheduler", None),
+                              FairShareScheduler)
+                else None
+            ),
+        ),
         obs=obs,
     )

@@ -1136,6 +1136,22 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
             ("ftc_serve_prefill_tokens_saved_total", "counter",
              "prefill_tokens_saved_total"),
             ("ftc_serve_prefix_cache_bytes", "gauge", "prefix_cache_bytes"),
+            # replica fleet + router (docs/serving.md §Fleet)
+            ("ftc_serve_replica_total", "gauge", "replicas_total"),
+            ("ftc_serve_replica_healthy", "gauge", "replicas_healthy"),
+            ("ftc_serve_replica_draining", "gauge", "replicas_draining"),
+            ("ftc_serve_replica_generation", "gauge", "generation"),
+            ("ftc_serve_replica_restarts_total", "counter",
+             "replica_restarts_total"),
+            ("ftc_serve_replica_failed_total", "counter",
+             "replicas_failed_total"),
+            ("ftc_serve_drains_total", "counter", "drains_total"),
+            ("ftc_serve_rollovers_total", "counter", "rollovers_total"),
+            ("ftc_serve_failovers_total", "counter", "failovers_total"),
+            ("ftc_serve_duplicates_suppressed_total", "counter",
+             "duplicates_suppressed_total"),
+            ("ftc_serve_shed_total", "counter", "shed_total"),
+            ("ftc_serve_step_errors_total", "counter", "step_errors_total"),
         )
         lines.append("# TYPE ftc_serve_models_loaded gauge")
         lines.append(f"ftc_serve_models_loaded {len(sessions)}")

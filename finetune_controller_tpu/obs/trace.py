@@ -268,7 +268,11 @@ def build_trace(
             phase("promotion", ts if promo_since is None else promo_since,
                   ts, outcome=name)
             promo_since = None
-        if name == "serve-loaded":
+        if name.startswith("serve-") and name != "serve-unloaded" \
+                and serve_since is None:
+            # any serve-plane event opens the phase: the fleet emits
+            # replica-started events while the session is still being
+            # assembled, BEFORE serve-loaded lands (docs/serving.md §Fleet)
             serve_since = ts
         if name == "serve-unloaded" and serve_since is not None:
             phase("serve", serve_since, ts)

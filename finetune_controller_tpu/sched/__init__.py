@@ -16,6 +16,9 @@ Modules:
   share first, full eviction as the fallback; docs/elasticity.md) and the
   victim ordering (lowest priority, most-over-share, youngest first);
 - :mod:`.backfill` — the reservation-protected backfill gate;
+- :mod:`.serve_tenant` — serve replicas as preemptible ``owner="serve"``
+  workloads with queue-depth autoscaling; shrink and preemption go through
+  graceful drain (docs/serving.md §Fleet);
 - :mod:`.sim` — a seeded, clock-injected cluster simulator so fairness /
   starvation / preemption / progress-loss properties are provable in fast
   deterministic tests (and ``BENCH_MODE=sched`` comparisons against the
@@ -33,13 +36,17 @@ from .queues import (
     Workload,
     parse_priority,
 )
+from .serve_tenant import SERVE_QUEUE, ServeScalePolicy, ServeTenant
 
 __all__ = [
     "DEFAULT_QUEUE",
     "PRIORITY_CLASSES",
+    "SERVE_QUEUE",
     "FairShareScheduler",
     "QueueConfig",
     "QueueSet",
+    "ServeScalePolicy",
+    "ServeTenant",
     "Workload",
     "ResizeDecision",
     "backfill_capacity",

@@ -144,8 +144,14 @@ class FairShareScheduler:
         priority: object | None = None,
         requested_slices: int | None = None,
         min_slices: int = 1,
+        owner: str = "train",
     ) -> Workload:
         """Register a suspended workload under a tenant queue + priority.
+
+        ``owner`` tags which plane runs the workload ("train" = the backend
+        spawns a trainer; "serve" = a serve-tenant replica) — admission and
+        preemption delivery filter on it so each plane only ever handles its
+        own workloads (docs/scheduling.md §Serve tenant).
 
         ``requested_slices`` (>= ``num_slices``) is the topology the job
         originally asked for; a resized resubmit runs at ``num_slices`` and
@@ -185,6 +191,7 @@ class FairShareScheduler:
             num_slices=num_slices,
             requested_slices=requested,
             min_slices=max(1, min(min_slices, num_slices)),
+            owner=owner,
         )
         self._workloads[job_id] = w
         return w
@@ -584,12 +591,27 @@ class FairShareScheduler:
                 free[f] + delta * cps, f,
             )
 
-    def take_preemptions(self) -> list[ResizeDecision]:
+    def take_preemptions(self, owner: str | None = None) -> list[ResizeDecision]:
         """Drain the :class:`ResizeDecision`s selected since the last call —
         the backend SIGTERMs each victim; the resilience loop (checkpoint →
         RETRYING → resume, at ``to_slices`` when the decision is a resize)
-        does the rest."""
-        out, self._pending_preemptions = self._pending_preemptions, []
+        does the rest.
+
+        ``owner`` filters by the victim workload's owner tag, leaving the
+        rest pending: the training backend drains ``owner="train"`` (SIGTERM
+        → retry supervisor), the serve tenant drains ``owner="serve"``
+        (graceful replica drain — never a kill).  ``None`` keeps the legacy
+        take-everything behavior for single-plane callers.
+        """
+        if owner is None:
+            out, self._pending_preemptions = self._pending_preemptions, []
+            return out
+        out, keep = [], []
+        for d in self._pending_preemptions:
+            victim = self._workloads.get(d.job_id)
+            victim_owner = victim.owner if victim is not None else "train"
+            (out if victim_owner == owner else keep).append(d)
+        self._pending_preemptions = keep
         return out
 
     # -- introspection (GangScheduler-compatible + the tenant view) ----------

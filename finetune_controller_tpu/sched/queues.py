@@ -132,6 +132,12 @@ class Workload:
     priority: int = PRIORITY_CLASSES[DEFAULT_PRIORITY]
     seq: int = 0
     admitted: bool = False
+    #: which plane owns this workload's lifecycle: "train" (the backend
+    #: starts/stops a trainer process for it) or "serve" (a
+    #: ``sched/serve_tenant.py`` replica — the backend must NOT try to start
+    #: a process for it, and its preemption decisions route to the serve
+    #: tenant, which drains the replica instead of SIGTERMing anything)
+    owner: str = "train"
     #: victim of an in-flight preemption/resize: SIGTERM sent, chips still
     #: held until the process exits and the backend releases the workload
     preempting: bool = False

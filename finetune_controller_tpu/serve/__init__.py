@@ -6,10 +6,27 @@ external inference stack (SURVEY.md §3.4); this package serves them.
 * :mod:`engine`  — slot-based batch decode over the flax ``cache`` collection
   (fixed decode slots, bucketed prefill, bounded compile count);
 * :mod:`batcher` — asyncio admission queue with backpressure + deadlines;
+* :mod:`fleet`   — N health-checked replicas per served job: stall/fault
+  detection, restart with resilience backoff, graceful drain, zero-downtime
+  checkpoint rollover;
+* :mod:`router`  — spreads requests over the fleet with failover retries,
+  idempotent request ids (exactly-once), and Retry-After load shedding;
 * :mod:`loader`  — promoted-checkpoint resolution/loading + LoRA merge;
 * :mod:`service` — aiohttp routes mounted on the controller server.
 """
 
 from .engine import BatchEngine, EngineConfig, GenRequest, GenResult
+from .fleet import Replica, ReplicaFleet, ReplicaState
+from .router import FleetUnavailable, ReplicaRouter
 
-__all__ = ["BatchEngine", "EngineConfig", "GenRequest", "GenResult"]
+__all__ = [
+    "BatchEngine",
+    "EngineConfig",
+    "FleetUnavailable",
+    "GenRequest",
+    "GenResult",
+    "Replica",
+    "ReplicaFleet",
+    "ReplicaRouter",
+    "ReplicaState",
+]

@@ -12,17 +12,26 @@ plane's event loop:
   stays responsive;
 * **deadlines**: a request that waited in the queue past its deadline is
   dropped with :class:`DeadlineExceeded` before ever touching the engine; an
-  admitted request past its deadline is evicted between steps;
+  admitted request past its deadline is evicted between steps.  A caller may
+  pass an ABSOLUTE ``deadline`` instead of a relative timeout — the fleet
+  router (``serve/router.py``) uses this so a failover re-enqueue keeps the
+  request's ORIGINAL deadline rather than minting a fresh one;
 * ``max_wait_ms`` is the idle park interval: with nothing queued and nothing
   in flight the driver sleeps that long between re-checks rather than
   spinning.  Submissions wake it immediately (the ``_wake`` event), so the
   knob only bounds how stale the fallback re-check can go — floored at 1 ms
-  so a zero can never busy-spin the loop.
+  so a zero can never busy-spin the loop;
+* **drain** (docs/serving.md §Fleet): :meth:`drain` stops admissions, bounces
+  still-queued requests with :class:`ReplicaUnavailable` (retryable on a
+  survivor — they never touched a lane) and lets in-flight lanes finish
+  before closing — the zero-downtime half of checkpoint rollover and of
+  scheduler-driven scale-down.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import logging
 import time
@@ -34,11 +43,30 @@ logger = logging.getLogger(__name__)
 
 
 class QueueFull(RuntimeError):
-    """Admission queue at capacity — shed load (HTTP 429)."""
+    """Admission queue at capacity — shed load (HTTP 429).
+
+    ``retry_after_s`` (when known) is the batcher's drain-time estimate; the
+    HTTP layer surfaces it as a ``Retry-After`` header so callers back off
+    for a useful interval instead of guessing.
+    """
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before it finished."""
+
+
+class ReplicaUnavailable(RuntimeError):
+    """The replica serving this request died or is draining.
+
+    The request did NOT complete (queued requests never touched a lane;
+    in-flight lanes were evicted), so it is safe for the router to re-enqueue
+    it on a surviving replica — the exactly-once contract holds because the
+    failed attempt produced no result.
+    """
 
 
 @dataclasses.dataclass
@@ -75,10 +103,21 @@ class Batcher:
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._closed = False
+        self._draining = False
         # counters surfaced by /metrics
         self.rejected_total = 0
         self.deadline_drops_total = 0
         self.completed_total = 0
+        #: decode-step faults the drive loop survived (fleet health checks
+        #: read this: a replica whose steps fault is torn down + restarted)
+        self.step_errors_total = 0
+        self.last_step_error: BaseException | None = None
+        #: recent decode-step completion instants (monotonic) — the decode
+        #: rate half of the Retry-After estimate
+        self._step_stamps: collections.deque[float] = collections.deque(maxlen=64)
+        #: EMA of decode steps per completed request — the work-per-request
+        #: half of the Retry-After estimate
+        self._avg_request_steps: float | None = None
 
     # ---- public surface ---------------------------------------------------
 
@@ -102,7 +141,10 @@ class Batcher:
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._drive())
 
-    async def close(self) -> None:
+    async def close(self, exc: BaseException | None = None) -> None:
+        """Tear down; pending futures fail with ``exc`` (default: the
+        shutdown :class:`DeadlineExceeded` — a fleet teardown passes
+        :class:`ReplicaUnavailable` instead so the router can fail over)."""
         self._closed = True
         self._wake.set()
         if self._task is not None:
@@ -114,29 +156,91 @@ class Batcher:
             self._task = None
         for p in self._queue + list(self._inflight.values()):
             if not p.future.done():
-                p.future.set_exception(DeadlineExceeded("server shutting down"))
+                p.future.set_exception(
+                    exc if exc is not None
+                    else DeadlineExceeded("server shutting down")
+                )
         self._queue.clear()
         self._inflight.clear()
 
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: refuse new admissions, bounce still-QUEUED
+        requests with :class:`ReplicaUnavailable` (they never touched a lane
+        — a router retries them on a survivor), let IN-FLIGHT lanes finish,
+        then close.  Returns True when every in-flight request completed
+        within ``timeout_s`` (stragglers past it fail retryably too)."""
+        self._draining = True
+        bounced, self._queue = self._queue, []
+        for p in bounced:
+            if not p.future.done():
+                p.future.set_exception(ReplicaUnavailable(
+                    f"request {p.req.request_id} bounced: replica draining"
+                ))
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while self._inflight and time.monotonic() < deadline:
+            self._wake.set()
+            await asyncio.sleep(0.005)
+        drained = not self._inflight
+        if not drained:
+            logger.warning(
+                "drain timed out with %d request(s) still in flight; "
+                "failing them over", len(self._inflight),
+            )
+        await self.close(ReplicaUnavailable("replica drained away"))
+        return drained
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def retry_after_s(self, extra_requests: int = 1) -> float:
+        """Estimated seconds until ``extra_requests`` more requests queued NOW
+        would complete — queue depth × observed steps-per-request over the
+        observed decode-step rate (lanes run in parallel, so the work
+        amortises over ``slots``).  The number behind the ``Retry-After``
+        header on 429s; clamped to [1, 120] and 1.0 before any signal exists.
+        """
+        if not self._avg_request_steps or len(self._step_stamps) < 2:
+            return 1.0
+        span = self._step_stamps[-1] - self._step_stamps[0]
+        if span <= 0:
+            return 1.0
+        steps_per_s = (len(self._step_stamps) - 1) / span
+        lanes = max(1, self.engine.config.slots)
+        work_steps = (len(self._queue) + extra_requests) * self._avg_request_steps
+        eta = work_steps / (steps_per_s * lanes)
+        return min(120.0, max(1.0, eta))
+
     async def submit(
-        self, req: GenRequest, *, timeout_s: float | None = None
+        self,
+        req: GenRequest,
+        *,
+        timeout_s: float | None = None,
+        deadline: float | None = None,
     ) -> GenResult:
         """Queue a request and await its result (raises :class:`QueueFull`
-        immediately at capacity)."""
+        immediately at capacity).  ``deadline`` is an absolute
+        ``time.monotonic`` instant that wins over ``timeout_s`` — failover
+        re-enqueues pass the ORIGINAL deadline through it."""
+        if self._draining:
+            raise ReplicaUnavailable("replica is draining")
         if self._closed:
             raise QueueFull("batcher is closed")
         if len(self._queue) >= self.max_queue:
             self.rejected_total += 1
             raise QueueFull(
-                f"admission queue at capacity ({self.max_queue}); retry later"
+                f"admission queue at capacity ({self.max_queue}); retry later",
+                retry_after_s=self.retry_after_s(),
             )
-        timeout = self.default_timeout_s if timeout_s is None else timeout_s
         now = time.monotonic()
+        if deadline is None:
+            timeout = self.default_timeout_s if timeout_s is None else timeout_s
+            deadline = None if timeout <= 0 else now + timeout
         pending = _Pending(
             req=req,
             future=asyncio.get_running_loop().create_future(),
             enqueued_at=now,
-            deadline=None if timeout <= 0 else now + timeout,
+            deadline=deadline,
         )
         self._queue.append(pending)
         self.start()
@@ -210,9 +314,18 @@ class Batcher:
                 except asyncio.TimeoutError:
                     continue
                 continue
+            # register admissions as IN-FLIGHT before the worker thread runs:
+            # while the thread admits them they are in neither _queue nor
+            # _inflight otherwise, and a concurrent drain()/close() would
+            # see an idle batcher and strand their futures forever
+            for p in to_admit:
+                self._inflight[p.req.request_id] = p
+            steps_before = self.engine.steps_total
             admitted, finished, step_err = await asyncio.to_thread(
                 self._admit_and_step, to_admit
             )
+            if self.engine.steps_total > steps_before:
+                self._step_stamps.append(time.monotonic())
             if self.ttft_observe is not None:
                 now = time.monotonic()
                 for p, _done, exc in admitted:
@@ -222,24 +335,38 @@ class Batcher:
                         except Exception:
                             logger.debug("ttft observe failed", exc_info=True)
             for p, done, exc in admitted:
+                rid = p.req.request_id
                 if exc is not None:
+                    self._inflight.pop(rid, None)
                     if not p.future.done():
                         p.future.set_exception(exc)
                 elif done is not None:  # finished on admission (eos/max_new=1)
+                    self._inflight.pop(rid, None)
                     self.completed_total += 1
+                    self._observe_request_steps(done)
                     if not p.future.done():
                         p.future.set_result(done)
-                else:
-                    self._inflight[p.req.request_id] = p
+                elif p.future.done():
+                    # resolved while the thread was admitting it (deadline
+                    # drop or shutdown): free the lane the thread just
+                    # filled — nobody is waiting on it
+                    self._inflight.pop(rid, None)
+                    self.engine.evict(rid)
             for result in finished:
                 p = self._inflight.pop(result.request_id, None)
                 self.completed_total += 1
+                self._observe_request_steps(result)
                 if p is not None and not p.future.done():
                     p.future.set_result(result)
             if step_err is not None:
                 # the decode step died (OOM, XLA fault, recompile budget):
                 # every in-flight request is lost — fail them LOUDLY instead
-                # of hanging clients, free the lanes, keep serving
+                # of hanging clients, free the lanes, keep serving.  The
+                # error is also counted: a fleet health check treats a
+                # faulting replica as crashed (teardown + restart with
+                # backoff, docs/serving.md §Fleet).
+                self.step_errors_total += 1
+                self.last_step_error = step_err
                 logger.exception("decode step failed; failing %d in-flight "
                                  "request(s)", len(self._inflight),
                                  exc_info=step_err)
@@ -251,6 +378,16 @@ class Batcher:
 
     # ---- observability ----------------------------------------------------
 
+    def _observe_request_steps(self, result: GenResult) -> None:
+        """EMA of decode steps per completed request (Retry-After input)."""
+        steps = max(1, result.steps)
+        if self._avg_request_steps is None:
+            self._avg_request_steps = float(steps)
+        else:
+            self._avg_request_steps = (
+                0.8 * self._avg_request_steps + 0.2 * steps
+            )
+
     def stats(self) -> dict[str, Any]:
         return {
             "queue_depth": self.queue_depth,
@@ -261,6 +398,7 @@ class Batcher:
             "requests_completed_total": self.completed_total,
             "requests_rejected_total": self.rejected_total,
             "deadline_drops_total": self.deadline_drops_total,
+            "step_errors_total": self.step_errors_total,
             "compilations": self.engine.compilations,
             # prefix-reuse KV cache (docs/serving.md) — all zeros when off
             "prefix_hits_total": self.engine.prefix_hits_total,

@@ -128,6 +128,9 @@ class GenResult:
     steps: int                         # decode steps this request rode
     admitted_at: float = 0.0
     finished_at: float = 0.0
+    #: which fleet replica decoded this request (router-annotated; "" when
+    #: the engine is driven directly) — the router → replica trace hop
+    replica_id: str = ""
 
 
 @dataclasses.dataclass

@@ -1,0 +1,489 @@
+"""Replica fleet: N health-checked engine replicas behind one served job.
+
+The PR-4/6 serving plane was ONE engine process — a crash, a stuck decode, or
+a checkpoint rollover took every in-flight request with it.  Promoted
+checkpoints are immutable artifacts, so replicas are cattle
+(docs/serving.md §Fleet): this module owns the herd for one job —
+
+* each :class:`Replica` is a full serving stack (its own
+  :class:`~finetune_controller_tpu.serve.engine.BatchEngine` +
+  :class:`~finetune_controller_tpu.serve.batcher.Batcher`), the in-process
+  equivalent of one ``ServeManager`` per process;
+* **health** rides the same liveness idea as the trainer heartbeats
+  (``resilience/heartbeat.py``): a replica with work in flight whose engine
+  stops completing decode steps for ``stall_timeout_s`` — or whose drive
+  loop survives a decode-step fault — is marked unhealthy, torn down (its
+  requests fail with :class:`ReplicaUnavailable`, which the router retries
+  on a survivor), and restarted with the resilience layer's seeded
+  decorrelated-jitter backoff (``resilience/policy.py::RetryPolicy``) under
+  a bounded attempt budget, exactly the supervisor pattern training uses;
+* **drain** is the only way capacity leaves the fleet voluntarily: new
+  admissions stop, queued requests bounce retryably, in-flight lanes finish
+  (checkpoint rollover and scheduler-driven scale-down both go through it —
+  never through a kill);
+* **rollover** spins replicas on the NEW checkpoint first, shifts traffic
+  (the router prefers the newest generation), and only then drains the old
+  generation — no stop-the-world swap;
+* the seeded chaos hand (``resilience/faults.py::ServeFault``) can kill or
+  wedge a chosen replica at a chosen decode step, the injection path the
+  serve-chaos tests and ``BENCH_MODE=serve`` share.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import itertools
+import logging
+import time
+from typing import Any, Awaitable, Callable
+
+from ..resilience.faults import ServeFaultInjector
+from ..resilience.policy import RETRYABLE, RetryPolicy, classify_failure
+from .batcher import Batcher, ReplicaUnavailable
+from .engine import BatchEngine, EngineConfig, GenRequest
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaState(str, enum.Enum):
+    HEALTHY = "healthy"
+    DRAINING = "draining"
+    FAILED = "failed"
+    STOPPED = "stopped"
+
+
+@dataclasses.dataclass
+class Replica:
+    """One serving stack inside the fleet."""
+
+    replica_id: str
+    generation: int
+    batcher: Batcher
+    state: ReplicaState = ReplicaState.HEALTHY
+    started_at: float = 0.0
+    #: clock reading when the engine last made observable progress (a decode
+    #: step completed, or the replica was verifiably idle) — the health lease
+    last_progress: float = 0.0
+    last_steps_total: int = 0
+    last_step_errors: int = 0
+
+    @property
+    def engine(self) -> BatchEngine:
+        return self.batcher.engine
+
+    @property
+    def healthy(self) -> bool:
+        return self.state is ReplicaState.HEALTHY
+
+    def load(self) -> int:
+        """Routing weight: queued + decoding requests on this replica."""
+        return self.batcher.queue_depth + self.batcher.slots_busy
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "state": self.state.value,
+            "generation": self.generation,
+            **self.batcher.stats(),
+        }
+
+
+@dataclasses.dataclass
+class _PendingRestart:
+    due_at: float
+    prev_delay_s: float
+    reason: str
+
+
+class ReplicaFleet:
+    """The replica set for one served job (docs/serving.md §Fleet).
+
+    ``payload`` is the loaded serving model ``(model, variables)``; engine
+    construction is heavy (a forward trace + first-use compiles) and always
+    runs in a worker thread.  ``event_cb`` (async, best-effort) lands fleet
+    decisions on the job's timeline.
+    """
+
+    #: per-replica stats that are cumulative COUNTERS: folded into
+    #: ``_retired_totals`` when a replica leaves so aggregates never regress
+    _COUNTER_KEYS = (
+        "steps_total", "tokens_generated_total", "requests_completed_total",
+        "requests_rejected_total", "deadline_drops_total",
+        "step_errors_total", "prefix_hits_total", "prefix_misses_total",
+        "prefill_tokens_saved_total",
+    )
+    #: point-in-time gauges: summed over LIVE replicas only
+    _GAUGE_KEYS = (
+        "queue_depth", "slots_busy", "slots_total", "compilations",
+        "prefix_cache_bytes", "prefix_cache_entries",
+    )
+
+    def __init__(
+        self,
+        job_id: str,
+        model: Any,
+        variables: dict,
+        engine_config: EngineConfig,
+        *,
+        replicas: int = 1,
+        batcher_kwargs: dict[str, Any] | None = None,
+        stall_timeout_s: float = 15.0,
+        drain_timeout_s: float = 30.0,
+        restart_policy: RetryPolicy | None = None,
+        fault: ServeFaultInjector | None = None,
+        event_cb: Callable[..., Awaitable[Any]] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        warm_start: bool = True,
+    ):
+        self.job_id = job_id
+        self._model = model
+        self._variables = variables
+        self._engine_config = engine_config
+        self.target_replicas = max(1, replicas)
+        self._batcher_kwargs = dict(batcher_kwargs or {})
+        self.stall_timeout_s = stall_timeout_s
+        self.drain_timeout_s = drain_timeout_s
+        #: restart budget + backoff for crashed/stuck replicas — the same
+        #: policy shape the training retry supervisor runs
+        self.restart_policy = restart_policy or RetryPolicy()
+        self._fault = fault if fault is not None \
+            else ServeFaultInjector.from_env()
+        self._event_cb = event_cb
+        self._clock = clock
+        #: pay every prefill-bucket + decode compile at spawn, BEFORE the
+        #: replica takes traffic — the zero-downtime rollover contract
+        #: depends on a fresh generation not compiling under load
+        self.warm_start = warm_start
+        self.generation = 0
+        self._replicas: dict[str, Replica] = {}
+        self._seq = itertools.count()
+        self._restarts_pending: list[_PendingRestart] = []
+        #: consecutive failed/stuck replicas since the fleet last looked
+        #: fully healthy — the restart policy's attempt counter
+        self._failure_streak = 0
+        #: last backoff delay handed out this streak — feeds next_delay so
+        #: the decorrelated-jitter schedule actually grows across a crash
+        #: loop (reset when the streak resets)
+        self._last_restart_delay: float | None = None
+        self._health_task: asyncio.Task | None = None
+        self._closed = False
+        # counters (/metrics + GET /admin/serve)
+        self.replica_restarts_total = 0
+        self.replicas_failed_total = 0
+        self.drains_total = 0
+        self.rollovers_total = 0
+        #: counter totals folded in from replicas that left the fleet —
+        #: the aggregate /metrics counters must stay monotonic across
+        #: drains/restarts/rollovers
+        self._retired_totals: dict[str, int] = {
+            k: 0 for k in self._COUNTER_KEYS
+        }
+
+    # ---- events ------------------------------------------------------------
+
+    async def _event(self, event: str, **attrs) -> None:
+        if self._event_cb is None:
+            return
+        try:
+            await self._event_cb(event, **attrs)
+        except Exception:
+            logger.debug("fleet event %s failed", event, exc_info=True)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        """Spawn the initial replica set."""
+        for _ in range(self.target_replicas):
+            await self.spawn_replica()
+
+    def _build_engine(self) -> BatchEngine:
+        """Worker-thread body: construct and (by default) WARM the engine —
+        one dummy request per prompt bucket plus a decode step, so every
+        compile this replica will ever need lands before it serves traffic.
+        The warmup's counter noise is zeroed; its shapes are exactly the
+        budgeted ones, so the recompile guard stays armed and accurate."""
+        engine = BatchEngine(self._model, self._variables, self._engine_config)
+        if self.warm_start:
+            warm_new = min(2, engine.config.max_new_tokens)
+            for bucket in engine.config.prompt_buckets:
+                engine.run([GenRequest(
+                    request_id=f"_warm-{bucket}", tokens=[1] * bucket,
+                    max_new_tokens=warm_new,
+                )])
+            engine.steps_total = 0
+            engine.tokens_generated_total = 0
+            engine.requests_finished_total = 0
+            engine.prefix_hits_total = 0
+            engine.prefix_misses_total = 0
+            engine.prefill_tokens_saved_total = 0
+        return engine
+
+    async def spawn_replica(self) -> Replica:
+        """Build one engine (worker thread) and put a replica in service."""
+        rid = f"r{next(self._seq)}"
+        engine = await asyncio.to_thread(self._build_engine)
+        if self._fault is not None and self._fault.arm(rid, engine):
+            logger.warning("replica %s armed with a serve fault", rid)
+        batcher = Batcher(engine, **self._batcher_kwargs)
+        now = self._clock()
+        replica = Replica(
+            replica_id=rid, generation=self.generation, batcher=batcher,
+            started_at=now, last_progress=now,
+        )
+        self._replicas[rid] = replica
+        await self._event(
+            "serve-replica-started", replica=rid, generation=self.generation,
+        )
+        logger.info("serve replica %s started (job=%s gen=%d)",
+                    rid, self.job_id, self.generation)
+        return replica
+
+    def healthy_replicas(self) -> list[Replica]:
+        return [r for r in self._replicas.values() if r.healthy]
+
+    @property
+    def replicas(self) -> dict[str, Replica]:
+        return self._replicas
+
+    async def drain_replica(self, replica_id: str, *, reason: str) -> bool:
+        """Graceful removal: no new admissions, queued requests bounce
+        retryably, in-flight lanes finish (bounded by ``drain_timeout_s``).
+        The ONLY path scale-down and rollover use — never a kill."""
+        replica = self._replicas.get(replica_id)
+        if replica is None or replica.state in (
+            ReplicaState.DRAINING, ReplicaState.STOPPED
+        ):
+            return False
+        replica.state = ReplicaState.DRAINING
+        self.drains_total += 1
+        drained = await replica.batcher.drain(self.drain_timeout_s)
+        replica.state = ReplicaState.STOPPED
+        self._retire(replica)
+        self._replicas.pop(replica_id, None)
+        await self._event(
+            "serve-replica-drained", replica=replica_id, reason=reason,
+            clean=drained,
+        )
+        logger.info("serve replica %s drained (%s, clean=%s)",
+                    replica_id, reason, drained)
+        return drained
+
+    async def fail_replica(
+        self, replica_id: str, *, error: str, restart: bool = True
+    ) -> None:
+        """Immediate teardown of a crashed/stuck replica: its requests fail
+        with :class:`ReplicaUnavailable` (the router re-enqueues them on a
+        survivor) and a restart is scheduled with backoff when the attempt
+        budget allows."""
+        replica = self._replicas.pop(replica_id, None)
+        if replica is None:
+            return
+        replica.state = ReplicaState.FAILED
+        self.replicas_failed_total += 1
+        self._retire(replica)
+        await replica.batcher.close(ReplicaUnavailable(
+            f"replica {replica_id} torn down: {error}"
+        ))
+        failure = classify_failure(None, error)
+        self._failure_streak += 1
+        await self._event(
+            "serve-replica-unhealthy", replica=replica_id, error=error,
+            failure_class=failure.value,
+        )
+        if not restart or self._closed:
+            return
+        if failure in RETRYABLE \
+                and self._failure_streak <= self.restart_policy.max_attempts:
+            delay = self.restart_policy.next_delay(self._last_restart_delay)
+            self._last_restart_delay = delay
+            self._restarts_pending.append(_PendingRestart(
+                due_at=self._clock() + delay, prev_delay_s=delay, reason=error,
+            ))
+            logger.warning(
+                "serve replica %s failed (%s); restart in %.1fs "
+                "(streak %d/%d)", replica_id, error, delay,
+                self._failure_streak, self.restart_policy.max_attempts,
+            )
+        elif not self._replicas and not self._restarts_pending:
+            # budget spent AND the fleet just hit ZERO replicas: a fully
+            # dead fleet with no pending restart would 503 forever (and,
+            # under autoscale, hold its admitted chips against training
+            # indefinitely).  Keep exactly one slow revival probe pending
+            # at the backoff ceiling — bounded cadence, never a storm.
+            delay = self.restart_policy.max_delay_s
+            self._last_restart_delay = delay
+            self._restarts_pending.append(_PendingRestart(
+                due_at=self._clock() + delay, prev_delay_s=delay,
+                reason=f"revival probe after: {error}",
+            ))
+            logger.error(
+                "serve replica %s failed (%s); restart budget exhausted "
+                "(%d/%d) and no replicas remain — probing revival every "
+                "%.0fs", replica_id, error, self._failure_streak,
+                self.restart_policy.max_attempts, delay,
+            )
+        else:
+            logger.error(
+                "serve replica %s failed (%s); restart budget exhausted "
+                "(%d/%d) — fleet degraded to %d replica(s)",
+                replica_id, error, self._failure_streak,
+                self.restart_policy.max_attempts, len(self._replicas),
+            )
+
+    # ---- health ------------------------------------------------------------
+
+    async def health_tick(self) -> dict[str, list[str]]:
+        """One health pass: catch faulted and stalled replicas, run due
+        restarts.  Returns the actions taken (tests assert on them)."""
+        now = self._clock()
+        actions: dict[str, list[str]] = {"failed": [], "restarted": []}
+        for replica in list(self._replicas.values()):
+            if not replica.healthy:
+                continue
+            batcher = replica.batcher
+            engine = replica.engine
+            if batcher.step_errors_total > replica.last_step_errors:
+                # the drive loop survived a decode fault (it keeps serving),
+                # but a faulting engine is a crashed replica from the
+                # fleet's point of view: tear down + restart with backoff
+                err = batcher.last_step_error
+                actions["failed"].append(replica.replica_id)
+                await self.fail_replica(
+                    replica.replica_id,
+                    error=f"decode step fault: {err}",
+                )
+                continue
+            if engine.steps_total > replica.last_steps_total \
+                    or batcher.slots_busy == 0:
+                replica.last_steps_total = engine.steps_total
+                replica.last_progress = now
+            elif now - replica.last_progress > self.stall_timeout_s:
+                # work in flight, no decode step completing: the
+                # stuck-decode shape — the replica holds lanes forever and
+                # only this active check can reclaim them
+                actions["failed"].append(replica.replica_id)
+                await self.fail_replica(
+                    replica.replica_id,
+                    error=(
+                        f"stuck decode: no step completed in "
+                        f"{now - replica.last_progress:.1f}s with "
+                        f"{batcher.slots_busy} request(s) in flight"
+                    ),
+                )
+                continue
+        if not self._restarts_pending \
+                and len(self._replicas) >= self.target_replicas \
+                and all(r.healthy for r in self._replicas.values()):
+            # fleet fully healthy again: a future failure is a fresh
+            # incident, not attempt N+1 of this one
+            self._failure_streak = 0
+            self._last_restart_delay = None
+        due = [p for p in self._restarts_pending if p.due_at <= now]
+        for pending in due:
+            self._restarts_pending.remove(pending)
+            if self._closed or len(self._replicas) >= self.target_replicas:
+                continue
+            replica = await self.spawn_replica()
+            self.replica_restarts_total += 1
+            actions["restarted"].append(replica.replica_id)
+            await self._event(
+                "serve-replica-restarted", replica=replica.replica_id,
+                after=pending.reason,
+            )
+        return actions
+
+    def start_health_loop(self, interval_s: float) -> None:
+        """Background health checks at ``interval_s`` (restarted if dead)."""
+        if self._health_task is None or self._health_task.done():
+            self._health_task = asyncio.get_running_loop().create_task(
+                self._health_loop(max(0.05, interval_s))
+            )
+
+    async def _health_loop(self, interval_s: float) -> None:
+        while not self._closed:
+            try:
+                await self.health_tick()
+            # ftc: ignore[silent-except] -- logged: the health loop must outlive any single tick's failure
+            except Exception:
+                logger.exception("fleet health tick failed (job=%s)",
+                                 self.job_id)
+            await asyncio.sleep(interval_s)
+
+    # ---- rollover ----------------------------------------------------------
+
+    async def rollover(self, model: Any, variables: dict,
+                       *, reason: str = "checkpoint rollover") -> None:
+        """Zero-downtime payload swap: spin up the new generation FIRST,
+        shift traffic (the router prefers the newest generation), then drain
+        the old generation — in-flight lanes finish on the weights they
+        started on."""
+        old = [r for r in self._replicas.values() if r.healthy]
+        self._model = model
+        self._variables = variables
+        self.generation += 1
+        self.rollovers_total += 1
+        await self._event(
+            "serve-rollover-started", generation=self.generation,
+            reason=reason, old_replicas=len(old),
+        )
+        for _ in range(max(1, len(old))):
+            await self.spawn_replica()
+        await asyncio.gather(*(
+            self.drain_replica(r.replica_id, reason=reason) for r in old
+        ))
+        await self._event(
+            "serve-rollover-completed", generation=self.generation,
+        )
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._health_task is not None:
+            self._health_task.cancel()
+            try:
+                await self._health_task
+            except asyncio.CancelledError:
+                pass
+            self._health_task = None
+        for replica in list(self._replicas.values()):
+            await replica.batcher.close()
+        self._replicas.clear()
+
+    # ---- observability -----------------------------------------------------
+
+    def _retire(self, replica: Replica) -> None:
+        stats = replica.batcher.stats()
+        for key in self._COUNTER_KEYS:
+            self._retired_totals[key] += stats.get(key, 0)
+
+    def stats(self) -> dict[str, Any]:
+        """The PR-4 aggregate stats shape every existing consumer reads —
+        counters are monotonic (retired replicas' totals folded in), gauges
+        sum over live replicas — plus the per-replica rows."""
+        replicas = {rid: r.stats() for rid, r in self._replicas.items()}
+        agg: dict[str, Any] = {
+            k: sum(r[k] for r in replicas.values()) for k in self._GAUGE_KEYS
+        }
+        for k in self._COUNTER_KEYS:
+            agg[k] = self._retired_totals[k] + sum(
+                r[k] for r in replicas.values()
+            )
+        agg.update({
+            "replicas": replicas,
+            "replicas_total": len(replicas),
+            "replicas_healthy": sum(
+                1 for r in self._replicas.values() if r.healthy
+            ),
+            "replicas_draining": sum(
+                1 for r in self._replicas.values()
+                if r.state is ReplicaState.DRAINING
+            ),
+            "generation": self.generation,
+            "target_replicas": self.target_replicas,
+            "replica_restarts_total": self.replica_restarts_total,
+            "replicas_failed_total": self.replicas_failed_total,
+            "drains_total": self.drains_total,
+            "rollovers_total": self.rollovers_total,
+            "restarts_pending": len(self._restarts_pending),
+        })
+        return agg

@@ -230,12 +230,33 @@ async def load_promoted(
     *,
     merge_lora: bool = True,
 ) -> tuple[Any, dict, dict]:
-    """resolve → stage → load, the whole serve-side path for one job."""
+    """resolve → stage → load, the whole serve-side path for one job.
+
+    Each load stages into its OWN ``stage-<nonce>`` directory and removes it
+    once the weights are in memory: two racing loads for the same job (or a
+    load racing a rollover) can no longer interleave writes under one shared
+    prefix — the last-writer-wins corruption ISSUE 10 names.  Winner
+    selection between racing callers happens one level up
+    (``ServeManager.load``'s per-job single-flight CAS); this layer just
+    guarantees that even uncoordinated concurrent loads are each internally
+    consistent.  (A crashed load can leak its stage dir; no sweep happens
+    here on purpose — a sweep would race a concurrent load's live staging,
+    which is the exact bug being fixed.)
+    """
+    import shutil
+    import uuid
+
     job = await resolve_promoted(state, job_id)
-    local = await fetch_promoted(store, job.promotion_uri, Path(work_dir) / job_id)
-    model, variables, meta = await asyncio.to_thread(
-        load_serving_model, local, merge_lora=merge_lora
+    job_dir = Path(work_dir) / job_id
+    local = await fetch_promoted(
+        store, job.promotion_uri, job_dir / f"stage-{uuid.uuid4().hex[:8]}"
     )
+    try:
+        model, variables, meta = await asyncio.to_thread(
+            load_serving_model, local, merge_lora=merge_lora
+        )
+    finally:
+        await asyncio.to_thread(shutil.rmtree, local, ignore_errors=True)
     meta["job_id"] = job_id
     meta["promotion_uri"] = job.promotion_uri
     return model, variables, meta

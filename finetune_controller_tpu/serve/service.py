@@ -6,8 +6,16 @@ middlewares apply — a bearer token that can read a job can generate from it):
 * ``POST {prefix}/jobs/{job_id}/generate`` — generate from a promoted job's
   checkpoint (auto-loads on first use when ``serve_autoload`` is on);
 * ``POST {prefix}/admin/serve/{job_id}/load`` / ``.../unload`` — explicit
-  model lifecycle (admin);
-* ``GET {prefix}/admin/serve`` — per-model engine/batcher stats (admin).
+  model lifecycle (admin).  ``load`` on an ALREADY-loaded job is the
+  zero-downtime rollover trigger: if the promotion now points at a newer
+  checkpoint, replicas spin up on it, traffic shifts, and the old replicas
+  drain after their in-flight lanes finish (docs/serving.md §Fleet);
+* ``GET {prefix}/admin/serve`` — per-model fleet/router stats (admin).
+
+Every served job runs a :class:`~finetune_controller_tpu.serve.fleet.
+ReplicaFleet` behind a :class:`~finetune_controller_tpu.serve.router.
+ReplicaRouter` (``serve_replicas`` controls the floor; 1 keeps the PR-4
+single-engine footprint but with health checks and drains).
 
 The manager refuses jobs whose promotion is not COMPLETED
 (``serve/loader.py::resolve_promoted``) — serving a half-copied or deleted
@@ -27,9 +35,12 @@ from typing import Any
 
 from aiohttp import web
 
-from .batcher import Batcher, DeadlineExceeded, QueueFull
-from .engine import BatchEngine, EngineConfig, GenRequest, GenResult, PromptTooLong
-from .loader import ServeLoadError, load_promoted
+from ..resilience.policy import RetryPolicy
+from .batcher import DeadlineExceeded, QueueFull, ReplicaUnavailable
+from .engine import EngineConfig, GenRequest, GenResult, PromptTooLong
+from .fleet import ReplicaFleet
+from .loader import ServeLoadError, load_promoted, resolve_promoted
+from .router import FleetUnavailable, ReplicaRouter
 
 logger = logging.getLogger(__name__)
 
@@ -39,23 +50,32 @@ SERVE_KEY = web.AppKey("serve", object)
 @dataclasses.dataclass
 class _Session:
     job_id: str
-    batcher: Batcher
+    fleet: ReplicaFleet
+    router: ReplicaRouter
     meta: dict[str, Any]
     loaded_at: float
+    tenant: Any = None  # sched/serve_tenant.py when autoscale is on
 
 
 class ServeManager:
-    """Loaded serving sessions, one engine+batcher per promoted job."""
+    """Loaded serving sessions, one replica fleet + router per promoted job."""
 
-    def __init__(self, state, store, settings, *, obs=None):
+    def __init__(self, state, store, settings, *, obs=None, scheduler=None):
         self.state = state
         self.store = store
         self.settings = settings
         #: observability hub (obs/prom.py): serve TTFT histogram + timeline
         #: events on load/unload (docs/observability.md)
         self.obs = obs
+        #: the backend's fair-share scheduler (serve-as-a-tenant autoscale,
+        #: docs/scheduling.md §Serve tenant); None = static fleets
+        self.scheduler = scheduler
         self.sessions: dict[str, _Session] = {}
-        self._load_lock = asyncio.Lock()
+        #: per-job single-flight loads: the dict insert is the CAS — exactly
+        #: one racing ``load`` wins and does the work, the rest await its
+        #: future (the ISSUE 10 loader-staleness fix, with the staging race
+        #: itself removed by unique stage dirs in ``loader.load_promoted``)
+        self._loading: dict[str, asyncio.Future] = {}
         self.work_dir = Path(settings.state_path) / "serve_cache"
 
     async def _event(self, job_id: str, event: str, **attrs) -> None:
@@ -75,51 +95,173 @@ class ServeManager:
             ),
         )
 
+    def _batcher_kwargs(self) -> dict[str, Any]:
+        return dict(
+            max_queue=self.settings.serve_max_queue,
+            max_wait_ms=self.settings.serve_max_wait_ms,
+            default_timeout_s=self.settings.serve_request_timeout_s,
+            ttft_observe=(
+                self.obs.serve_ttft_seconds.observe
+                if self.obs is not None else None
+            ),
+        )
+
+    async def _build_session(self, job_id, model, variables, meta) -> _Session:
+        s = self.settings
+        fleet = ReplicaFleet(
+            job_id, model, variables, self._engine_config(),
+            replicas=s.serve_replicas,
+            batcher_kwargs=self._batcher_kwargs(),
+            stall_timeout_s=s.serve_replica_stall_s,
+            drain_timeout_s=s.serve_drain_timeout_s,
+            restart_policy=RetryPolicy(
+                max_attempts=s.serve_replica_restart_attempts,
+                base_delay_s=s.retry_base_delay_s,
+                max_delay_s=s.retry_max_delay_s,
+            ),
+            event_cb=(
+                lambda event, **attrs: self._event(job_id, event, **attrs)
+            ),
+        )
+        await fleet.start()
+        router = ReplicaRouter(
+            fleet,
+            default_timeout_s=s.serve_request_timeout_s,
+            failover_retries=s.serve_failover_retries,
+        )
+        session = _Session(
+            job_id=job_id, fleet=fleet, router=router, meta=meta,
+            loaded_at=time.time(),
+        )
+        if s.serve_autoscale and self.scheduler is not None:
+            from ..sched.serve_tenant import ServeScalePolicy, ServeTenant
+
+            flavor = s.serve_flavor or getattr(
+                getattr(self.scheduler, "_catalog", None), "default_flavor", ""
+            )
+            if flavor:
+                session.tenant = ServeTenant(
+                    self.scheduler, fleet,
+                    flavor=flavor, queue=s.serve_queue,
+                    policy=ServeScalePolicy(
+                        min_replicas=s.serve_min_replicas,
+                        max_replicas=s.serve_max_replicas,
+                        scale_up_queue_depth=s.serve_scale_up_queue_depth,
+                        sustain_ticks=s.serve_scale_sustain_ticks,
+                    ),
+                )
+                await session.tenant.attach_initial()
+        fleet.start_health_loop(s.serve_health_interval_s)
+        if session.tenant is not None:
+            self._start_tenant_loop(session)
+        return session
+
+    def _start_tenant_loop(self, session: _Session) -> None:
+        async def loop():
+            while session.tenant is not None \
+                    and self.sessions.get(session.job_id) is session:
+                try:
+                    await session.tenant.tick()
+                # ftc: ignore[silent-except] -- logged: the autoscale loop must outlive a single tick's failure
+                except Exception:
+                    logger.exception("serve tenant tick failed for %s",
+                                     session.job_id)
+                await asyncio.sleep(self.settings.serve_health_interval_s)
+
+        asyncio.get_running_loop().create_task(loop())
+
     async def load(self, job_id: str) -> dict[str, Any]:
-        """Idempotent: returns the existing session's meta when loaded."""
+        """Load a promoted job for serving (idempotent), or — when it is
+        already loaded and its promotion points at a NEWER checkpoint —
+        perform a zero-downtime rollover onto it."""
+        racing = self._loading.get(job_id)
+        if racing is not None:
+            return await asyncio.shield(racing)
+        future = asyncio.get_running_loop().create_future()
+        self._loading[job_id] = future  # the CAS: we are the winner now
+        try:
+            meta = await self._load_or_rollover(job_id)
+            future.set_result(meta)
+            return meta
+        except BaseException as exc:
+            if not future.done():
+                future.set_exception(exc)
+                future.exception()  # losers or nobody: mark retrieved
+            raise
+        finally:
+            self._loading.pop(job_id, None)
+
+    async def _peek_latest_step(self, promotion_uri: str) -> int | None:
+        """Newest ``checkpoints/step_N`` under the deploy prefix — a store
+        LISTING, not a download: the cheap already-serving-this pre-check."""
+        prefix = promotion_uri.rstrip("/") + "/"
+        try:
+            objs = await self.store.list_prefix(promotion_uri)
+        except Exception:
+            logger.debug("peek of %s failed; falling back to a full load",
+                         promotion_uri, exc_info=True)
+            return None
+        steps = []
+        for obj in objs:
+            rel = obj.get("uri", "")[len(prefix):]
+            if rel.startswith("checkpoints/step_"):
+                raw = rel.split("/", 2)[1].rpartition("_")[2]
+                if raw.isdigit():
+                    steps.append(int(raw))
+        return max(steps) if steps else None
+
+    async def _load_or_rollover(self, job_id: str) -> dict[str, Any]:
         existing = self.sessions.get(job_id)
         if existing is not None:
-            return existing.meta
-        async with self._load_lock:  # single-flight per manager
-            existing = self.sessions.get(job_id)
-            if existing is not None:
+            # cheap idempotence check BEFORE staging gigabytes: same deploy
+            # prefix and no newer checkpoint step means the live session
+            # already serves this exact artifact
+            job = await resolve_promoted(self.state, job_id)
+            if job.promotion_uri == existing.meta.get("promotion_uri"):
+                peek = await self._peek_latest_step(job.promotion_uri)
+                if peek is not None \
+                        and peek == existing.meta.get("checkpoint_step"):
+                    return existing.meta
+        model, variables, meta = await load_promoted(
+            self.state, self.store, job_id, self.work_dir,
+            merge_lora=self.settings.serve_merge_lora,
+        )
+        if existing is not None:
+            same = (
+                existing.meta.get("checkpoint_step") == meta.get("checkpoint_step")
+                and existing.meta.get("promotion_uri") == meta.get("promotion_uri")
+            )
+            if same:
+                # already serving exactly this artifact — idempotent
                 return existing.meta
-            model, variables, meta = await load_promoted(
-                self.state, self.store, job_id, self.work_dir,
-                merge_lora=self.settings.serve_merge_lora,
-            )
-            # engine construction traces a forward to shape the batch cache —
-            # device work that must not run on the event loop
-            engine = await asyncio.to_thread(
-                BatchEngine, model, variables, self._engine_config()
-            )
-            batcher = Batcher(
-                engine,
-                max_queue=self.settings.serve_max_queue,
-                max_wait_ms=self.settings.serve_max_wait_ms,
-                default_timeout_s=self.settings.serve_request_timeout_s,
-                ttft_observe=(
-                    self.obs.serve_ttft_seconds.observe
-                    if self.obs is not None else None
-                ),
-            )
-            self.sessions[job_id] = _Session(
-                job_id=job_id, batcher=batcher, meta=meta,
-                loaded_at=time.time(),
-            )
             await self._event(
-                job_id, "serve-loaded",
-                checkpoint_step=meta.get("checkpoint_step"),
-                lora_merged=meta.get("lora_merged"),
+                job_id, "serve-rollover-requested",
+                from_step=existing.meta.get("checkpoint_step"),
+                to_step=meta.get("checkpoint_step"),
             )
-            logger.info("serve session loaded for %s: %s", job_id, meta)
+            await existing.fleet.rollover(model, variables)
+            existing.meta = meta
+            logger.info("serve rollover completed for %s: %s", job_id, meta)
             return meta
+        session = await self._build_session(job_id, model, variables, meta)
+        self.sessions[job_id] = session
+        await self._event(
+            job_id, "serve-loaded",
+            checkpoint_step=meta.get("checkpoint_step"),
+            lora_merged=meta.get("lora_merged"),
+            replicas=session.fleet.target_replicas,
+        )
+        logger.info("serve session loaded for %s: %s", job_id, meta)
+        return meta
 
     async def unload(self, job_id: str) -> bool:
         session = self.sessions.pop(job_id, None)
         if session is None:
             return False
-        await session.batcher.close()
+        if session.tenant is not None:
+            await session.tenant.close()
+            session.tenant = None
+        await session.fleet.close()
         await self._event(job_id, "serve-unloaded")
         logger.info("serve session unloaded for %s", job_id)
         return True
@@ -141,14 +283,18 @@ class ServeManager:
                     f"job {job_id!r} was unloaded while loading; retry",
                     status=409,
                 )
-        result = await session.batcher.submit(req, timeout_s=timeout_s)
+        result = await session.router.submit(req, timeout_s=timeout_s)
         return result, session.meta
 
     def stats(self) -> dict[str, Any]:
-        return {
-            job_id: session.batcher.stats()
-            for job_id, session in self.sessions.items()
-        }
+        out: dict[str, Any] = {}
+        for job_id, session in self.sessions.items():
+            stats = session.fleet.stats()
+            stats.update(session.router.stats())
+            if session.tenant is not None:
+                stats["autoscale"] = session.tenant.stats()
+            out[job_id] = stats
+        return out
 
     async def close(self) -> None:
         for job_id in list(self.sessions):
@@ -218,8 +364,21 @@ async def generate_job(request: web.Request) -> web.Response:
             job.job_id, req, timeout_s=timeout_s
         )
     except QueueFull as e:
+        # Retry-After derived from observed queue depth and decode rate
+        # (serve/batcher.py::retry_after_s) — callers back off for a useful
+        # interval instead of guessing from a bare 429
+        retry_after = max(1, round(e.retry_after_s or 1.0))
         return web.Response(
-            status=429, headers={"Retry-After": "1"},
+            status=429, headers={"Retry-After": str(retry_after)},
+            body=json.dumps({
+                "detail": str(e), "retry_after_s": retry_after,
+            }).encode(),
+            content_type="application/json",
+        )
+    except (FleetUnavailable, ReplicaUnavailable) as e:
+        retry_after = max(1, round(getattr(e, "retry_after_s", None) or 2.0))
+        return web.Response(
+            status=503, headers={"Retry-After": str(retry_after)},
             body=json.dumps({"detail": str(e)}).encode(),
             content_type="application/json",
         )
@@ -237,6 +396,7 @@ async def generate_job(request: web.Request) -> web.Response:
             "tokens": result.generated,
             "finish_reason": result.finish_reason,
             "latency_ms": round((time.monotonic() - t0) * 1000, 2),
+            "replica_id": result.replica_id,
             "model": {
                 "checkpoint_step": meta.get("checkpoint_step"),
                 "lora_merged": meta.get("lora_merged"),

@@ -94,11 +94,26 @@ if [ "$sched_rc" -ne 0 ]; then
     exit "$sched_rc"
 fi
 
+echo "== serve-chaos-fast (replica kill, drain, failover, autoscale) ==" >&2
+# The fleet robustness anchors (docs/serving.md §Fleet): the 'not slow'
+# replica-kill/drain/failover/autoscale tests lead, and the slow-marked
+# fleet HTTP loops (429 Retry-After, concurrent-load CAS) ride along so
+# the whole fleet layer is covered exactly once per gate, before the full
+# serve suite below.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serve_fleet.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+serve_chaos_rc=$?
+if [ "$serve_chaos_rc" -ne 0 ]; then
+    echo "ci_check: serve-chaos-fast failed (exit $serve_chaos_rc)" >&2
+    exit "$serve_chaos_rc"
+fi
+
 echo "== serve-fast (batching invariance + prefix cache + metrics) ==" >&2
 # no 'not slow' filter here: the serve suite IS this stage's whole job, so
 # its slow-marked extras (sampled-decode parity, prefix-cache eviction
-# mid-flight) run too — they are excluded from tier-1 below only to protect
-# that stage's wall-clock budget
+# mid-flight) run too — they are excluded from tier-1 below only to
+# protect that stage's wall-clock budget
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_serve.py tests/test_prefix_cache.py \
     tests/test_metrics_endpoint.py -q \

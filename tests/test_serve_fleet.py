@@ -1,0 +1,948 @@
+"""Serve fleet robustness (ISSUE 10, docs/serving.md §Fleet).
+
+The serve-chaos anchors: a seeded mid-workload replica kill loses no
+request and duplicates none (greedy outputs bit-identical to the no-kill
+baseline), a stuck decode is caught by the health check and the replica
+restarts with backoff, drain finishes in-flight lanes before the replica
+leaves, rollover is zero-downtime, failover preserves the ORIGINAL request
+deadline, 429s carry a derived Retry-After, racing loads resolve to one
+winner, and the autoscale round-trip returns chips a training tenant can
+admit within one scheduler tick.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import run_async
+from finetune_controller_tpu.models.generate import cached_generate
+from finetune_controller_tpu.models.llama import PRESETS, LlamaForCausalLM
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.resilience.faults import (
+    ServeFault,
+    ServeFaultInjector,
+)
+from finetune_controller_tpu.resilience.policy import RetryPolicy
+from finetune_controller_tpu.serve.batcher import (
+    Batcher,
+    DeadlineExceeded,
+    QueueFull,
+)
+from finetune_controller_tpu.serve.engine import (
+    BatchEngine,
+    EngineConfig,
+    GenRequest,
+)
+from finetune_controller_tpu.serve.fleet import ReplicaFleet, ReplicaState
+from finetune_controller_tpu.serve.router import FleetUnavailable, ReplicaRouter
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=4))
+    model = LlamaForCausalLM(cfg)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 4), jnp.int32)
+    )
+    return model, variables
+
+
+# same shapes as tests/test_serve.py so the warm XLA cache is shared
+ENGINE_CFG = dict(slots=2, prompt_buckets=(8, 16), max_new_tokens=24)
+
+
+def _fleet(model, variables, **kw):
+    defaults = dict(
+        replicas=2,
+        # comfortably above a first-use decode compile on this box (the
+        # production default is 120 s for exactly this reason)
+        stall_timeout_s=1.0,
+        drain_timeout_s=10.0,
+        restart_policy=RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=0.1, seed=0
+        ),
+    )
+    defaults.update(kw)
+    engine_kw = defaults.pop("engine", {})
+    return ReplicaFleet(
+        "job-under-test", model, variables,
+        EngineConfig(**{**ENGINE_CFG, **engine_kw}), **defaults,
+    )
+
+
+def _baseline(model, variables, prompt, n):
+    out = cached_generate(
+        model, variables, jnp.asarray([prompt], jnp.int32), max_new_tokens=n
+    )
+    return list(np.asarray(out[0, len(prompt):]))
+
+
+PROMPTS = [
+    [5, 9, 2, 7],
+    [1, 3, 3, 8, 2, 2],
+    [7, 7, 7],
+    [2, 13],
+    [11, 4, 9, 1],
+    [3, 3, 1],
+    [6, 2, 8, 8, 1],
+    [9, 9],
+]
+
+
+def _reqs(max_new=8):
+    return [
+        GenRequest(request_id=f"r{i}", tokens=p, max_new_tokens=max_new)
+        for i, p in enumerate(PROMPTS)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Fault plumbing (ISSUE 10 satellite: FTC_FAULT_SERVE_*)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_fault_env_roundtrip(tmp_path):
+    once = str(tmp_path / "spent")
+    fault = ServeFault(replica_id="r1", at_step=7, mode="stall",
+                       once_file=once)
+    env = fault.to_env()
+    assert env["FTC_FAULT_SERVE_REPLICA"] == "r1"
+    assert env["FTC_FAULT_SERVE_AT_STEP"] == "7"
+    assert env["FTC_FAULT_SERVE_MODE"] == "stall"
+    assert ServeFault.from_env(env) == fault
+    # malformed / absent env arms nothing
+    assert ServeFault.from_env({}) is None
+    assert ServeFault.from_env({"FTC_FAULT_SERVE_REPLICA": "r0",
+                                "FTC_FAULT_SERVE_AT_STEP": "x"}) is None
+    assert ServeFault.from_env({"FTC_FAULT_SERVE_REPLICA": "r0",
+                                "FTC_FAULT_SERVE_AT_STEP": "1",
+                                "FTC_FAULT_SERVE_MODE": "nuke"}) is None
+
+
+def test_serve_fault_once_file_spends(tmp_path):
+    """A spent once-file keeps the fault from re-firing on a restarted
+    replica (mirrors StepFault's once semantics)."""
+
+    class FakeEngine:
+        steps_total = 5
+        active_requests = 1
+
+        def step(self):
+            return ["ok"]
+
+    once = str(tmp_path / "once")
+    inj = ServeFaultInjector(ServeFault("r0", at_step=1, once_file=once))
+    eng = FakeEngine()
+    assert inj.arm("r0", eng)
+    assert not inj.arm("r9", FakeEngine())  # wrong replica: not armed
+    with pytest.raises(Exception, match="killed"):
+        eng.step()
+    # restarted replica, same env: the once-file marks the fault spent
+    inj2 = ServeFaultInjector(ServeFault("r0", at_step=1, once_file=once))
+    eng2 = FakeEngine()
+    inj2.arm("r0", eng2)
+    assert eng2.step() == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# The serve-chaos anchor: replica kill → exactly once, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_replica_kill_every_request_exactly_once_bit_identical(tiny_model):
+    """Seeded mid-workload kill of one of two replicas: every accepted
+    request completes EXACTLY once, greedy outputs bit-identical to the
+    single-request anchor (== an unkilled run, by the PR-4 invariance
+    proof), none lost, none duplicated."""
+    model, variables = tiny_model
+
+    async def main():
+        fault = ServeFaultInjector(
+            ServeFault(replica_id="r1", at_step=3, mode="kill")
+        )
+        fleet = _fleet(model, variables, fault=fault)
+        await fleet.start()
+        router = ReplicaRouter(fleet, default_timeout_s=60,
+                               failover_retries=2)
+        reqs = _reqs()
+        results = await asyncio.gather(*(router.submit(r) for r in reqs))
+        by_id = {}
+        for res in results:
+            assert res.request_id not in by_id, "request completed twice"
+            by_id[res.request_id] = res
+        assert len(by_id) == len(reqs)  # none lost
+        for req in reqs:
+            want = _baseline(model, variables, req.tokens, req.max_new_tokens)
+            got = by_id[req.request_id]
+            assert got.generated == want, f"{req.request_id} diverged"
+            assert got.finish_reason == "length"
+            assert got.replica_id  # the router → replica trace hop
+        # the kill actually happened and was survived via failover
+        assert fault.fired
+        assert router.failovers_total >= 1
+        stats = fleet.stats()
+        assert stats["step_errors_total"] >= 1
+        # aggregate counter audit: completions == accepted requests even
+        # though a replica died mid-workload (retired totals folded in)
+        assert stats["requests_completed_total"] == len(reqs)
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_router_retry_after_failure_is_classified(tiny_model):
+    """Failover reuses the resilience classification: a retryable decode
+    fault fails over; a per-request error (bad params) surfaces
+    immediately without burning retries."""
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(model, variables, replicas=1)
+        await fleet.start()
+        router = ReplicaRouter(fleet, failover_retries=2)
+        with pytest.raises(ValueError, match="engine cap"):
+            await router.submit(GenRequest(
+                request_id="bad", tokens=[1, 2], max_new_tokens=999,
+            ))
+        assert router.failovers_total == 0
+        await fleet.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Stuck decode: health check + restart with backoff
+# ---------------------------------------------------------------------------
+
+
+def test_stuck_decode_detected_drained_and_restarted(tiny_model):
+    """A wedged replica (decode stops progressing while holding lanes) is
+    caught by the active health check, torn down — its requests fail over
+    and still complete bit-identically — and restarted after the seeded
+    backoff delay."""
+    model, variables = tiny_model
+
+    async def main():
+        fault = ServeFaultInjector(
+            ServeFault(replica_id="r1", at_step=2, mode="stall")
+        )
+        fleet = _fleet(model, variables, fault=fault, stall_timeout_s=1.0)
+        await fleet.start()
+        router = ReplicaRouter(fleet, default_timeout_s=60,
+                               failover_retries=2)
+        reqs = _reqs(max_new=6)
+        tasks = [asyncio.ensure_future(router.submit(r)) for r in reqs]
+        # drive health ticks until the stall is caught and everything lands
+        failed: list[str] = []
+        for _ in range(200):
+            acts = await fleet.health_tick()
+            failed.extend(acts["failed"])
+            if all(t.done() for t in tasks):
+                break
+            await asyncio.sleep(0.05)
+        results = await asyncio.gather(*tasks)
+        assert failed, "the stalled replica was never caught"
+        for req, res in zip(reqs, results):
+            assert res.generated == _baseline(
+                model, variables, req.tokens, req.max_new_tokens
+            )
+        # restart lands after the (tiny, seeded) backoff
+        for _ in range(100):
+            acts = await fleet.health_tick()
+            if acts["restarted"]:
+                break
+            await asyncio.sleep(0.02)
+        assert fleet.replica_restarts_total == 1
+        stats = fleet.stats()
+        assert stats["replicas_healthy"] == 2
+        assert stats["replicas_failed_total"] == 1
+        # the restarted replica serves traffic
+        res = await router.submit(GenRequest(
+            request_id="after", tokens=[5, 9, 2, 7], max_new_tokens=4,
+        ))
+        assert res.generated == _baseline(model, variables, [5, 9, 2, 7], 4)
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_restart_budget_exhaustion_probes_instead_of_dying(tiny_model):
+    """Past the restart budget a zero-replica fleet keeps exactly ONE slow
+    revival probe pending (bounded cadence, never a storm, never a
+    permanently dead fleet holding chips) — and once the failures stop,
+    the probe revives it and it serves again."""
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(
+            model, variables, replicas=1,
+            restart_policy=RetryPolicy(
+                max_attempts=2, base_delay_s=0.01, max_delay_s=0.05, seed=0
+            ),
+        )
+        await fleet.start()
+        for i in range(4):
+            for rid in list(fleet.replicas):
+                await fleet.fail_replica(rid, error=f"boom {i}")
+            # no storm, and LIVENESS: a dead fleet always has a restart
+            # (or revival probe) pending
+            assert len(fleet._restarts_pending) <= 1
+            assert fleet.replicas or fleet._restarts_pending
+            await asyncio.sleep(0.06)  # past the 0.05 backoff ceiling
+            await fleet.health_tick()
+        # failures stop: the pending probe revives the fleet
+        for _ in range(100):
+            if fleet.healthy_replicas():
+                break
+            await asyncio.sleep(0.02)
+            await fleet.health_tick()
+        router = ReplicaRouter(fleet)
+        res = await router.submit(GenRequest(
+            request_id="revived", tokens=[5, 9, 2, 7], max_new_tokens=4,
+        ))
+        assert res.generated == _baseline(model, variables, [5, 9, 2, 7], 4)
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_router_passes_unlimited_timeout_through(tiny_model):
+    """timeout_s=0 means NO deadline end to end: the router must not let
+    the batcher re-mint its default deadline for the failover-capable
+    path (a regression a review caught)."""
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(model, variables, replicas=1)
+        await fleet.start()
+        router = ReplicaRouter(fleet, default_timeout_s=60)
+        r0 = fleet.replicas["r0"]
+        task = asyncio.ensure_future(router.submit(
+            GenRequest(request_id="nolimit", tokens=[5, 9, 2, 7],
+                       max_new_tokens=24),
+            timeout_s=0,
+        ))
+        pend: list = []
+        for _ in range(400):
+            pend = r0.batcher._queue + list(r0.batcher._inflight.values())
+            if pend:
+                break
+            await asyncio.sleep(0.002)
+        assert pend and pend[0].deadline is None  # unlimited survived
+        res = await task
+        assert res.finish_reason == "length"
+        await fleet.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Drain: in-flight lanes finish, admissions stop
+# ---------------------------------------------------------------------------
+
+
+def test_drain_finishes_inflight_and_blocks_new_admissions(tiny_model):
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(model, variables, replicas=2)
+        await fleet.start()
+        router = ReplicaRouter(fleet)
+        rids = sorted(fleet.replicas)
+        victim = fleet.replicas[rids[0]]
+        # park a long request on the victim directly
+        task = asyncio.ensure_future(victim.batcher.submit(GenRequest(
+            request_id="inflight", tokens=[5, 9, 2, 7], max_new_tokens=24,
+        )))
+        for _ in range(200):  # admitted (or mid-admission) on the victim
+            if victim.batcher._inflight:
+                break
+            await asyncio.sleep(0.01)
+        assert victim.batcher._inflight
+        drained = await fleet.drain_replica(rids[0], reason="test")
+        assert drained  # in-flight lane finished inside the budget
+        res = await task
+        assert res.finish_reason == "length"
+        assert res.generated == _baseline(model, variables, [5, 9, 2, 7], 24)
+        # the drained replica is gone; new traffic lands on the survivor
+        assert rids[0] not in fleet.replicas
+        res2 = await router.submit(GenRequest(
+            request_id="after-drain", tokens=[7, 7, 7], max_new_tokens=4,
+        ))
+        assert res2.replica_id == rids[1]
+        assert fleet.stats()["drains_total"] == 1
+        # monotonic aggregates: the drained replica's tokens are not lost
+        assert fleet.stats()["tokens_generated_total"] >= 24
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_drain_bounces_queued_requests_to_survivor(tiny_model):
+    """Requests still QUEUED on a draining replica never ran — they bounce
+    with ReplicaUnavailable and the router completes them on a survivor."""
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(model, variables, replicas=2)
+        await fleet.start()
+        router = ReplicaRouter(fleet, failover_retries=2)
+        rids = sorted(fleet.replicas)
+        victim = fleet.replicas[rids[0]]
+        # fill the victim's lanes, then queue one more behind them
+        lane_tasks = [
+            asyncio.ensure_future(victim.batcher.submit(GenRequest(
+                request_id=f"lane{i}", tokens=[5, 9, 2, 7],
+                max_new_tokens=24,
+            )))
+            for i in range(ENGINE_CFG["slots"])
+        ]
+        await asyncio.sleep(0.05)
+        queued = asyncio.ensure_future(router.submit(GenRequest(
+            request_id="queued", tokens=[2, 13], max_new_tokens=4,
+        )))
+        await asyncio.sleep(0.02)
+        await fleet.drain_replica(rids[0], reason="test")
+        res = await queued
+        assert res.generated == _baseline(model, variables, [2, 13], 4)
+        for t in lane_tasks:
+            assert (await t).finish_reason == "length"
+        await fleet.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Rollover: zero downtime, traffic shifts to the new generation
+# ---------------------------------------------------------------------------
+
+
+def test_rollover_zero_downtime_and_traffic_shift(tiny_model):
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(model, variables, replicas=2)
+        await fleet.start()
+        router = ReplicaRouter(fleet)
+        gen0 = set(fleet.replicas)
+        # sustained trickle of traffic THROUGH the rollover
+        failures: list[BaseException] = []
+        results: list = []
+
+        async def traffic():
+            i = 0
+            while len(results) + len(failures) < 30:
+                try:
+                    results.append(await router.submit(GenRequest(
+                        request_id=f"t{i}", tokens=PROMPTS[i % len(PROMPTS)],
+                        max_new_tokens=4,
+                    )))
+                except Exception as exc:  # noqa: BLE001 - the assertion target
+                    failures.append(exc)
+                i += 1
+
+        stream = asyncio.ensure_future(traffic())
+        await asyncio.sleep(0.05)
+        await fleet.rollover(model, variables)
+        await stream
+        assert not failures, f"rollover dropped requests: {failures[:3]}"
+        for res in results:
+            want = _baseline(
+                model, variables, res.prompt_tokens, len(res.generated)
+            )
+            assert res.generated == want
+        # old generation fully drained; fleet is generation 1
+        assert not (gen0 & set(fleet.replicas))
+        stats = fleet.stats()
+        assert stats["generation"] == 1
+        assert stats["rollovers_total"] == 1
+        assert stats["replicas_healthy"] == 2
+        # post-rollover traffic decodes on the new generation only
+        res = await router.submit(GenRequest(
+            request_id="post", tokens=[5, 9, 2, 7], max_new_tokens=4,
+        ))
+        assert res.replica_id in set(fleet.replicas) - gen0
+        await fleet.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Failover deadline semantics (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_failover_keeps_original_deadline_and_drops_once(tiny_model):
+    """A request re-enqueued on a survivor keeps its ORIGINAL deadline (the
+    survivor's pending entry carries the same absolute instant), and the
+    post-failover deadline drop decrements slot/queue gauges exactly once
+    across the fleet."""
+    model, variables = tiny_model
+
+    async def main():
+        # survivor r1 is wedged from its first step: the failed-over request
+        # can never finish there, so only its ORIGINAL deadline can end it
+        fault = ServeFaultInjector(
+            ServeFault(replica_id="r1", at_step=0, mode="stall")
+        )
+        fleet = _fleet(model, variables, fault=fault, stall_timeout_s=60)
+        await fleet.start()
+        router = ReplicaRouter(fleet, failover_retries=2)
+        r0 = fleet.replicas["r0"]
+        r1 = fleet.replicas["r1"]
+        timeout_s = 1.2
+        t0 = time.monotonic()
+        task = asyncio.ensure_future(router.submit(
+            GenRequest(request_id="doomed", tokens=[5, 9, 2, 7],
+                       max_new_tokens=24),
+            timeout_s=timeout_s,
+        ))
+        # the request lands on r0 (r1 idle, tie broken by id) — kill r0
+        # once it is in flight there (admission may pay a prefill compile)
+        for _ in range(100):
+            if r0.batcher._inflight:
+                break
+            await asyncio.sleep(0.005)
+        assert r0.batcher._inflight
+        await fleet.fail_replica("r0", error="test kill", restart=False)
+        # failed over to r1 with the ORIGINAL absolute deadline
+        pend: list = []
+        for _ in range(100):
+            pend = list(r1.batcher._inflight.values()) + r1.batcher._queue
+            if pend:
+                break
+            await asyncio.sleep(0.005)
+        assert len(pend) == 1
+        assert pend[0].deadline == pytest.approx(t0 + timeout_s, abs=0.1)
+        with pytest.raises(DeadlineExceeded):
+            await task
+        elapsed = time.monotonic() - t0
+        # ended by the original deadline, NOT a fresh one minted at failover
+        # (a re-minted deadline could not expire before ~2x timeout_s)
+        assert elapsed < timeout_s + 0.5, elapsed
+        # the drop was accounted exactly once fleet-wide, and the gauges
+        # returned to baseline (no leaked slot/queue occupancy)
+        stats = fleet.stats()
+        assert stats["deadline_drops_total"] == 1
+        assert stats["queue_depth"] == 0
+        assert stats["slots_busy"] == 0
+        assert r1.engine.free_slots == r1.engine.config.slots
+        await fleet.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Router: idempotent request ids, shedding
+# ---------------------------------------------------------------------------
+
+
+def test_router_duplicate_request_id_never_double_decodes(tiny_model):
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(model, variables, replicas=1)
+        await fleet.start()
+        router = ReplicaRouter(fleet)
+        req = GenRequest(request_id="dup", tokens=[5, 9, 2, 7],
+                         max_new_tokens=6)
+        # concurrent duplicates attach to ONE in-flight attempt
+        a, b = await asyncio.gather(router.submit(req), router.submit(req))
+        assert a.generated == b.generated
+        assert router.duplicates_suppressed_total == 1
+        tokens_after = fleet.stats()["tokens_generated_total"]
+        assert tokens_after == 6  # decoded once, not twice
+        # a replay after completion returns the cached result, no decode
+        c = await router.submit(req)
+        assert c.generated == a.generated
+        assert fleet.stats()["tokens_generated_total"] == tokens_after
+        assert router.duplicates_suppressed_total == 2
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_router_sheds_with_retry_after_when_all_queues_full(tiny_model):
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(
+            model, variables, replicas=1,
+            batcher_kwargs={"max_queue": 0},
+        )
+        await fleet.start()
+        router = ReplicaRouter(fleet)
+        with pytest.raises(QueueFull) as exc_info:
+            await router.submit(GenRequest(
+                request_id="shed", tokens=[1, 2], max_new_tokens=2,
+            ))
+        assert exc_info.value.retry_after_s >= 1.0
+        assert router.shed_total == 1
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_router_no_healthy_replica_is_503_shaped(tiny_model):
+    model, variables = tiny_model
+
+    async def main():
+        fleet = _fleet(model, variables, replicas=1)
+        await fleet.start()
+        router = ReplicaRouter(fleet)
+        await fleet.fail_replica("r0", error="gone", restart=False)
+        with pytest.raises(FleetUnavailable):
+            await router.submit(GenRequest(
+                request_id="x", tokens=[1], max_new_tokens=2,
+            ))
+        await fleet.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Retry-After estimation (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_after_derived_from_queue_depth_and_decode_rate(tiny_model):
+    model, variables = tiny_model
+
+    async def main():
+        eng = BatchEngine(model, variables, EngineConfig(**ENGINE_CFG))
+        b = Batcher(eng, max_queue=64)
+        assert b.retry_after_s() == 1.0  # no signal yet: the floor
+        for i in range(4):
+            await b.submit(GenRequest(
+                request_id=f"w{i}", tokens=[5, 9, 2, 7], max_new_tokens=8,
+            ))
+        base = b.retry_after_s()
+        assert base >= 1.0
+        # a (much) deeper queue means a later retry hint: deep enough that
+        # the estimate clears the 1 s floor regardless of box speed
+        b._queue = [object()] * 5000  # type: ignore[assignment]
+        deep = b.retry_after_s()
+        assert deep > base
+        assert deep <= 120.0
+        b._queue = []
+        await b.close()
+
+    run_async(main())
+
+
+@pytest.mark.slow  # HTTP loop; runs in ci_check serve-chaos-fast/serve-fast
+def test_http_429_carries_retry_after_header(tmp_path):
+    from test_api import _client
+    from test_serve import _fabricate_promoted_job, _serve_runtime
+
+    async def main():
+        rt = _serve_runtime(tmp_path)
+        rt.settings.serve_max_queue = 0
+        client = await _client(rt, with_monitor=False)
+        job_id = await _fabricate_promoted_job(rt)
+        r = await client.post(f"/api/v1/admin/serve/{job_id}/load")
+        assert r.status == 200
+        r = await client.post(
+            f"/api/v1/jobs/{job_id}/generate",
+            json={"tokens": [1, 2], "max_new_tokens": 2},
+        )
+        assert r.status == 429
+        assert int(r.headers["Retry-After"]) >= 1
+        assert (await r.json())["retry_after_s"] >= 1
+        await client.close()
+
+    run_async(main())
+
+
+def test_ctl_generate_honors_retry_after_once(capsys):
+    """`ftc-ctl generate` backs off for the server's Retry-After and retries
+    exactly once — a second 429 surfaces."""
+    import argparse
+
+    from finetune_controller_tpu.controller import ctl
+
+    calls = {"n": 0}
+
+    class StubClient:
+        async def post(self, path, json=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ctl.ApiError("POST -> 429: busy", status=429,
+                                   retry_after_s=0.01)
+            return {"job_id": "j", "tokens": [1, 2], "request_id": "r"}
+
+    ns = argparse.Namespace(
+        job_id="j", tokens="5,9", max_new_tokens=None, temperature=None,
+        top_k=None, eos_id=None, seed=None,
+    )
+    rc = run_async(ctl.cmd_generate(StubClient(), ns))
+    assert rc == 0
+    assert calls["n"] == 2
+    out = capsys.readouterr()
+    assert '"tokens"' in out.out
+    assert "retrying once" in out.err
+
+    # a 429 with no Retry-After (or a non-429) is NOT retried
+    calls["n"] = 0
+
+    class AlwaysBusy(StubClient):
+        async def post(self, path, json=None):
+            calls["n"] += 1
+            raise ctl.ApiError("POST -> 429: busy", status=429)
+
+    with pytest.raises(ctl.ApiError):
+        run_async(ctl.cmd_generate(AlwaysBusy(), ns))
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Concurrent loads: one winner (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # loader + HTTP runtime; runs in ci_check serve stages
+def test_concurrent_loads_resolve_to_one_winner(tmp_path, monkeypatch):
+    from test_api import _runtime
+    from test_serve import _fabricate_promoted_job
+
+    from finetune_controller_tpu.serve import service as service_mod
+
+    async def main():
+        rt = _runtime(tmp_path)
+        rt.settings.serve_slots = 4
+        rt.settings.serve_prompt_buckets = [8, 16]
+        rt.settings.serve_max_new_tokens = 32
+        await rt.state.connect()
+        job_id = await _fabricate_promoted_job(rt)
+        real = service_mod.load_promoted
+        loads = {"n": 0}
+
+        async def counting_load(*args, **kw):
+            loads["n"] += 1
+            await asyncio.sleep(0.05)  # widen the race window
+            return await real(*args, **kw)
+
+        monkeypatch.setattr(service_mod, "load_promoted", counting_load)
+        manager = service_mod.ServeManager(
+            rt.state, rt.store, rt.settings
+        )
+        rt.serve = manager  # rt.close() tears the sessions down
+        meta1, meta2 = await asyncio.gather(
+            manager.load(job_id), manager.load(job_id)
+        )
+        # ONE winner staged and loaded; the loser attached to its future
+        assert loads["n"] == 1
+        assert meta1 is meta2 or meta1 == meta2
+        assert len(manager.sessions) == 1
+        # the session serves
+        result, _meta = await manager.generate(job_id, GenRequest(
+            request_id="g", tokens=[5, 9, 2, 7], max_new_tokens=4,
+        ))
+        assert len(result.generated) == 4
+        # a follow-up load of the SAME artifact is idempotent: the peek
+        # pre-check answers from a store LISTING — no re-download, no
+        # rollover, no extra loader call
+        meta3 = await manager.load(job_id)
+        assert meta3["checkpoint_step"] == meta1["checkpoint_step"]
+        assert manager.sessions[job_id].fleet.generation == 0
+        assert loads["n"] == 1
+        await rt.close()
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Autoscale round-trip: serve as a preemptible scheduler tenant
+# ---------------------------------------------------------------------------
+
+
+def _catalog(quota=4):
+    from finetune_controller_tpu.controller.devices import (
+        DeviceCatalog,
+        DeviceFlavor,
+        FlavorQuota,
+    )
+
+    return DeviceCatalog(
+        flavors=[DeviceFlavor(
+            name="chip", generation="cpu", hosts=1, chips_per_host=1,
+            runtime="cpu", queue="q",
+        )],
+        quotas=[FlavorQuota(flavor="chip", nominal_chips=quota)],
+        default_flavor="chip",
+    )
+
+
+def test_autoscale_grow_shrink_and_training_reclaims_in_one_tick(tiny_model):
+    """The ISSUE 10 autoscale round-trip: queue-depth pressure grows the
+    fleet through scheduler admissions, idleness shrinks it via DRAIN, and
+    the reclaimed chips admit a training tenant within one scheduler tick."""
+    from finetune_controller_tpu.sched import FairShareScheduler
+    from finetune_controller_tpu.sched.serve_tenant import (
+        ServeScalePolicy,
+        ServeTenant,
+    )
+
+    model, variables = tiny_model
+
+    async def main():
+        sched = FairShareScheduler(
+            _catalog(quota=4), {"serve": 1.0, "train": 1.0},
+        )
+        fleet = _fleet(model, variables, replicas=1)
+        await fleet.start()
+        depth = {"value": 0}
+        tenant = ServeTenant(
+            sched, fleet, flavor="chip", queue="serve",
+            policy=ServeScalePolicy(
+                min_replicas=1, max_replicas=3,
+                scale_up_queue_depth=2, sustain_ticks=1, idle_ticks=1,
+            ),
+            drive_admission=True,
+            queue_depth_fn=lambda: depth["value"],
+        )
+        await tenant.attach_initial()
+        # --- grow under sustained queue pressure --------------------------
+        depth["value"] = 12
+        for _ in range(8):
+            await tenant.tick()
+            if fleet.stats()["replicas_healthy"] == 3:
+                break
+        assert fleet.stats()["replicas_healthy"] == 3
+        assert tenant.scale_ups_total >= 2
+        # serve now holds 3 of 4 chips in the scheduler's accounting
+        used = sum(
+            1 for wl in tenant._workloads.values()
+            if sched.is_admitted(wl.workload_id)
+        )
+        assert used == 3
+        # --- idle: shrink via drain (never kill) --------------------------
+        depth["value"] = 0
+        for _ in range(8):
+            await tenant.tick()
+            if fleet.stats()["replicas_healthy"] == 1:
+                break
+        assert fleet.stats()["replicas_healthy"] == 1
+        assert fleet.drains_total >= 2  # scale-down went through drain
+        assert tenant.scale_downs_total >= 2
+        # --- training reclaims the freed chips in ONE tick ----------------
+        sched.submit("train-big", "chip", 3, queue="train",
+                     priority="normal")
+        admitted = sched.try_admit()
+        assert any(w.job_id == "train-big" for w in admitted)
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_preempted_serve_workload_drains_inflight_then_releases(tiny_model):
+    """A training tenant preempting a serve replica triggers a DRAIN — the
+    replica's in-flight request completes — and the released chips admit
+    the preemptor on the next pass."""
+    from finetune_controller_tpu.sched import FairShareScheduler
+    from finetune_controller_tpu.sched.serve_tenant import (
+        ServeScalePolicy,
+        ServeTenant,
+    )
+
+    model, variables = tiny_model
+
+    async def main():
+        sched = FairShareScheduler(
+            _catalog(quota=2), {"serve": 1.0, "train": 1.0},
+        )
+        fleet = _fleet(model, variables, replicas=2)
+        await fleet.start()
+        tenant = ServeTenant(
+            sched, fleet, flavor="chip", queue="serve", priority="low",
+            policy=ServeScalePolicy(min_replicas=1, max_replicas=2,
+                                    scale_up_queue_depth=10**6),
+            drive_admission=True,
+        )
+        await tenant.attach_initial()
+        sched.try_admit()  # both serve workloads admitted: cluster full
+        # park a long request on each replica
+        router = ReplicaRouter(fleet)
+        tasks = [
+            asyncio.ensure_future(router.submit(GenRequest(
+                request_id=f"long{i}", tokens=[5, 9, 2, 7],
+                max_new_tokens=24,
+            )))
+            for i in range(2)
+        ]
+        await asyncio.sleep(0.05)
+        # higher-priority training job wants a chip -> plans a preemption
+        sched.submit("train-1", "chip", 1, queue="train", priority="normal")
+        sched.try_admit()
+        summary = await tenant.tick()
+        assert summary["preempted"], "no serve workload was preempted"
+        # the drain let the in-flight request finish (never killed)
+        for t in tasks:
+            res = await t
+            assert res.finish_reason == "length"
+        assert fleet.stats()["replicas_healthy"] == 1
+        assert tenant.preempted_total == 1
+        # the preemptor admits now that the chips are released (the tick's
+        # own admission pass may already have done it)
+        sched.try_admit()
+        assert sched.is_admitted("train-1")
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_local_backend_skips_serve_owned_workloads():
+    """The local backend's admission pass must leave serve-owned workloads
+    alone: no tombstone FAILED report, no release — their lifecycle belongs
+    to the serve tenant."""
+    from finetune_controller_tpu.sched import FairShareScheduler
+
+    class FakeBackend:
+        """Just the _admit_pending-relevant surface."""
+
+    async def main():
+        from finetune_controller_tpu.controller.backends.local import (
+            LocalProcessBackend,
+        )
+
+        sched = FairShareScheduler(_catalog(quota=2), {"serve": 1.0})
+        backend = LocalProcessBackend.__new__(LocalProcessBackend)
+        backend.scheduler = sched
+        backend._handles = {}
+        backend._lost = {}
+        backend._closing = False
+        sched.submit("serve-j-w0", "chip", 1, queue="serve", owner="serve")
+        backend._admit_pending()
+        assert sched.is_admitted("serve-j-w0")  # admitted, NOT released
+        assert backend._lost == {}  # and no tombstone
+
+    run_async(main())
+
+
+def test_take_preemptions_owner_filter():
+    """take_preemptions(owner=...) routes each plane its own victims and
+    leaves the other plane's decisions pending."""
+    from finetune_controller_tpu.sched import FairShareScheduler
+
+    sched = FairShareScheduler(
+        _catalog(quota=2), {"serve": 1.0, "train": 4.0},
+    )
+    sched.submit("serve-w0", "chip", 1, queue="serve", priority="low",
+                 owner="serve")
+    sched.submit("train-old", "chip", 1, queue="train", priority="low")
+    sched.try_admit()
+    sched.submit("train-new", "chip", 2, queue="train", priority="high")
+    sched.try_admit()
+    pending = list(sched._pending_preemptions)
+    assert {d.job_id for d in pending} == {"serve-w0", "train-old"}
+    train_side = sched.take_preemptions(owner="train")
+    assert {d.job_id for d in train_side} == {"train-old"}
+    serve_side = sched.take_preemptions(owner="serve")
+    assert {d.job_id for d in serve_side} == {"serve-w0"}
+    assert sched.take_preemptions() == []
