@@ -164,8 +164,42 @@ class Settings(BaseModel):
     #: only explicit POST /admin/serve/{job}/load serves traffic)
     serve_autoload: bool = True
     #: fold LoRA deltas into the base kernels at load (dense-model matmul
-    #: count; int4-quantized bases always serve unmerged)
+    #: count; int4-quantized bases always serve unmerged).  Ignored when
+    #: serve_max_adapters > 0: multi-tenant serving needs the pristine base,
+    #: so the loaded job's own adapter becomes tenant #1 instead of merging
     serve_merge_lora: bool = True
+
+    # --- Paged KV cache (docs/serving.md §Paged KV) ---
+    #: page the serve KV cache: lanes hold fixed-size pages proportional to
+    #: their actual length instead of reserving cache_len slots at admit —
+    #: memory stops capping concurrency (vLLM-style; PAPERS.md).  Greedy and
+    #: sampled outputs are bit-identical to the unpaged path
+    serve_paged_kv: bool = False
+    #: sequence positions per KV page; smaller pages pack mixed-length lanes
+    #: tighter, larger pages cut page-table overhead.  Divides the cache
+    #: length (max bucket + serve_max_new_tokens) for the tightest layout
+    serve_kv_page_tokens: int = 16
+    #: total pool pages per replica INCLUDING the reserved scratch page;
+    #: 0 auto-sizes to the unpaged capacity (slots * pages-per-lane + 1) —
+    #: set it LOWER to actually oversubscribe memory, which is the point:
+    #: admission reserves worst-case pages, so a full pool backpressures
+    #: (429 + Retry-After) instead of OOMing mid-decode
+    serve_kv_pool_pages: int = 0
+
+    # --- Multi-tenant adapters (docs/serving.md §Multi-tenant adapters) ---
+    #: tenant adapters multiplexable per served base model (0 = off): LoRA
+    #: jobs serve UNMERGED on a shared base fleet, each lane applying its
+    #: request's adapter via a gathered batched einsum — N tenants per base
+    #: model on the same chips.  When on, the base job loads unmerged and
+    #: its own adapter auto-registers as the first tenant
+    serve_max_adapters: int = 0
+    #: adapter stack rank ceiling; tenants trained at lower rank zero-pad
+    #: (bit-neutral), higher-rank adapters are refused at load
+    serve_adapter_rank: int = 32
+    #: deficit-round-robin admission quantum (token cost credited to every
+    #: waiting tenant per round) — fairness knob: one hot tenant cannot
+    #: starve the rest of the batch
+    serve_drr_quantum_tokens: int = 256
 
     # --- Serve fleet (docs/serving.md §Fleet, failover, and drain) ---
     #: replicas per served job (each a full engine+batcher stack behind the

@@ -303,26 +303,47 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
         return 0
     header = (f"{'JOB':<24} {'REPL':>5} {'SLOTS':>7} {'QUEUE':>5} "
               f"{'TOKENS':>8} {'HITS':>5} {'MISS':>5} {'SAVED':>8} "
-              f"{'CACHE_MB':>8}")
+              f"{'CACHE_MB':>8} {'PAGES':>9} {'ADPT':>4}")
     print(header)
     for job_id, s in sorted(sessions.items()):
         slots = f"{s['slots_busy']}/{s['slots_total']}"
         repl = f"{s.get('replicas_healthy', 1)}/{s.get('replicas_total', 1)}"
         cache_mb = s.get("prefix_cache_bytes", 0) / (1 << 20)
+        # paged KV occupancy (used/total across replicas; '-' = unpaged)
+        pages_total = s.get("kv_pages_total", 0)
+        pages = (f"{s.get('kv_pages_used', 0)}/{pages_total}"
+                 if pages_total else "-")
         print(
             f"{job_id:<24} {repl:>5} {slots:>7} {s['queue_depth']:>5} "
             f"{s['tokens_generated_total']:>8} "
             f"{s.get('prefix_hits_total', 0):>5} "
             f"{s.get('prefix_misses_total', 0):>5} "
-            f"{s.get('prefill_tokens_saved_total', 0):>8} {cache_mb:>8.1f}"
+            f"{s.get('prefill_tokens_saved_total', 0):>8} {cache_mb:>8.1f} "
+            f"{pages:>9} {s.get('adapters_loaded', 0):>4}"
         )
         for rid, r in sorted((s.get("replicas") or {}).items()):
+            rpages = (f" pages {r.get('kv_pages_used', 0)}/"
+                      f"{r.get('kv_pages_total', 0)}"
+                      if r.get("kv_pages_total") else "")
             print(
                 f"  {rid:<10} gen{r.get('generation', 0):<3} "
                 f"{r.get('state', '?'):<9} "
                 f"slots {r.get('slots_busy', 0)}/{r.get('slots_total', 0)} "
                 f"queue {r.get('queue_depth', 0)} "
-                f"tokens {r.get('tokens_generated_total', 0)}"
+                f"tokens {r.get('tokens_generated_total', 0)}{rpages}"
+            )
+        # one row per multiplexed tenant: slot, live lanes, queue, tokens
+        adapters = s.get("adapters") or {}
+        tokens_by = s.get("tokens_by_tenant") or {}
+        lanes_by = s.get("lanes_by_tenant") or {}
+        queue_by = s.get("queue_depth_by_tenant") or {}
+        for aid, a in sorted(adapters.items()):
+            print(
+                f"  @{aid:<22} slot{a.get('slot', '?'):<3} "
+                f"r{a.get('rank', '?'):<3} "
+                f"lanes {lanes_by.get(aid, 0)} "
+                f"queue {queue_by.get(aid, 0)} "
+                f"tokens {tokens_by.get(aid, 0)}"
             )
         extras = []
         for label, key in (("failovers", "failovers_total"),
@@ -388,6 +409,8 @@ async def cmd_generate(client: Client, ns: argparse.Namespace) -> int:
         body["eos_id"] = ns.eos_id
     if ns.seed is not None:
         body["seed"] = ns.seed
+    if getattr(ns, "adapter", None):
+        body["adapter"] = ns.adapter
     try:
         result = await client.post(f"/jobs/{ns.job_id}/generate", json=body)
     except ApiError as exc:
@@ -512,6 +535,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--top-k", type=int, default=None)
     s.add_argument("--eos-id", type=int, default=None)
     s.add_argument("--seed", type=int, default=None)
+    s.add_argument("--adapter", default=None,
+                   help="decode with this multiplexed tenant adapter (a "
+                        "LoRA job id loaded via /admin/serve/.../adapters; "
+                        "docs/serving.md §Multi-tenant adapters)")
     s = sub.add_parser("dev-token")
     s.add_argument("user_id", nargs="?", default="dev")
     return p

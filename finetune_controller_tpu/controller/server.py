@@ -1152,6 +1152,17 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
              "duplicates_suppressed_total"),
             ("ftc_serve_shed_total", "counter", "shed_total"),
             ("ftc_serve_step_errors_total", "counter", "step_errors_total"),
+            # paged KV pool (docs/serving.md §Paged KV) — zeros when unpaged
+            ("ftc_serve_kv_pages_total", "gauge", "kv_pages_total"),
+            ("ftc_serve_kv_pages_free", "gauge", "kv_pages_free"),
+            ("ftc_serve_kv_pages_used", "gauge", "kv_pages_used"),
+            ("ftc_serve_kv_pages_shared", "gauge", "kv_pages_shared"),
+            ("ftc_serve_kv_cow_copies_total", "counter",
+             "kv_cow_copies_total"),
+            ("ftc_serve_kv_pool_exhaustions_total", "counter",
+             "kv_pool_exhaustions_total"),
+            # multi-tenant adapters (docs/serving.md §Multi-tenant adapters)
+            ("ftc_serve_adapters_loaded", "gauge", "adapters_loaded"),
         )
         lines.append("# TYPE ftc_serve_models_loaded gauge")
         lines.append(f"ftc_serve_models_loaded {len(sessions)}")
@@ -1160,7 +1171,30 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
             for job_id, stats in sorted(sessions.items()):
                 lines.append(
                     f'{metric}{{job_id="{prom_escape(job_id)}"}} '
-                    f"{stats[stat_key]}"
+                    f"{stats.get(stat_key, 0)}"
+                )
+        # per-tenant series — bounded cardinality: loaded adapters only
+        # ("" = the base model, labeled "base")
+        tenant_gauges = (
+            ("ftc_serve_tenant_tokens_total", "counter", "tokens_by_tenant"),
+            ("ftc_serve_tenant_lanes", "gauge", "lanes_by_tenant"),
+            ("ftc_serve_tenant_queue_depth", "gauge",
+             "queue_depth_by_tenant"),
+        )
+        for metric, kind, stat_key in tenant_gauges:
+            series = [
+                (job_id, tenant, value)
+                for job_id, stats in sorted(sessions.items())
+                for tenant, value in sorted(
+                    (stats.get(stat_key) or {}).items())
+            ]
+            if not series:
+                continue
+            lines.append(f"# TYPE {metric} {kind}")
+            for job_id, tenant, value in series:
+                lines.append(
+                    f'{metric}{{job_id="{prom_escape(job_id)}",'
+                    f'adapter="{prom_escape(tenant or "base")}"}} {value}'
                 )
     # preference-optimization gauges (docs/preference.md): surfaced from the
     # newest synced metrics row of every ACTIVE dpo/rlhf job — reward margin

@@ -145,6 +145,21 @@ class LlamaConfig:
     #: bias terms on the q/k/v projections (Qwen-2 family; o_proj and the
     #: MLP stay bias-free there, matching the HF architecture)
     attention_qkv_bias: bool = False
+    # --- serving-only knobs (inert at 0; never set by training specs) ------
+    #: paged KV cache (docs/serving.md §Paged KV): sequence positions per
+    #: page. When > 0 together with ``kv_pool_pages``, the decode-path cache
+    #: becomes a shared (P, page_tokens, Hkv, D) page pool per layer,
+    #: addressed through the per-lane ``page_table`` argument — lanes hold
+    #: pages proportional to their length instead of ``max_seq_len`` slots.
+    kv_page_tokens: int = 0
+    #: total pages P in the pool (page 0 is the scratch page)
+    kv_pool_pages: int = 0
+    #: multi-tenant unmerged-LoRA serving: stacked adapter slots (slot 0 =
+    #: base model) applied per batch row via the ``adapter_ids`` argument
+    #: (``models/lora.py``); 0 disables the tenant branch entirely
+    lora_tenant_slots: int = 0
+    #: stacked adapter rank ceiling (smaller trained ranks are zero-padded)
+    lora_tenant_rank: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -405,6 +420,8 @@ def _proj(cfg: LlamaConfig, name: str, features: int) -> LoRADense:
         param_dtype=cfg.param_dtype,
         quantize_base=cfg.quantize_base,
         quant_block=cfg.quant_block,
+        tenant_slots=cfg.lora_tenant_slots,
+        tenant_rank=cfg.lora_tenant_rank,
     )
 
 
@@ -413,13 +430,13 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids, deterministic=True,
-                 decode=False):
+                 decode=False, page_table=None, adapter_ids=None):
         cfg = self.cfg
         b, s, _ = x.shape
         hd = cfg.head_dim
-        q = _proj(cfg, "q_proj", cfg.n_heads * hd)(x, deterministic)
-        k = _proj(cfg, "k_proj", cfg.n_kv_heads * hd)(x, deterministic)
-        v = _proj(cfg, "v_proj", cfg.n_kv_heads * hd)(x, deterministic)
+        q = _proj(cfg, "q_proj", cfg.n_heads * hd)(x, deterministic, adapter_ids)
+        k = _proj(cfg, "k_proj", cfg.n_kv_heads * hd)(x, deterministic, adapter_ids)
+        v = _proj(cfg, "v_proj", cfg.n_kv_heads * hd)(x, deterministic, adapter_ids)
         inv_freqs = rope_inv_freqs(cfg)
         q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions,
                        inv_freqs=inv_freqs)
@@ -427,7 +444,8 @@ class Attention(nn.Module):
                        inv_freqs=inv_freqs)
         v = v.reshape(b, s, cfg.n_kv_heads, hd)
         if decode:
-            return self._decode_attention(q, k, v, deterministic)
+            return self._decode_attention(q, k, v, deterministic,
+                                          page_table, adapter_ids)
         q = checkpoint_name(q, "attn_qkv")
         k = checkpoint_name(k, "attn_qkv")
         v = checkpoint_name(v, "attn_qkv")
@@ -436,10 +454,12 @@ class Attention(nn.Module):
             tuning=cfg.kernel_tuning(),
         )
         out = checkpoint_name(out, "attn_ctx")
-        out = _proj(cfg, "o_proj", cfg.d_model)(out.reshape(b, s, -1), deterministic)
+        out = _proj(cfg, "o_proj", cfg.d_model)(
+            out.reshape(b, s, -1), deterministic, adapter_ids)
         return checkpoint_name(out, "attn_o")
 
-    def _decode_attention(self, q, k, v, deterministic):
+    def _decode_attention(self, q, k, v, deterministic, page_table=None,
+                          adapter_ids=None):
         """KV-cached generation path (``models/generate.py`` fill-then-decode).
 
         A static-length cache (``cfg.max_seq_len`` slots) lives in the flax
@@ -469,6 +489,10 @@ class Attention(nn.Module):
 
         cfg = self.cfg
         b, s, _, hd = q.shape
+        if cfg.kv_page_tokens and cfg.kv_pool_pages:
+            return self._paged_decode_attention(
+                q, k, v, deterministic, page_table, adapter_ids
+            )
         m = cfg.max_seq_len
         fresh = not self.has_variable("cache", "k")
         ck = self.variable(
@@ -510,19 +534,79 @@ class Attention(nn.Module):
             ci.value = jnp.minimum(idx + 1, m)
             out = single_token_attention(q, ck.value, cv.value, idx)
         return _proj(cfg, "o_proj", cfg.d_model)(
-            out.reshape(b, s, -1), deterministic)
+            out.reshape(b, s, -1), deterministic, adapter_ids)
+
+    def _paged_decode_attention(self, q, k, v, deterministic, page_table,
+                                adapter_ids):
+        """Decode-path attention through a shared KV page pool
+        (docs/serving.md §Paged KV).
+
+        The cache collection holds one (P, T, Hkv, D) page pool per layer —
+        batch-size independent, shared by every lane — plus the per-row
+        ``index``; which pages belong to which lane arrives as the
+        ``page_table`` (B, MP) argument the serve engine passes into every
+        jitted call (``serve/kv_pages.py`` owns the allocator).  One code
+        path serves prefill (index 0), suffix prefill continuing a spliced
+        prefix (index = reuse length), and the decode step (S = 1): the
+        chunk's K/V scatter to ``(table[pos // T], pos % T)`` and attention
+        gathers the lane's logical cache back through the table
+        (``ops.attention.paged_cache_attention``) — bit-equal to the
+        contiguous cache because masked slots (including anything read
+        through an unmaterialized table entry's scratch page) contribute an
+        exact 0.0 to the softmax.
+
+        Write positions clamp to the last logical slot and the index
+        saturates, mirroring the unpaged branch: a parked lane (all-scratch
+        table, index 0) rides every step writing throwaway tokens into the
+        scratch page that no live lane ever reads unmasked.
+        """
+        from ..ops.attention import paged_cache_attention
+
+        cfg = self.cfg
+        b, s, _, hd = q.shape
+        t, p = cfg.kv_page_tokens, cfg.kv_pool_pages
+        if page_table is None:
+            raise ValueError(
+                "paged KV decode (kv_page_tokens > 0) requires the "
+                "page_table argument"
+            )
+        ck = self.variable(
+            "cache", "k",
+            lambda: jnp.zeros((p, t, cfg.n_kv_heads, hd), cfg.dtype))
+        cv = self.variable(
+            "cache", "v",
+            lambda: jnp.zeros((p, t, cfg.n_kv_heads, hd), cfg.dtype))
+        ci = self.variable("cache", "index",
+                           lambda: jnp.zeros((b,), jnp.int32))
+        cap = page_table.shape[-1] * t
+        idx = ci.value  # (B,) — every lane at its own position
+        pos = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        pos_w = jnp.minimum(pos, cap - 1)
+        phys = jnp.take_along_axis(page_table, pos_w // t, axis=1)  # (B, S)
+        off = pos_w % t
+        ck.value = ck.value.at[phys, off].set(k.astype(cfg.dtype))
+        cv.value = cv.value.at[phys, off].set(v.astype(cfg.dtype))
+        ci.value = jnp.minimum(idx + s, cap)
+        out = paged_cache_attention(q, ck.value, cv.value, page_table, idx)
+        return _proj(cfg, "o_proj", cfg.d_model)(
+            out.reshape(b, s, -1), deterministic, adapter_ids)
 
 
 class MLP(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, adapter_ids=None):
         cfg = self.cfg
-        gate = checkpoint_name(_proj(cfg, "gate_proj", cfg.d_ff)(x, deterministic), "mlp_gate")
-        up = checkpoint_name(_proj(cfg, "up_proj", cfg.d_ff)(x, deterministic), "mlp_up")
+        gate = checkpoint_name(
+            _proj(cfg, "gate_proj", cfg.d_ff)(x, deterministic, adapter_ids),
+            "mlp_gate")
+        up = checkpoint_name(
+            _proj(cfg, "up_proj", cfg.d_ff)(x, deterministic, adapter_ids),
+            "mlp_up")
         act = nn.gelu if cfg.mlp_act == "gelu" else nn.silu  # GeGLU | SwiGLU
-        out = _proj(cfg, "down_proj", cfg.d_model)(act(gate) * up, deterministic)
+        out = _proj(cfg, "down_proj", cfg.d_model)(
+            act(gate) * up, deterministic, adapter_ids)
         return checkpoint_name(out, "mlp_down")
 
 
@@ -531,10 +615,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids, deterministic=True,
-                 decode=False):
+                 decode=False, page_table=None, adapter_ids=None):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, cfg.norm_offset, name="attn_norm")(x)
-        x = x + Attention(cfg, name="attn")(h, positions, segment_ids, deterministic, decode)
+        x = x + Attention(cfg, name="attn")(
+            h, positions, segment_ids, deterministic, decode,
+            page_table, adapter_ids)
         h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, cfg.norm_offset, name="mlp_norm")(x)
         if cfg.n_experts:
             from .moe import MoEMLP
@@ -552,7 +638,7 @@ class Block(nn.Module):
                 name="moe",
             )(h, deterministic)
         else:
-            mlp_out = MLP(cfg, name="mlp")(h, deterministic)
+            mlp_out = MLP(cfg, name="mlp")(h, deterministic, adapter_ids)
         return x + mlp_out
 
 
@@ -641,9 +727,10 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids, deterministic=True,
-                 decode=False):
+                 decode=False, page_table=None, adapter_ids=None):
         y = Block(self.cfg, name="block")(
-            x, positions, segment_ids, deterministic, decode
+            x, positions, segment_ids, deterministic, decode,
+            page_table, adapter_ids
         )
         return y, None
 
@@ -653,7 +740,8 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, segment_ids=None,
-                 deterministic=True, decode=False):
+                 deterministic=True, decode=False, page_table=None,
+                 adapter_ids=None):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
@@ -684,12 +772,15 @@ class LlamaForCausalLM(nn.Module):
                 )
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "lora": 0, "moe_aux": 0, "cache": 0},
+                variable_axes={"params": 0, "lora": 0, "moe_aux": 0,
+                               "cache": 0, "tenants": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.n_layers,
             )(cfg, name="blocks")
-            x, _ = stack(x, positions, segment_ids, deterministic, decode)
+            x, _ = stack(x, positions, segment_ids, deterministic, decode,
+                         page_table, adapter_ids)
         else:
             block_cls = (
                 nn.remat(Block, prevent_cse=False, static_argnums=(4, 5), policy=policy)
@@ -698,7 +789,8 @@ class LlamaForCausalLM(nn.Module):
             )
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
-                    x, positions, segment_ids, deterministic, decode)
+                    x, positions, segment_ids, deterministic, decode,
+                    page_table, adapter_ids)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.param_dtype, cfg.norm_offset, name="final_norm")(x)
         if cfg.tie_embeddings:

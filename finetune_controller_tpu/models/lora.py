@@ -54,9 +54,21 @@ class LoRADense(nn.Module):
     #: store the frozen base kernel as blockwise int4 (QLoRA — models/quant.py)
     quantize_base: bool = False
     quant_block: int = 64
+    #: multi-tenant serving (docs/serving.md §Multi-tenant adapters): when
+    #: > 0, a ``"tenants"`` collection holds ``tenant_slots`` stacked
+    #: per-tenant adapters — ``lora_a (N, in, r)``, ``lora_b (N, r, out)``,
+    #: ``scale (N,)`` — and each batch row applies the adapter named by its
+    #: entry in the per-row ``adapter_ids`` vector via a gathered batched
+    #: einsum.  Slot 0 is the base model (all-zero stack, scale 0 — the
+    #: delta is an exact 0.0).  Tenants whose trained rank is below
+    #: ``tenant_rank`` are zero-padded: the extra rank columns/rows
+    #: contribute exactly nothing, so the padded math is bit-equal to the
+    #: unpadded adapter.
+    tenant_slots: int = 0
+    tenant_rank: int = 0
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, adapter_ids=None):
         in_features = x.shape[-1]
         if self.quantize_base:
             from .quant import quantized_param
@@ -99,4 +111,29 @@ class LoRADense(nn.Module):
                 h = nn.Dropout(rate=self.lora_dropout, deterministic=False)(h)
             scale = self.lora_alpha / self.lora_rank
             y = y + (h @ a.astype(self.dtype)) @ b.astype(self.dtype) * scale
+        if self.tenant_slots > 0 and adapter_ids is not None:
+            # per-row tenant adapters: y_b += scale[t_b] * (x_b @ A[t_b]) @
+            # B[t_b] with t = adapter_ids — the unmerged-LoRA multiplexing
+            # math (same eval order as the single-adapter branch above, so a
+            # one-tenant registry reproduces it exactly up to the gather)
+            n, r = self.tenant_slots, max(1, self.tenant_rank)
+            ta = self.variable(
+                "tenants", "lora_a",
+                lambda *_: jnp.zeros((n, in_features, r), self.param_dtype),
+                None,
+            ).value
+            tb = self.variable(
+                "tenants", "lora_b",
+                lambda *_: jnp.zeros((n, r, self.features), self.param_dtype),
+                None,
+            ).value
+            ts = self.variable(
+                "tenants", "scale",
+                lambda *_: jnp.zeros((n,), self.param_dtype),
+                None,
+            ).value
+            ids = adapter_ids.astype(jnp.int32)
+            ha = jnp.einsum("bsi,bir->bsr", x, ta[ids].astype(self.dtype))
+            delta = jnp.einsum("bsr,bro->bso", ha, tb[ids].astype(self.dtype))
+            y = y + delta * ts[ids].astype(self.dtype)[:, None, None]
         return y

@@ -116,6 +116,53 @@ def single_token_attention(
     return chunked_cache_attention(q, k_cache, v_cache, idx)
 
 
+def paged_gather(pool: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Assemble per-row logical KV caches from a shared page pool.
+
+    ``pool`` is (P, T, Hkv, D) — P fixed-size pages of T sequence positions
+    each, shared by every decode lane; ``page_table`` is (B, MP) int32
+    physical page ids, row ``b`` listing the pages that hold lane ``b``'s
+    positions ``[i*T, (i+1)*T)``.  Returns the gathered (B, MP*T, Hkv, D)
+    logical cache — a compute-time temporary the attention below consumes;
+    the *resident* KV is only ever the pool, which is what lets lanes hold
+    pages proportional to their actual length instead of a full-length
+    reservation (``serve/kv_pages.py``).
+
+    Table slots a lane has not materialized yet point at the scratch page
+    (id 0); whatever bytes they gather sit at positions beyond the lane's
+    cache index and are masked to an exact-zero softmax contribution.
+    """
+    b, mp = page_table.shape
+    _, t, hkv, d = pool.shape
+    return pool[page_table].reshape(b, mp * t, hkv, d)
+
+
+def paged_cache_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    idx: jax.Array,
+) -> jax.Array:
+    """:func:`chunked_cache_attention` reading through a page table.
+
+    Gather-based paged attention: the per-lane logical caches are gathered
+    from the shared pools and the exact :func:`chunked_cache_attention`
+    numerics run over them, so a paged decode/suffix-prefill is bit-identical
+    to the unpaged one whenever the gathered length equals the contiguous
+    cache length (the engine sizes ``MP*T == cache_len`` when the page size
+    divides it; otherwise the tail positions are masked exact-zeros like any
+    other beyond-index slot).  S = 1 is the decode step, S > 1 a
+    (bucket-padded) prefill or suffix prefill.
+    """
+    return chunked_cache_attention(
+        q,
+        paged_gather(k_pool, page_table),
+        paged_gather(v_pool, page_table),
+        idx,
+    )
+
+
 def _check_block(name: str, raw) -> int:
     try:
         val = int(raw)

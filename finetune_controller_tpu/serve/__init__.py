@@ -4,29 +4,44 @@ The lifecycle closer: the reference hands promoted artifacts to an unnamed
 external inference stack (SURVEY.md §3.4); this package serves them.
 
 * :mod:`engine`  — slot-based batch decode over the flax ``cache`` collection
-  (fixed decode slots, bucketed prefill, bounded compile count);
-* :mod:`batcher` — asyncio admission queue with backpressure + deadlines;
+  (fixed decode slots, bucketed prefill, bounded compile count), with an
+  optional paged KV layout where lanes hold pool pages proportional to their
+  actual length (docs/serving.md §Paged KV);
+* :mod:`kv_pages` — the page pool's host-side allocator: free list,
+  copy-on-write refcounts, reservation-backed admission control;
+* :mod:`adapters` — multi-tenant unmerged-LoRA registry: stacked per-tenant
+  adapters multiplexed on one base fleet via per-lane adapter ids;
+* :mod:`batcher` — asyncio admission queue with backpressure + deadlines +
+  per-tenant deficit-round-robin fairness;
 * :mod:`fleet`   — N health-checked replicas per served job: stall/fault
   detection, restart with resilience backoff, graceful drain, zero-downtime
   checkpoint rollover;
 * :mod:`router`  — spreads requests over the fleet with failover retries,
   idempotent request ids (exactly-once), and Retry-After load shedding;
-* :mod:`loader`  — promoted-checkpoint resolution/loading + LoRA merge;
+* :mod:`loader`  — promoted-checkpoint resolution/loading + LoRA merge +
+  adapter-only staging for multi-tenant fleets;
 * :mod:`service` — aiohttp routes mounted on the controller server.
 """
 
+from .adapters import AdapterRegistry, UnknownAdapter
 from .engine import BatchEngine, EngineConfig, GenRequest, GenResult
 from .fleet import Replica, ReplicaFleet, ReplicaState
+from .kv_pages import KVPagePool, PageRun, PoolExhausted
 from .router import FleetUnavailable, ReplicaRouter
 
 __all__ = [
+    "AdapterRegistry",
     "BatchEngine",
     "EngineConfig",
     "FleetUnavailable",
     "GenRequest",
     "GenResult",
+    "KVPagePool",
+    "PageRun",
+    "PoolExhausted",
     "Replica",
     "ReplicaFleet",
     "ReplicaRouter",
     "ReplicaState",
+    "UnknownAdapter",
 ]

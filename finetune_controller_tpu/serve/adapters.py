@@ -1,0 +1,208 @@
+"""Multi-tenant LoRA adapter registry (docs/serving.md §Multi-tenant adapters).
+
+The millions-of-users move (ROADMAP item 2): instead of one merged-weights
+replica set per promoted job, ONE base-model fleet serves N fine-tuned
+tenants by keeping the adapters unmerged — every LoRA-targeted projection
+carries stacked per-tenant ``(A, B, alpha/rank)`` tensors in the model's
+``"tenants"`` collection (``models/lora.py``), and the decode step applies
+each lane's adapter through a gathered batched einsum over the per-row
+``adapter_ids`` vector (the same per-row trick as the PR-4 cache index).
+
+This module owns the host side: slot assignment (slot 0 is the base model —
+an all-zero stack whose delta is an exact 0.0), rank padding (tenants train
+at different ranks; smaller ones zero-pad to the stack rank, which is
+bit-neutral), and the functional device writes that install or clear one
+tenant's slot in an engine's tenants tree.  Stacks are FIXED capacity
+(``serve_max_adapters``), so registering a tenant is a device write, never a
+shape change — the decode step never recompiles for tenant churn.
+
+One registry serves a whole replica fleet; each replica engine holds its own
+device copy of the stacks and is synced by the fleet on register/unregister,
+spawn, and rollover (``serve/fleet.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class AdapterError(ValueError):
+    """Registration refused (capacity, rank, shape mismatch)."""
+
+
+class UnknownAdapter(ValueError):
+    """A request named an adapter this registry has not loaded."""
+
+
+@dataclasses.dataclass
+class AdapterEntry:
+    adapter_id: str
+    slot: int
+    tree: Any                     # host-side lora collection pytree
+    alpha: float
+    rank: int
+    meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return getattr(last, "key", getattr(last, "name", ""))
+
+
+def _subtree(tree: Any, path) -> Any:
+    """Follow a tree_map_with_path prefix into ``tree`` (None when absent)."""
+    node = tree
+    for part in path:
+        key = getattr(part, "key", getattr(part, "name", ""))
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    return node
+
+
+def install_into(tenants: Any, slot: int, adapter_tree: Any | None,
+                 alpha: float, rank: int) -> Any:
+    """Write one tenant's (rank-padded) adapter into stack slot ``slot`` of a
+    device tenants tree; ``adapter_tree=None`` clears the slot (zero stack,
+    scale 0).  Functional — returns the new tree; callers swap the engine's
+    reference atomically so an in-flight decode step keeps its snapshot.
+
+    Stack leaves carry the tenant axis at ``ndim - 3`` for ``lora_a``
+    (N, in, R) / ``lora_b`` (N, R, out) and ``ndim - 1`` for ``scale`` (N,),
+    with scanned models adding a leading layer axis to each.  Projections
+    the adapter does not target stay zero — their delta is an exact 0.0.
+    """
+    import jax
+
+    def fix(path, stack):
+        name = _leaf_name(path)
+        if name not in ("lora_a", "lora_b", "scale"):  # pragma: no cover
+            return stack
+        if name == "scale":
+            value = (alpha / rank) if adapter_tree is not None else 0.0
+            return stack.at[..., slot].set(np.asarray(value, stack.dtype))
+        n_axis = stack.ndim - 3
+        slot_shape = stack.shape[:n_axis] + stack.shape[n_axis + 1:]
+        padded = np.zeros(slot_shape, np.float32)
+        leaf = None
+        if adapter_tree is not None:
+            sub = _subtree(adapter_tree, path[:-1])
+            leaf = sub.get(name) if isinstance(sub, dict) else None
+        if leaf is not None:
+            leaf = np.asarray(leaf, np.float32)
+            try:
+                if name == "lora_a":     # (..., in, r) -> (..., in, R)
+                    padded[..., : leaf.shape[-1]] = leaf
+                else:                    # (..., r, out) -> (..., R, out)
+                    padded[..., : leaf.shape[-2], :] = leaf
+            except (ValueError, IndexError) as e:
+                raise AdapterError(
+                    f"adapter leaf {'/'.join(str(getattr(p, 'key', p)) for p in path)} "
+                    f"shape {leaf.shape} does not fit stack slot {slot_shape} "
+                    f"(wrong base model or rank > stack rank?): {e}"
+                ) from None
+        index = (slice(None),) * n_axis + (slot,)
+        return stack.at[index].set(padded.astype(stack.dtype))
+
+    return jax.tree_util.tree_map_with_path(fix, tenants)
+
+
+class AdapterRegistry:
+    """Slot assignment + host copies for one served base model.
+
+    ``capacity`` counts stack slots INCLUDING the reserved base slot 0, so a
+    registry built from ``serve_max_adapters=4`` has capacity 5.
+    """
+
+    def __init__(self, capacity: int, max_rank: int):
+        if capacity < 2:
+            raise ValueError("adapter registry needs capacity >= 2 "
+                             "(slot 0 is the base model)")
+        if max_rank < 1:
+            raise ValueError("adapter stack rank must be >= 1")
+        self.capacity = int(capacity)
+        self.max_rank = int(max_rank)
+        self._entries: dict[str, AdapterEntry] = {}
+        self._free_slots = list(range(self.capacity - 1, 0, -1))
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_slots)
+
+    def resolve(self, adapter_id: str) -> int:
+        """Stack slot for ``adapter_id`` ('' = the base model, slot 0)."""
+        if not adapter_id:
+            return 0
+        entry = self._entries.get(adapter_id)
+        if entry is None:
+            raise UnknownAdapter(
+                f"adapter {adapter_id!r} is not loaded on this fleet "
+                f"(loaded: {sorted(self._entries) or 'none'})"
+            )
+        return entry.slot
+
+    def get(self, adapter_id: str) -> AdapterEntry | None:
+        return self._entries.get(adapter_id)
+
+    def entries(self) -> list[AdapterEntry]:
+        return sorted(self._entries.values(), key=lambda e: e.slot)
+
+    def register(self, adapter_id: str, lora_tree: Any, alpha: float,
+                 rank: int, meta: dict[str, Any] | None = None) -> AdapterEntry:
+        """Assign (or re-use, for a tenant checkpoint rollover) a slot and
+        record the host copy.  Device installation is the fleet's job —
+        every replica engine applies :func:`install_into` with this entry."""
+        if not adapter_id:
+            raise AdapterError("adapter id must be non-empty")
+        if rank < 1 or rank > self.max_rank:
+            raise AdapterError(
+                f"adapter rank {rank} outside [1, {self.max_rank}] "
+                f"(raise serve_adapter_rank to stack higher ranks)"
+            )
+        existing = self._entries.get(adapter_id)
+        if existing is not None:
+            slot = existing.slot  # in-place refresh: tenant rollover
+        elif self._free_slots:
+            slot = self._free_slots.pop()
+        else:
+            raise AdapterError(
+                f"adapter registry full ({self.capacity - 1} tenant slots); "
+                "unload an adapter or raise serve_max_adapters"
+            )
+        entry = AdapterEntry(
+            adapter_id=adapter_id, slot=slot, tree=lora_tree,
+            alpha=float(alpha), rank=int(rank), meta=dict(meta or {}),
+        )
+        self._entries[adapter_id] = entry
+        logger.info("adapter %s registered in slot %d (rank %d, alpha %s)",
+                    adapter_id, slot, rank, alpha)
+        return entry
+
+    def unregister(self, adapter_id: str) -> AdapterEntry:
+        entry = self._entries.pop(adapter_id, None)
+        if entry is None:
+            raise UnknownAdapter(f"adapter {adapter_id!r} is not loaded")
+        self._free_slots.append(entry.slot)
+        logger.info("adapter %s unregistered (slot %d freed)",
+                    adapter_id, entry.slot)
+        return entry
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "capacity": self.capacity - 1,
+            "loaded": len(self._entries),
+            "adapters": {
+                e.adapter_id: {"slot": e.slot, "rank": e.rank,
+                               "alpha": e.alpha, **e.meta}
+                for e in self.entries()
+            },
+        }

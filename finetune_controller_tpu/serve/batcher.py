@@ -25,7 +25,18 @@ plane's event loop:
   still-queued requests with :class:`ReplicaUnavailable` (retryable on a
   survivor — they never touched a lane) and lets in-flight lanes finish
   before closing — the zero-downtime half of checkpoint rollover and of
-  scheduler-driven scale-down.
+  scheduler-driven scale-down;
+* **per-tenant fairness** (docs/serving.md §Multi-tenant adapters): the
+  queue is one FIFO per tenant (``GenRequest.adapter_id``; "" = the base
+  model) admitted by deficit round robin — each round every waiting tenant
+  earns ``drr_quantum_tokens`` of credit and admits requests while its
+  credit covers their token cost (prompt + max_new), so one hot tenant
+  flooding the queue cannot starve the others, while a single-tenant
+  workload degenerates to the original FIFO exactly;
+* the engine's :meth:`~finetune_controller_tpu.serve.engine.BatchEngine.
+  can_admit` gates admission, so paged-KV pool pressure keeps requests
+  QUEUED (and a full queue 429s with a derived ``Retry-After``) instead of
+  failing them mid-admission.
 """
 
 from __future__ import annotations
@@ -38,6 +49,7 @@ import time
 from typing import Any
 
 from .engine import BatchEngine, GenRequest, GenResult
+from .kv_pages import PoolExhausted
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +100,7 @@ class Batcher:
         max_wait_ms: float = 1000.0,
         default_timeout_s: float = 60.0,
         ttft_observe=None,
+        drr_quantum_tokens: float = 256.0,
     ):
         self.engine = engine
         self.max_queue = max_queue
@@ -98,7 +111,14 @@ class Batcher:
         #: observed at admission: the prefill that admits a request also
         #: produces its first token
         self.ttft_observe = ttft_observe
-        self._queue: list[_Pending] = []
+        #: deficit-round-robin quantum: token-cost credit every waiting
+        #: tenant earns per admission round (``serve_drr_quantum_tokens``)
+        self.drr_quantum_tokens = max(1.0, drr_quantum_tokens)
+        #: one FIFO per tenant, admitted by deficit round robin
+        self._queues: collections.OrderedDict[
+            str, collections.deque[_Pending]
+        ] = collections.OrderedDict()
+        self._deficit: dict[str, float] = {}
         self._inflight: dict[str, _Pending] = {}
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
@@ -123,7 +143,33 @@ class Batcher:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return sum(len(q) for q in self._queues.values())
+
+    def queue_depth_by_tenant(self) -> dict[str, int]:
+        return {t: len(q) for t, q in self._queues.items() if q}
+
+    def queued(self) -> list[_Pending]:
+        """Snapshot of everything queued, in per-tenant FIFO order."""
+        return [p for q in self._queues.values() for p in q]
+
+    def inflight_by_tenant(self) -> dict[str, int]:
+        """Requests registered in-flight (admitted OR mid-admission in the
+        worker thread) per tenant — the engine's lane view alone misses the
+        admission window, which matters to adapter-unload busy checks."""
+        out: dict[str, int] = {}
+        for p in self._inflight.values():
+            tenant = p.req.adapter_id or ""
+            out[tenant] = out.get(tenant, 0) + 1
+        return out
+
+    def _drain_queues(self) -> list[_Pending]:
+        """Pop everything queued (drain/close paths)."""
+        out: list[_Pending] = []
+        for q in self._queues.values():
+            out.extend(q)
+        self._queues.clear()
+        self._deficit.clear()
+        return out
 
     @property
     def slots_busy(self) -> int:
@@ -154,13 +200,12 @@ class Batcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
-        for p in self._queue + list(self._inflight.values()):
+        for p in self._drain_queues() + list(self._inflight.values()):
             if not p.future.done():
                 p.future.set_exception(
                     exc if exc is not None
                     else DeadlineExceeded("server shutting down")
                 )
-        self._queue.clear()
         self._inflight.clear()
 
     async def drain(self, timeout_s: float = 30.0) -> bool:
@@ -170,7 +215,7 @@ class Batcher:
         then close.  Returns True when every in-flight request completed
         within ``timeout_s`` (stragglers past it fail retryably too)."""
         self._draining = True
-        bounced, self._queue = self._queue, []
+        bounced = self._drain_queues()
         for p in bounced:
             if not p.future.done():
                 p.future.set_exception(ReplicaUnavailable(
@@ -207,7 +252,7 @@ class Batcher:
             return 1.0
         steps_per_s = (len(self._step_stamps) - 1) / span
         lanes = max(1, self.engine.config.slots)
-        work_steps = (len(self._queue) + extra_requests) * self._avg_request_steps
+        work_steps = (self.queue_depth + extra_requests) * self._avg_request_steps
         eta = work_steps / (steps_per_s * lanes)
         return min(120.0, max(1.0, eta))
 
@@ -226,7 +271,7 @@ class Batcher:
             raise ReplicaUnavailable("replica is draining")
         if self._closed:
             raise QueueFull("batcher is closed")
-        if len(self._queue) >= self.max_queue:
+        if self.queue_depth >= self.max_queue:
             self.rejected_total += 1
             raise QueueFull(
                 f"admission queue at capacity ({self.max_queue}); retry later",
@@ -242,7 +287,10 @@ class Batcher:
             enqueued_at=now,
             deadline=deadline,
         )
-        self._queue.append(pending)
+        tenant = req.adapter_id or ""
+        if tenant not in self._queues:
+            self._queues[tenant] = collections.deque()
+        self._queues[tenant].append(pending)
         self.start()
         self._wake.set()
         return await pending.future
@@ -251,17 +299,23 @@ class Batcher:
 
     def _drop_expired(self) -> None:
         now = time.monotonic()
-        keep: list[_Pending] = []
-        for p in self._queue:
-            if p.deadline is not None and now > p.deadline:
-                self.deadline_drops_total += 1
-                if not p.future.done():
-                    p.future.set_exception(DeadlineExceeded(
-                        f"request {p.req.request_id} spent its deadline queued"
-                    ))
+        for tenant, q in list(self._queues.items()):
+            keep = collections.deque()
+            for p in q:
+                if p.deadline is not None and now > p.deadline:
+                    self.deadline_drops_total += 1
+                    if not p.future.done():
+                        p.future.set_exception(DeadlineExceeded(
+                            f"request {p.req.request_id} spent its deadline "
+                            "queued"
+                        ))
+                else:
+                    keep.append(p)
+            if keep:
+                self._queues[tenant] = keep
             else:
-                keep.append(p)
-        self._queue = keep
+                self._queues.pop(tenant, None)
+                self._deficit.pop(tenant, None)
         for rid, p in list(self._inflight.items()):
             if p.deadline is not None and now > p.deadline:
                 result = self.engine.evict(rid)
@@ -273,6 +327,73 @@ class Batcher:
                     ))
                 if result is not None:
                     logger.info("evicted %s after %d tokens", rid, result.steps)
+
+    @staticmethod
+    def _cost(req: GenRequest) -> float:
+        """DRR token cost: the work a request buys (prompt prefill + decode
+        budget)."""
+        return float(len(req.tokens) + req.max_new_tokens)
+
+    def _select_admissions(self, budget: int) -> list[_Pending]:
+        """Deficit-round-robin pick of up to ``budget`` admittable requests.
+
+        Every round, each tenant with queued work earns ``drr_quantum_tokens``
+        of credit and admits head-of-line requests while the credit covers
+        their cost AND the engine can take them (free lane + paged-pool
+        slack) — a blocked head (pool pressure) stays queued without
+        consuming credit, and the rotation moves on so other tenants keep
+        flowing.  A tenant's credit resets when its queue empties: deficits
+        only ever accumulate toward the NEXT request in line, never into a
+        burst allowance.
+        """
+        picked: list[_Pending] = []
+        if budget <= 0:
+            return picked
+        quantum = self.drr_quantum_tokens
+        # pages already promised to this batch: the engine only RESERVES at
+        # admit time (in the worker thread), so the gate must account for
+        # the whole batch, not each request against the same free pool
+        planned_pages = 0
+        while len(picked) < budget:
+            progress = False
+            blocked_only = True
+            for tenant in list(self._queues.keys()):
+                q = self._queues.get(tenant)
+                if not q:
+                    continue
+                served = False
+                self._deficit[tenant] = self._deficit.get(tenant, 0.0) + quantum
+                while q and len(picked) < budget:
+                    head = q[0]
+                    cost = self._cost(head.req)
+                    if self._deficit[tenant] < cost:
+                        blocked_only = False  # still earning credit
+                        break
+                    if not self.engine.can_admit(head.req, planned_pages):
+                        # pool/lane pressure: stays queued, credit capped to
+                        # the head's cost so waiting never banks a burst
+                        self._deficit[tenant] = min(self._deficit[tenant], cost)
+                        break
+                    q.popleft()
+                    self._deficit[tenant] -= cost
+                    planned_pages += self.engine.admission_pages(head.req)
+                    picked.append(head)
+                    progress = True
+                    served = True
+                if not q:
+                    self._queues.pop(tenant, None)
+                    self._deficit.pop(tenant, None)
+                elif served:
+                    # rotate a served tenant to the tail so the round robin
+                    # PERSISTS across drive iterations — with a small slot
+                    # budget per iteration, restarting the rotation from the
+                    # same tenant every time would starve the rest
+                    self._queues.move_to_end(tenant)
+            if not self._queues:
+                break
+            if not progress and blocked_only:
+                break  # every head is engine-blocked; wait for a step
+        return picked
 
     def _admit_and_step(self, to_admit: list[_Pending]):
         """Worker-thread body: admissions (prefill — a first-use XLA compile
@@ -302,9 +423,7 @@ class Batcher:
         worker thread so the control plane's event loop stays responsive."""
         while not self._closed:
             self._drop_expired()
-            to_admit: list[_Pending] = []
-            while self._queue and self.engine.free_slots > len(to_admit):
-                to_admit.append(self._queue.pop(0))
+            to_admit = self._select_admissions(self.engine.free_slots)
             if not to_admit and not self._inflight:
                 self._wake.clear()
                 try:
@@ -334,9 +453,18 @@ class Batcher:
                             self.ttft_observe(now - p.enqueued_at)
                         except Exception:
                             logger.debug("ttft observe failed", exc_info=True)
+            bounced: list[_Pending] = []
             for p, done, exc in admitted:
                 rid = p.req.request_id
-                if exc is not None:
+                if isinstance(exc, PoolExhausted):
+                    # defense in depth: the selection gate should prevent
+                    # this, but a transient exhaustion is BACKPRESSURE, not
+                    # a request failure — put it back at the head of its
+                    # tenant's queue and let pages free up
+                    self._inflight.pop(rid, None)
+                    if not p.future.done():
+                        bounced.append(p)
+                elif exc is not None:
                     self._inflight.pop(rid, None)
                     if not p.future.done():
                         p.future.set_exception(exc)
@@ -352,6 +480,14 @@ class Batcher:
                     # filled — nobody is waiting on it
                     self._inflight.pop(rid, None)
                     self.engine.evict(rid)
+            # reinsert pool-bounced requests at the head of their tenant
+            # queues IN ARRIVAL ORDER (reversed appendleft: the first
+            # bounced request must end up first in line again)
+            for p in reversed(bounced):
+                tenant = p.req.adapter_id or ""
+                if tenant not in self._queues:
+                    self._queues[tenant] = collections.deque()
+                self._queues[tenant].appendleft(p)
             for result in finished:
                 p = self._inflight.pop(result.request_id, None)
                 self.completed_total += 1
@@ -389,6 +525,7 @@ class Batcher:
             )
 
     def stats(self) -> dict[str, Any]:
+        pages = self.engine.kv_page_stats()
         return {
             "queue_depth": self.queue_depth,
             "slots_busy": self.slots_busy,
@@ -406,4 +543,21 @@ class Batcher:
             "prefill_tokens_saved_total": self.engine.prefill_tokens_saved_total,
             "prefix_cache_bytes": self.engine.prefix_cache_bytes,
             "prefix_cache_entries": self.engine.prefix_cache_entries,
+            # paged KV pool (docs/serving.md §Paged KV) — zeros when unpaged
+            "kv_pages_total": pages.get("pages_total", 0),
+            "kv_pages_free": pages.get("pages_free", 0),
+            "kv_pages_used": pages.get("pages_used", 0),
+            "kv_pages_shared": pages.get("pages_shared", 0),
+            "kv_page_bytes": pages.get("page_bytes", 0),
+            "kv_cow_copies_total": pages.get("cow_copies_total", 0),
+            "kv_pool_exhaustions_total": pages.get(
+                "pool_exhaustions_total", 0),
+            # multi-tenant adapters (docs/serving.md §Multi-tenant adapters)
+            "adapters_loaded": (
+                len(self.engine.adapters)
+                if self.engine.adapters is not None else 0
+            ),
+            "queue_depth_by_tenant": self.queue_depth_by_tenant(),
+            "lanes_by_tenant": self.engine.active_by_tenant(),
+            "tokens_by_tenant": dict(self.engine.tokens_by_tenant),
         }

@@ -6,40 +6,51 @@ a new request joins mid-flight instead of waiting for the batch to drain
 (the VirtualFlow idea: request slots decoupled from physical batch shape, so
 traffic shape never changes the compiled program).
 
+Two memory regimes for the KV cache (docs/serving.md §Paged KV):
+
+* **unpaged** (the PR-4/6 layout): every lane owns a contiguous
+  ``cache_len`` stripe of the batch cache, reserved at admit time whatever
+  the request's actual length;
+* **paged** (``EngineConfig.page_tokens > 0``): the cache is a shared pool
+  of fixed-size pages (``serve/kv_pages.py``) addressed through per-lane
+  page tables that ride into every jitted call — a lane materializes pages
+  as its tokens actually arrive (prompt pages at admit, one page per
+  ``page_tokens`` decode steps after), eviction frees them immediately, and
+  the prefix cache stores page RUNS shared copy-on-write instead of
+  full-shape snapshots.  Admission reserves a request's worst-case page
+  count up front, so growth can never OOM mid-flight: a pool too full to
+  host a request is backpressure (:class:`~finetune_controller_tpu.serve.
+  kv_pages.PoolExhausted` → the batcher keeps it queued → a full queue is a
+  429 with ``Retry-After``), never a crash.
+
+Multi-tenant unmerged-LoRA multiplexing (docs/serving.md §Multi-tenant
+adapters, ``EngineConfig.tenant_slots > 0``): the model's ``"tenants"``
+collection stacks per-tenant adapters and each lane's adapter is selected by
+the per-row ``adapter_ids`` vector the engine passes alongside the batch —
+N fine-tuned tenants share one base-model engine, and the prefix cache keys
+namespaces by adapter id so one tenant's KV never splices into another's.
+
 Compile-count contract (armed with ``analysis.recompile_guard``):
 
-* prefill compiles once per **prompt bucket** (prompts are right-padded to
-  the smallest configured bucket that fits; causality makes the pad slots
-  invisible to the real tokens);
-* with the prefix cache enabled, suffix prefill (``fill_from``) compiles
-  once per prompt bucket too — suffixes pad to the same bucket table, so
-  the budget grows by exactly ``len(prompt_buckets)``;
-* the decode step compiles **once**, at ``(slots, 1)``, regardless of how
-  many requests come and go.
+* unpaged: prefill compiles once per **prompt bucket** (+ once more per
+  bucket for the prefix-reuse suffix prefill when the cache is on); the
+  decode step compiles **once** at ``(slots, 1)``;
+* paged: ONE prefill program serves fresh prompts and suffix continuations
+  alike (the page table makes them the same shape), so the budget is
+  ``len(prompt_buckets) + 1`` with or without the prefix cache.
 
 Two host↔device traffic rules keep the hot path hot (docs/performance.md):
+prefix reuse (``serve/prefix_cache.py``) and on-device token selection (the
+decode step returns a ``(slots,)`` int32 token vector, never the logits).
 
-* **prefix reuse** (``serve/prefix_cache.py``): ``admit`` resolves the
-  longest cached prefix of the prompt, splices that B=1 KV snapshot into
-  the lane, and prefills only the suffix — shared system prompts stop
-  recomputing prefill;
-* **on-device token selection**: the decode step returns a ``(slots,)``
-  int32 token vector (in-graph argmax for greedy; in-graph ``_sample``
-  walking stacked per-lane PRNG keys for temperature > 0), so the per-step
-  device→host transfer is ``slots*4 + slots*8`` bytes instead of
-  ``slots*vocab*4``.  Host keeps only eos/length bookkeeping.
-
-Correctness anchor (proved in ``tests/test_serve.py``): greedy output for
-any request is bit-identical to single-request
-:func:`~finetune_controller_tpu.models.generate.cached_generate`, no matter
-what else shares the batch.  Three properties make that hold:
-
-* every per-row op in the decode path (matmul rows, RMSNorm, RoPE, the
-  per-row-masked ``single_token_attention``) is independent of other rows;
-* masked cache slots contribute exactly 0.0 to the softmax (the f32-min
-  fill underflows ``exp`` to zero), so a bucketed cache length is invisible;
-* the per-row cache index (``models/llama.py::_decode_attention``) lets each
-  lane write and attend at its own position.
+Correctness anchor (proved in ``tests/test_serve.py`` /
+``tests/test_kv_pages.py``): greedy output for any request is bit-identical
+to single-request :func:`~finetune_controller_tpu.models.generate.
+cached_generate`, no matter what else shares the batch, whether the cache is
+paged or not.  Per-row ops are independent of other rows; masked cache slots
+(including anything gathered through an unmaterialized page-table entry's
+scratch page) contribute exactly 0.0 to the softmax; and the per-row cache
+index lets each lane write and attend at its own position.
 
 MoE configs are refused: expert-capacity routing couples rows through the
 shared capacity budget, so batching invariance cannot hold there.
@@ -60,6 +71,13 @@ import numpy as np
 
 from ..analysis.recompile_guard import RecompileGuard
 from ..models.generate import _sample
+from .adapters import (
+    AdapterRegistry,
+    UnknownAdapter,
+    _leaf_name,
+    install_into,
+)
+from .kv_pages import KVPagePool, PageRun, PoolExhausted
 from .prefix_cache import PrefixCache, resolve_reuse_length
 
 logger = logging.getLogger(__name__)
@@ -89,14 +107,42 @@ class EngineConfig:
     #: (``serve/prefix_cache.py``; ``serve_prefix_cache_mb`` in Settings)
     prefix_cache_bytes: int = 0
     #: compile budget: defaults to len(prompt_buckets) + 1 (the decode step),
-    #: or 2*len(prompt_buckets) + 1 with the prefix cache on (fill AND
-    #: fill_from per bucket); the guard RAISES past it — an unexpected
-    #: compile on the serve path is a latency bug, not a warning
+    #: or 2*len(prompt_buckets) + 1 with the prefix cache on AND paging off
+    #: (fill AND fill_from per bucket); the guard RAISES past it — an
+    #: unexpected compile on the serve path is a latency bug, not a warning
     recompile_budget: int = 0
+    #: paged KV (docs/serving.md §Paged KV): sequence positions per page;
+    #: 0 keeps the unpaged contiguous-lane layout
+    page_tokens: int = 0
+    #: total pool pages including the scratch page; 0 = auto-size to the
+    #: unpaged capacity (``slots * pages_per_lane + 1``) — set it lower to
+    #: actually oversubscribe memory, which is the point
+    pool_pages: int = 0
+    #: multi-tenant adapter stack slots INCLUDING base slot 0; 0 = off
+    tenant_slots: int = 0
+    #: stacked adapter rank ceiling (tenants pad up to it, bit-neutrally)
+    tenant_rank: int = 0
 
     @property
     def cache_len(self) -> int:
         return max(self.prompt_buckets) + self.max_new_tokens
+
+    @property
+    def paged(self) -> bool:
+        return self.page_tokens > 0
+
+    @property
+    def pages_per_lane(self) -> int:
+        """Page-table width: pages covering one full-length lane."""
+        if not self.page_tokens:
+            return 0
+        return -(-self.cache_len // self.page_tokens)
+
+    @property
+    def effective_pool_pages(self) -> int:
+        if not self.paged:
+            return 0
+        return self.pool_pages or (self.slots * self.pages_per_lane + 1)
 
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.prompt_buckets:
@@ -117,6 +163,9 @@ class GenRequest:
     top_k: int = 0
     eos_id: int | None = None
     seed: int = 0                      # sampling stream (temperature > 0)
+    #: multi-tenant serving: which loaded adapter decodes this request
+    #: ("" = the base model, stack slot 0)
+    adapter_id: str = ""
 
 
 @dataclasses.dataclass
@@ -142,6 +191,10 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     rng: Any = None                    # per-request sampling stream
     admitted_at: float = 0.0
+    # paged-mode bookkeeping (``serve/kv_pages.py``)
+    pages: list[int] = dataclasses.field(default_factory=list)
+    reserved: int = 0                  # booked-but-unmaterialized pages
+    adapter_id: str = ""               # tenant serving this lane
 
     @property
     def active(self) -> bool:
@@ -172,6 +225,7 @@ class BatchEngine:
         model: Any,
         variables: dict,
         config: EngineConfig | None = None,
+        adapters: AdapterRegistry | None = None,
     ):
         cfg = model.cfg
         if getattr(cfg, "n_experts", 0):
@@ -183,28 +237,74 @@ class BatchEngine:
             raise ValueError("BatchEngine serves text-only models (no pixels)")
         self.config = config or EngineConfig()
         self.variables = variables
+        # --- multi-tenant adapters -----------------------------------------
+        if adapters is None and self.config.tenant_slots > 0:
+            adapters = AdapterRegistry(
+                self.config.tenant_slots, max(1, self.config.tenant_rank)
+            )
+        self.adapters = adapters
+        tenant_slots = adapters.capacity if adapters is not None else 0
+        tenant_rank = adapters.max_rank if adapters is not None else 0
+        # --- paged KV pool --------------------------------------------------
+        self._pool: KVPagePool | None = None
+        pool_pages = self.config.effective_pool_pages
+        if self.config.paged:
+            if pool_pages - 1 < self.config.pages_per_lane:
+                raise ValueError(
+                    f"kv page pool too small: {pool_pages} pages cannot hold "
+                    f"one full lane ({self.config.pages_per_lane} pages of "
+                    f"{self.config.page_tokens} tokens)"
+                )
         self._dcfg = cfg.replace(
             remat=False, attention_impl="xla",
             max_seq_len=self.config.cache_len,
+            kv_page_tokens=self.config.page_tokens,
+            kv_pool_pages=pool_pages,
+            lora_tenant_slots=tenant_slots,
+            lora_tenant_rank=tenant_rank,
         )
         self._dmodel = type(model)(cfg=self._dcfg)
-        self._prefix_cache = (
-            PrefixCache(self.config.prefix_cache_bytes)
-            if self.config.prefix_cache_bytes > 0 else None
-        )
-        per_bucket = 2 if self._prefix_cache is not None else 1
+        per_bucket = 1
+        if self.config.prefix_cache_bytes > 0 and not self.config.paged:
+            per_bucket = 2  # fill + fill_from; paged mode has ONE fill
         budget = self.config.recompile_budget or (
             per_bucket * len(self.config.prompt_buckets) + 1
         )
         self.guard = RecompileGuard(budget, on_excess="raise",
                                     name="serve-engine")
         self._slots = [_Slot(lane=i) for i in range(self.config.slots)]
+        self._tenants: Any = {}
         self._cache = self._init_cache()
+        if self.config.paged:
+            page_bytes = sum(
+                leaf.nbytes // pool_pages
+                for path, leaf in
+                jax.tree_util.tree_leaves_with_path(self._cache)
+                if _leaf_name(path) in ("k", "v")
+            )
+            self._pool = KVPagePool(
+                pool_pages, self.config.page_tokens, page_bytes
+            )
+        self._prefix_cache = (
+            PrefixCache(self.config.prefix_cache_bytes, pool=self._pool)
+            if self.config.prefix_cache_bytes > 0 else None
+        )
+        # host masters for the per-call arguments: lane page tables (paged)
+        # and per-lane adapter slots (tenants) — tiny int32 arrays shipped
+        # into every jitted call, so admission/eviction never touches device
+        # state beyond the index park
+        self._tables = np.zeros(
+            (self.config.slots, max(1, self.config.pages_per_lane)), np.int32
+        )
+        self._adapter_slots = np.zeros((self.config.slots,), np.int32)
         # per-lane sampling streams, mirrored to the decode step as a
         # (slots, 2) uint32 leaf — rows for greedy lanes are inert
         self._rng_keys = np.zeros((self.config.slots, 2), np.uint32)
-        (self._fill, self._fill_from, self._decode,
-         self._insert, self._reset_lane) = self._build_fns()
+        (self._fill, self._fill_from, self._fill_paged, self._decode,
+         self._insert, self._set_lane_index, self._copy_page) = \
+            self._build_fns()
+        if self.adapters is not None:
+            self.sync_adapters()
         # counters the /metrics gauges read
         self.steps_total = 0
         self.tokens_generated_total = 0
@@ -212,38 +312,121 @@ class BatchEngine:
         self.prefix_hits_total = 0
         self.prefix_misses_total = 0
         self.prefill_tokens_saved_total = 0
+        #: per-tenant token counters ("" = base model)
+        self.tokens_by_tenant: dict[str, int] = {}
         self._prefix_warned = False
+
+    # ---- mode helpers -----------------------------------------------------
+
+    @property
+    def paged(self) -> bool:
+        return self._pool is not None
+
+    @property
+    def tenant_mode(self) -> bool:
+        return self.adapters is not None
+
+    def _tenants_arg(self):
+        return self._tenants
+
+    def _page_table_arg(self):
+        return jnp.asarray(self._tables) if self.paged else None
+
+    def _adapter_ids_arg(self):
+        return (jnp.asarray(self._adapter_slots)
+                if self.tenant_mode else None)
+
+    # ---- adapters ---------------------------------------------------------
+
+    def install_adapter(self, adapter_id: str) -> None:
+        """Write one registered tenant's (rank-padded) stacks into this
+        engine's device tenants tree — an atomic reference swap, safe to run
+        while a decode step is in flight on the previous tree."""
+        entry = self.adapters.get(adapter_id)
+        if entry is None:
+            raise UnknownAdapter(f"adapter {adapter_id!r} is not registered")
+        self._tenants = install_into(
+            self._tenants, entry.slot, entry.tree, entry.alpha, entry.rank
+        )
+
+    def remove_adapter(self, adapter_id: str, slot: int) -> None:
+        """Zero a departed tenant's slot and drop its prefix-cache namespace
+        (the slot id may be reused by a different tenant)."""
+        self._tenants = install_into(self._tenants, slot, None, 0.0, 1)
+        self.drop_prefix_namespace(adapter_id)
+
+    def drop_prefix_namespace(self, adapter_id: str) -> None:
+        """Evict every prefix-cache entry computed under ``adapter_id`` —
+        required whenever the tenant's WEIGHTS change (unload, and the
+        in-place refresh of a tenant rollover): KV produced by the old
+        deltas must never splice into lanes decoding with the new ones."""
+        if self._prefix_cache is not None:
+            self._prefix_cache.drop_namespace(adapter_id)
+
+    def sync_adapters(self) -> None:
+        """Install every registered tenant — fresh replicas and rollover
+        generations call this before taking traffic."""
+        for entry in self.adapters.entries():
+            self.install_adapter(entry.adapter_id)
+
+    def active_by_tenant(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for slot in self._slots:
+            if slot.active:
+                out[slot.adapter_id] = out.get(slot.adapter_id, 0) + 1
+        return out
 
     # ---- jitted pieces ----------------------------------------------------
 
     def _init_cache(self):
-        """Zero batch cache shaped by a throwaway (slots, 1) decode trace."""
+        """Zero batch cache shaped by a throwaway (slots, 1) decode trace
+        (paged mode: the page pools + per-lane index; tenant mode also
+        creates the zero adapter stacks)."""
         tokens = jnp.zeros((self.config.slots, 1), jnp.int32)
+        mutable = ("cache", "tenants") if self._dcfg.lora_tenant_slots \
+            else ("cache",)
+        kwargs: dict[str, Any] = {}
+        if self.config.paged:
+            kwargs["page_table"] = jnp.zeros(
+                (self.config.slots, self.config.pages_per_lane), jnp.int32
+            )
+        if self._dcfg.lora_tenant_slots:
+            kwargs["adapter_ids"] = jnp.zeros((self.config.slots,), jnp.int32)
         _, variables = self._dmodel.apply(
             self.variables, tokens,
             positions=jnp.zeros((self.config.slots, 1), jnp.int32),
-            deterministic=True, decode=True, mutable=("cache",),
+            deterministic=True, decode=True, mutable=mutable, **kwargs,
         )
+        if "tenants" in variables:
+            self._tenants = variables["tenants"]  # zeros: slot 0 = base
         return jax.tree.map(jnp.zeros_like, variables["cache"])
 
     def _build_fns(self) -> tuple[Callable, ...]:
         dmodel = self._dmodel
 
+        def _assemble(variables, tenants, cache=None):
+            out = dict(variables)
+            if tenants:
+                out["tenants"] = tenants
+            if cache is not None:
+                out["cache"] = cache
+            return out
+
         def _index_setter(value):
             def fix(path, leaf):
-                name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
-                return jnp.full_like(leaf, value) if name == "index" else leaf
+                return (jnp.full_like(leaf, value)
+                        if _leaf_name(path) == "index" else leaf)
 
             return fix
 
         @jax.jit
-        def fill(variables, tokens, last_idx, true_len):
+        def fill(variables, tenants, tokens, adapter_ids, last_idx, true_len):
             """Prefill one request (B=1, right-padded to a bucket): logits at
             the TRUE last prompt position + a cache whose index rows read
             ``true_len`` (the model wrote the padded length)."""
             logits, updated = dmodel.apply(
-                variables, tokens, deterministic=True, decode=True,
-                mutable=("cache",),
+                _assemble(variables, tenants), tokens, deterministic=True,
+                decode=True, mutable=("cache",), adapter_ids=adapter_ids,
             )
             cache = jax.tree_util.tree_map_with_path(
                 _index_setter(true_len), updated["cache"]
@@ -251,7 +434,8 @@ class BatchEngine:
             return jnp.take(logits, last_idx, axis=1).astype(jnp.float32), cache
 
         @jax.jit
-        def fill_from(variables, cache, tokens, start, last_idx, true_len):
+        def fill_from(variables, tenants, cache, tokens, adapter_ids, start,
+                      last_idx, true_len):
             """Suffix prefill over a B=1 prefix snapshot: the first ``start``
             cache positions are reused as-is, the (bucket-padded) suffix
             ``tokens`` runs a chunked forward at absolute positions
@@ -266,8 +450,9 @@ class BatchEngine:
                 start + jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
             )
             logits, updated = dmodel.apply(
-                {**variables, "cache": cache}, tokens, positions=positions,
-                deterministic=True, decode=True, mutable=("cache",),
+                _assemble(variables, tenants, cache), tokens,
+                positions=positions, deterministic=True, decode=True,
+                mutable=("cache",), adapter_ids=adapter_ids,
             )
             cache = jax.tree_util.tree_map_with_path(
                 _index_setter(true_len), updated["cache"]
@@ -275,7 +460,30 @@ class BatchEngine:
             return jnp.take(logits, last_idx, axis=1).astype(jnp.float32), cache
 
         @jax.jit
-        def decode(variables, cache, tokens, positions, temps, top_ks, rngs):
+        def fill_paged(variables, tenants, cache, tokens, page_table,
+                       adapter_ids, start, last_idx):
+            """Paged prefill/suffix-prefill, ONE program for both: a B=1
+            forward whose writes scatter through ``page_table`` into the
+            shared pools and whose attention gathers back through it
+            (``models/llama.py`` paged branch).  ``start`` is 0 for a fresh
+            prompt or the reuse length over spliced prefix pages; the lane's
+            true index is set host-side after the call, so no index fixup
+            pass is needed here."""
+            positions = (
+                start + jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+            )
+            logits, updated = dmodel.apply(
+                _assemble(variables, tenants, cache), tokens,
+                positions=positions, deterministic=True, decode=True,
+                mutable=("cache",), page_table=page_table,
+                adapter_ids=adapter_ids,
+            )
+            return (jnp.take(logits, last_idx, axis=1).astype(jnp.float32),
+                    updated["cache"])
+
+        @jax.jit
+        def decode(variables, tenants, cache, tokens, positions, temps,
+                   top_ks, rngs, page_table, adapter_ids):
             """One batched decode step with ON-DEVICE token selection: returns
             ``(slots,)`` int32 next tokens + advanced per-lane PRNG keys +
             the updated cache — the per-step device→host transfer no longer
@@ -285,8 +493,10 @@ class BatchEngine:
             top-k mask → split → categorical), so per-request sampled decodes
             stay reproducible independent of batch-mates."""
             logits, updated = dmodel.apply(
-                {**variables, "cache": cache}, tokens, positions=positions,
-                deterministic=True, decode=True, mutable=("cache",),
+                _assemble(variables, tenants, cache), tokens,
+                positions=positions, deterministic=True, decode=True,
+                mutable=("cache",), page_table=page_table,
+                adapter_ids=adapter_ids,
             )
             logits = logits[:, -1].astype(jnp.float32)   # (slots, V)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -330,27 +540,48 @@ class BatchEngine:
             return jax.tree.map(put, cache, one)
 
         @jax.jit
-        def reset_lane(cache, slot):
-            """Park a freed lane: zero its cache-index rows so the dead lane
-            keeps writing its throwaway decode tokens at in-bounds positions
-            (index leaves are batch-last: ``(B,)``, or ``(L, B)`` scanned)."""
+        def set_lane_index(cache, slot, value):
+            """Point one lane's cache-index rows at ``value``: 0 parks a
+            freed lane (its throwaway decode writes stay benign and
+            in-bounds — scratch page 0 in paged mode, position 0 unpaged),
+            a prompt length arms a just-admitted paged lane (index leaves
+            are batch-last: ``(B,)``, or ``(L, B)`` scanned)."""
 
             def fix(path, leaf):
-                name = getattr(path[-1], "key", getattr(path[-1], "name", ""))
-                return leaf.at[..., slot].set(0) if name == "index" else leaf
+                return (leaf.at[..., slot].set(value)
+                        if _leaf_name(path) == "index" else leaf)
 
             return jax.tree_util.tree_map_with_path(fix, cache)
 
-        # insert and reset_lane have exactly one signature each (the cache
-        # trees are fixed-shape), so they stay outside the guard: the budget
-        # counts the shapes that can vary with traffic — prefill buckets
-        # (fill and fill_from) and the decode step
+        @jax.jit
+        def copy_page(cache, dst, src):
+            """Copy-on-write: duplicate pool page ``src`` into ``dst`` across
+            every layer's K and V pools (the page axis sits at ``ndim - 4``;
+            scanned models carry a leading layer axis)."""
+
+            def fix(path, leaf):
+                if _leaf_name(path) not in ("k", "v"):
+                    return leaf
+                ax = leaf.ndim - 4
+                page = jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=ax)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    leaf, page, dst, axis=ax
+                )
+
+            return jax.tree_util.tree_map_with_path(fix, cache)
+
+        # insert/set_lane_index/copy_page have exactly one signature each
+        # (the cache trees are fixed-shape), so they stay outside the guard:
+        # the budget counts the shapes that can vary with traffic — prefill
+        # buckets and the decode step
         return (
             self.guard.wrap(fill, "fill"),
             self.guard.wrap(fill_from, "fill_from"),
+            self.guard.wrap(fill_paged, "fill_paged"),
             self.guard.wrap(decode, "decode_step"),
             insert,
-            reset_lane,
+            set_lane_index,
+            copy_page,
         )
 
     # ---- slot management --------------------------------------------------
@@ -375,10 +606,63 @@ class BatchEngine:
     def prefix_cache_entries(self) -> int:
         return len(self._prefix_cache) if self._prefix_cache else 0
 
-    def _resolve_prefix(self, tokens: list[int], plen: int):
-        """Longest reusable cached prefix for ``tokens`` at bucket
-        granularity; returns ``(reuse_len, snapshot)`` or ``(0, None)``."""
-        match_len, snapshot = self._prefix_cache.lookup(tokens)
+    def kv_page_stats(self) -> dict[str, int]:
+        """Pool gauges for /metrics (empty when unpaged)."""
+        return self._pool.stats() if self._pool is not None else {}
+
+    def kv_slack_pages(self) -> int | None:
+        """Pages still promisable to new admissions (None when unpaged) —
+        the router's page-aware routing signal."""
+        return self._pool.slack() if self._pool is not None else None
+
+    def _request_span(self, req: GenRequest) -> int:
+        """Last written sequence position + 1 for ``req``: the prompt plus
+        every decode step's write (the final token is recorded, not
+        written)."""
+        return len(req.tokens) + max(0, req.max_new_tokens - 1)
+
+    def admission_pages(self, req: GenRequest) -> int:
+        """Worst-case pages admitting ``req`` reserves (0 when unpaged) —
+        the batcher sums this over a multi-request admission batch so the
+        batch as a WHOLE fits the pool, not just each request alone."""
+        if self._pool is None:
+            return 0
+        return self._pool.pages_for(self._request_span(req))
+
+    def can_admit(self, req: GenRequest, pending_pages: int = 0) -> bool:
+        """Whether :meth:`admit` would succeed NOW — the batcher's gate, so
+        pool pressure keeps requests queued instead of failing them.
+        ``pending_pages`` adds pages already promised to requests picked for
+        the same admission batch but not yet admitted.  Conservative in
+        paged mode: ignores prefix sharing, so a True can never turn into a
+        mid-admission exhaustion.  Permanently-impossible requests return
+        True so ``admit`` raises their real error."""
+        if self.free_slots == 0:
+            return False
+        if self._pool is None:
+            return True
+        need = self._pool.pages_for(self._request_span(req))
+        if need > self._pool.usable_pages:
+            return True  # impossible forever: let admit() fail it loudly
+        return self._pool.can_reserve(need + pending_pages)
+
+    def _resolve_adapter(self, req: GenRequest) -> tuple[int, str]:
+        """(stack slot, prefix-cache namespace) for the request's tenant."""
+        if not req.adapter_id:
+            return 0, ""
+        if self.adapters is None:
+            raise UnknownAdapter(
+                f"request {req.request_id} names adapter "
+                f"{req.adapter_id!r} but this engine has no adapter "
+                "registry (serve_max_adapters=0)"
+            )
+        return self.adapters.resolve(req.adapter_id), req.adapter_id
+
+    def _resolve_prefix(self, tokens: list[int], plen: int, ns: str):
+        """Longest reusable cached prefix for ``tokens`` under the adapter
+        namespace ``ns``, at bucket granularity; returns ``(reuse_len,
+        snapshot)`` or ``(0, None)``."""
+        match_len, snapshot = self._prefix_cache.lookup(tokens, namespace=ns)
         if snapshot is None:
             return 0, None
         reuse = resolve_reuse_length(
@@ -390,12 +674,16 @@ class BatchEngine:
 
     def admit(self, req: GenRequest) -> GenResult | None:
         """Prefill ``req`` into a free lane (raises :class:`EngineBusy` when
-        the batch is full, :class:`PromptTooLong` past the largest bucket).
+        the batch is full, :class:`PromptTooLong` past the largest bucket,
+        :class:`~finetune_controller_tpu.serve.kv_pages.PoolExhausted` when
+        the paged pool cannot host it yet — use :meth:`can_admit` to gate).
 
-        With the prefix cache on, the longest cached prefix of the prompt is
-        spliced in and only the (bucket-padded) suffix runs a forward —
-        greedy/sampled outputs stay bit-identical to the cache-off path
-        because causal KV depends only on the tokens before it.
+        With the prefix cache on, the longest cached prefix of the prompt
+        UNDER THE REQUEST'S ADAPTER is spliced in and only the
+        (bucket-padded) suffix runs a forward — greedy/sampled outputs stay
+        bit-identical to the cache-off path because causal KV depends only
+        on the tokens before it (and on the adapter, which the namespace
+        pins).
 
         Returns a :class:`GenResult` when the request finishes ON admission
         (its first sampled token hits eos, or ``max_new_tokens == 1``) —
@@ -413,9 +701,34 @@ class BatchEngine:
         cap = self.config.max_new_tokens
         if req.max_new_tokens > cap:
             raise ValueError(f"max_new_tokens {req.max_new_tokens} > engine cap {cap}")
+        a_slot, ns = self._resolve_adapter(req)
+        self.config.bucket_for(plen)  # PromptTooLong before any allocation
+        if self.paged:
+            logits = self._prefill_paged(req, slot_id, plen, a_slot, ns)
+        else:
+            logits = self._prefill_unpaged(req, slot_id, plen, a_slot, ns)
+        self._adapter_slots[slot_id] = a_slot
+        slot = self._slots[slot_id]
+        slot.req = req
+        slot.generated = []
+        slot.next_pos = plen
+        slot.adapter_id = req.adapter_id
+        slot.rng = jax.random.PRNGKey(req.seed)
+        slot.admitted_at = time.monotonic()
+        result = self._emit(slot, logits)
+        if result is None and req.temperature > 0.0:
+            # hand the post-first-token stream to the device-side sampler
+            self._rng_keys[slot_id] = np.asarray(slot.rng, np.uint32)
+        return result
+
+    # ---- unpaged prefill --------------------------------------------------
+
+    def _prefill_unpaged(self, req, slot_id, plen, a_slot, ns):
         bucket = self.config.bucket_for(plen)
+        ids1 = (jnp.asarray([a_slot], jnp.int32)
+                if self.tenant_mode else None)
         reuse, snapshot = (
-            self._resolve_prefix(req.tokens, plen)
+            self._resolve_prefix(req.tokens, plen, ns)
             if self._prefix_cache is not None else (0, None)
         )
         if snapshot is not None:
@@ -424,7 +737,8 @@ class BatchEngine:
             padded = np.zeros((1, sbucket), np.int32)
             padded[0, :len(suffix)] = suffix
             logits, one = self._fill_from(
-                self.variables, snapshot, jnp.asarray(padded),
+                self.variables, self._tenants_arg(), snapshot,
+                jnp.asarray(padded), ids1,
                 jnp.asarray(reuse, jnp.int32),
                 jnp.asarray(len(suffix) - 1, jnp.int32),
                 jnp.asarray(plen, jnp.int32),
@@ -435,15 +749,17 @@ class BatchEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :plen] = req.tokens
             logits, one = self._fill(
-                self.variables, jnp.asarray(padded),
-                jnp.asarray(plen - 1, jnp.int32), jnp.asarray(plen, jnp.int32),
+                self.variables, self._tenants_arg(), jnp.asarray(padded),
+                ids1, jnp.asarray(plen - 1, jnp.int32),
+                jnp.asarray(plen, jnp.int32),
             )
             if self._prefix_cache is not None:
                 self.prefix_misses_total += 1
         if self._prefix_cache is not None:
             # the hit path's `one` is a full-prompt cache too, so every
             # admission leaves its prompt resolvable for the next request
-            if (not self._prefix_cache.insert(tuple(req.tokens), one)
+            if (not self._prefix_cache.insert(tuple(req.tokens), one,
+                                              namespace=ns)
                     and not self._prefix_warned):
                 self._prefix_warned = True
                 logger.warning(
@@ -454,24 +770,133 @@ class BatchEngine:
                     self._prefix_cache.budget_bytes,
                 )
         self._cache = self._insert(self._cache, one, slot_id)
+        return logits
+
+    # ---- paged prefill ----------------------------------------------------
+
+    def _evict_hook(self):
+        return (self._prefix_cache.evict_oldest
+                if self._prefix_cache is not None else None)
+
+    def _b1_cache(self, start: int):
+        """Per-admission B=1 view over the live cache: the shared pools ride
+        along by reference, the per-lane index leaves shrink to one row
+        holding the prefill's start position."""
+
+        def fix(path, leaf):
+            if _leaf_name(path) == "index":
+                return jnp.full(leaf.shape[:-1] + (1,), start, jnp.int32)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(fix, self._cache)
+
+    def _merge_pools(self, updated_cache):
+        """Take the (B=1 apply's) updated pool leaves back into the batch
+        cache, keeping the batch-shaped index leaves."""
+
+        def pick(path, batch_leaf, b1_leaf):
+            return b1_leaf if _leaf_name(path) in ("k", "v") else batch_leaf
+
+        self._cache = jax.tree_util.tree_map_with_path(
+            pick, self._cache, updated_cache
+        )
+
+    def _prefill_paged(self, req, slot_id, plen, a_slot, ns):
+        pool, t = self._pool, self._pool.page_tokens
+        need_total = pool.pages_for(self._request_span(req))
+        if need_total > pool.usable_pages:
+            raise ValueError(
+                f"request {req.request_id} needs {need_total} kv pages but "
+                f"the pool holds {pool.usable_pages} — raise "
+                "serve_kv_pool_pages or shrink the request"
+            )
+        reuse, run = (
+            self._resolve_prefix(req.tokens, plen, ns)
+            if self._prefix_cache is not None else (0, None)
+        )
+        shared = list(run.pages[: reuse // t]) if run is not None else []
+        pool.reserve(need_total - len(shared))  # PoolExhausted backpressure
+        for page in shared:
+            pool.lane_ref(page)
         slot = self._slots[slot_id]
-        slot.req = req
-        slot.generated = []
-        slot.next_pos = plen
-        slot.rng = jax.random.PRNGKey(req.seed)
-        slot.admitted_at = time.monotonic()
-        result = self._emit(slot, logits)
-        if result is None and req.temperature > 0.0:
-            # hand the post-first-token stream to the device-side sampler
-            self._rng_keys[slot_id] = np.asarray(slot.rng, np.uint32)
-        return result
+        slot.pages = list(shared)
+        slot.reserved = need_total - len(shared)
+        row = np.zeros((self._tables.shape[1],), np.int32)
+        row[: len(shared)] = shared
+        try:
+            # materialize the pages the prompt writes NOW; decode growth
+            # spends the rest of the reservation page-by-page
+            prompt_pages = pool.pages_for(plen)
+            for i in range(len(shared), prompt_pages):
+                phys = pool.alloc_reserved(self._evict_hook())
+                row[i] = phys
+                slot.pages.append(phys)
+                slot.reserved -= 1
+            if run is not None and reuse % t:
+                # copy-on-write boundary: the page holding position `reuse`
+                # keeps the entry's prefix KV but will be written by this
+                # lane's suffix — it must be a private copy
+                self._cache = self._copy_page(
+                    self._cache,
+                    jnp.asarray(int(row[reuse // t]), jnp.int32),
+                    jnp.asarray(int(run.pages[reuse // t]), jnp.int32),
+                )
+                pool.cow_copies_total += 1
+            start = reuse if run is not None else 0
+            suffix = req.tokens[start:]
+            sbucket = self.config.bucket_for(len(suffix))
+            padded = np.zeros((1, sbucket), np.int32)
+            padded[0, :len(suffix)] = suffix
+            ids1 = (jnp.asarray([a_slot], jnp.int32)
+                    if self.tenant_mode else None)
+            logits, updated = self._fill_paged(
+                self.variables, self._tenants_arg(), self._b1_cache(start),
+                jnp.asarray(padded), jnp.asarray(row[None, :]), ids1,
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(len(suffix) - 1, jnp.int32),
+            )
+        except BaseException:
+            # roll the lane's pool state back so a failed prefill (bad
+            # request shape, injected fault) never leaks pages
+            pool.lane_release(slot.pages, slot.reserved)
+            slot.pages, slot.reserved = [], 0
+            raise
+        self._merge_pools(updated)
+        self._cache = self._set_lane_index(
+            self._cache, jnp.asarray(slot_id, jnp.int32),
+            jnp.asarray(plen, jnp.int32),
+        )
+        self._tables[slot_id, :] = row
+        if self._prefix_cache is not None:
+            if run is not None:
+                self.prefix_hits_total += 1
+                self.prefill_tokens_saved_total += start
+            else:
+                self.prefix_misses_total += 1
+            run_new = PageRun(
+                pages=tuple(int(x) for x in row[:pool.pages_for(plen)]),
+                n_tokens=plen,
+            )
+            if (not self._prefix_cache.insert(tuple(req.tokens), run_new,
+                                              namespace=ns)
+                    and not self._prefix_warned):
+                self._prefix_warned = True
+                logger.warning(
+                    "prefix cache cannot hold a single page run (%d pages x "
+                    "%d B > budget %d B) — every admission will miss; raise "
+                    "serve_prefix_cache_mb or disable the cache",
+                    len(run_new.pages), pool.page_bytes,
+                    self._prefix_cache.budget_bytes,
+                )
+        return logits
 
     def evict(self, request_id: str) -> GenResult | None:
         """Drop an in-flight request (deadline blown / client gone); frees
-        the lane immediately and parks its cache index at 0 (see
-        :meth:`_finish`) — the freed lane still rides every step, decoding
-        throwaway tokens at benign in-bounds positions that other rows
-        never see, until re-admission overwrites it."""
+        the lane — and, in paged mode, its pool pages — immediately and
+        parks its cache index at 0 (see :meth:`_finish`): the freed lane
+        still rides every step, decoding throwaway tokens at benign
+        in-bounds positions that other rows never see, until re-admission
+        overwrites it."""
         for slot in self._slots:
             if slot.active and slot.req.request_id == request_id:
                 return self._finish(slot, "evicted")
@@ -501,6 +926,9 @@ class BatchEngine:
         slot.generated.append(tok)
         slot.last_token = tok
         self.tokens_generated_total += 1
+        self.tokens_by_tenant[slot.adapter_id] = (
+            self.tokens_by_tenant.get(slot.adapter_id, 0) + 1
+        )
         if req.eos_id is not None and tok == req.eos_id:
             return self._finish(slot, "eos")
         if len(slot.generated) >= req.max_new_tokens:
@@ -523,17 +951,46 @@ class BatchEngine:
         slot.rng = None
         slot.last_token = 0
         slot.next_pos = 0
+        slot.adapter_id = ""
+        self._adapter_slots[slot.lane] = 0
+        if self.paged:
+            # free the lane's pages (shared refs drop; exclusive pages still
+            # referenced by prefix-cache entries stay resident for reuse)
+            # and return the unspent reservation — eviction reclaims memory
+            # IMMEDIATELY, the paged contract
+            self._pool.lane_release(slot.pages, slot.reserved)
+            slot.pages = []
+            slot.reserved = 0
+            self._tables[slot.lane, :] = 0
         # park the lane's device cache index at 0: a freed lane still rides
         # every decode step, and left at its stale position it would creep
         # toward (and past) the cache end — reset keeps its throwaway writes
-        # benign and in-bounds until re-admission overwrites the lane
-        self._cache = self._reset_lane(
-            self._cache, jnp.asarray(slot.lane, jnp.int32)
+        # benign and in-bounds (scratch page 0 in paged mode) until
+        # re-admission overwrites the lane
+        self._cache = self._set_lane_index(
+            self._cache, jnp.asarray(slot.lane, jnp.int32),
+            jnp.asarray(0, jnp.int32),
         )
         self.requests_finished_total += 1
         return result
 
     # ---- the decode loop --------------------------------------------------
+
+    def _grow_pages(self) -> None:
+        """Materialize the page each active lane's NEXT write lands in, when
+        it has not been allocated yet — reservation-backed, so the free list
+        (after evicting cache-only pages) can never come up short."""
+        t = self._pool.page_tokens
+        width = self._tables.shape[1]
+        for slot in self._slots:
+            if not slot.active:
+                continue
+            page_idx = slot.next_pos // t
+            if page_idx < width and self._tables[slot.lane, page_idx] == 0:
+                phys = self._pool.alloc_reserved(self._evict_hook())
+                self._tables[slot.lane, page_idx] = phys
+                slot.pages.append(phys)
+                slot.reserved -= 1
 
     def step(self) -> list[GenResult]:
         """One batched decode step; returns requests that finished on it.
@@ -543,6 +1000,8 @@ class BatchEngine:
         never the ``(slots, vocab)`` logits array."""
         if self.active_requests == 0:
             return []
+        if self.paged:
+            self._grow_pages()
         tokens = np.zeros((self.config.slots, 1), np.int32)
         positions = np.zeros((self.config.slots, 1), np.int32)
         temps = np.zeros((self.config.slots,), np.float32)
@@ -554,10 +1013,11 @@ class BatchEngine:
                 temps[i] = max(slot.req.temperature, 0.0)
                 top_ks[i] = slot.req.top_k
         next_tokens, rng_keys, self._cache = self._decode(
-            self.variables, self._cache,
+            self.variables, self._tenants_arg(), self._cache,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(temps), jnp.asarray(top_ks),
             jnp.asarray(self._rng_keys),
+            self._page_table_arg(), self._adapter_ids_arg(),
         )
         self.steps_total += 1
         next_tokens = np.asarray(next_tokens)
@@ -576,16 +1036,23 @@ class BatchEngine:
 
     def run(self, requests: list[GenRequest]) -> dict[str, GenResult]:
         """Synchronous convenience driver (tests/bench): admit everything —
-        overflow waits for a lane — and step until the batch drains."""
+        overflow waits for a lane or for pool pages — and step until the
+        batch drains."""
         results: dict[str, GenResult] = {}
         pending = list(requests)
         guard_steps = itertools.count()
         limit = sum(r.max_new_tokens for r in requests) + len(requests) + 8
         while pending or self.active_requests:
-            while pending and self.free_slots:
+            while pending and self.free_slots and self.can_admit(pending[0]):
                 done = self.admit(pending.pop(0))
                 if done is not None:  # finished on admission (eos / max_new=1)
                     results[done.request_id] = done
+            if pending and not self.active_requests \
+                    and not self.can_admit(pending[0]):
+                raise PoolExhausted(
+                    f"request {pending[0].request_id} can never admit: the "
+                    "kv page pool is exhausted with no work in flight"
+                )
             for done in self.step():
                 results[done.request_id] = done
             if next(guard_steps) > limit:  # pragma: no cover - safety valve

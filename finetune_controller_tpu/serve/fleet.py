@@ -47,6 +47,10 @@ from .engine import BatchEngine, EngineConfig, GenRequest
 logger = logging.getLogger(__name__)
 
 
+class AdapterBusy(RuntimeError):
+    """Unload refused: the tenant still has requests queued or in flight."""
+
+
 class ReplicaState(str, enum.Enum):
     HEALTHY = "healthy"
     DRAINING = "draining"
@@ -111,13 +115,21 @@ class ReplicaFleet:
         "steps_total", "tokens_generated_total", "requests_completed_total",
         "requests_rejected_total", "deadline_drops_total",
         "step_errors_total", "prefix_hits_total", "prefix_misses_total",
-        "prefill_tokens_saved_total",
+        "prefill_tokens_saved_total", "kv_cow_copies_total",
+        "kv_pool_exhaustions_total",
     )
     #: point-in-time gauges: summed over LIVE replicas only
     _GAUGE_KEYS = (
         "queue_depth", "slots_busy", "slots_total", "compilations",
         "prefix_cache_bytes", "prefix_cache_entries",
+        "kv_pages_total", "kv_pages_free", "kv_pages_used",
+        "kv_pages_shared",
     )
+    #: per-tenant counter DICTS ({adapter_id: n}): folded like the scalar
+    #: counters so retired replicas' tenant tokens never regress
+    _DICT_COUNTER_KEYS = ("tokens_by_tenant",)
+    #: per-tenant gauge dicts: summed over live replicas only
+    _DICT_GAUGE_KEYS = ("queue_depth_by_tenant", "lanes_by_tenant")
 
     def __init__(
         self,
@@ -135,11 +147,16 @@ class ReplicaFleet:
         event_cb: Callable[..., Awaitable[Any]] | None = None,
         clock: Callable[[], float] = time.monotonic,
         warm_start: bool = True,
+        adapters: "Any | None" = None,
     ):
         self.job_id = job_id
         self._model = model
         self._variables = variables
         self._engine_config = engine_config
+        #: shared multi-tenant adapter registry (serve/adapters.py); every
+        #: replica engine holds its own device copy of the stacks, synced
+        #: here on register/unregister/spawn/rollover
+        self.adapters = adapters
         self.target_replicas = max(1, replicas)
         self._batcher_kwargs = dict(batcher_kwargs or {})
         self.stall_timeout_s = stall_timeout_s
@@ -179,6 +196,9 @@ class ReplicaFleet:
         self._retired_totals: dict[str, int] = {
             k: 0 for k in self._COUNTER_KEYS
         }
+        self._retired_dict_totals: dict[str, dict[str, int]] = {
+            k: {} for k in self._DICT_COUNTER_KEYS
+        }
 
     # ---- events ------------------------------------------------------------
 
@@ -203,7 +223,8 @@ class ReplicaFleet:
         compile this replica will ever need lands before it serves traffic.
         The warmup's counter noise is zeroed; its shapes are exactly the
         budgeted ones, so the recompile guard stays armed and accurate."""
-        engine = BatchEngine(self._model, self._variables, self._engine_config)
+        engine = BatchEngine(self._model, self._variables,
+                             self._engine_config, adapters=self.adapters)
         if self.warm_start:
             warm_new = min(2, engine.config.max_new_tokens)
             for bucket in engine.config.prompt_buckets:
@@ -217,6 +238,7 @@ class ReplicaFleet:
             engine.prefix_hits_total = 0
             engine.prefix_misses_total = 0
             engine.prefill_tokens_saved_total = 0
+            engine.tokens_by_tenant = {}
         return engine
 
     async def spawn_replica(self) -> Replica:
@@ -238,6 +260,69 @@ class ReplicaFleet:
         logger.info("serve replica %s started (job=%s gen=%d)",
                     rid, self.job_id, self.generation)
         return replica
+
+    # ---- multi-tenant adapters ---------------------------------------------
+
+    async def register_adapter(self, adapter_id: str, lora_tree: Any,
+                               alpha: float, rank: int,
+                               meta: dict[str, Any] | None = None) -> int:
+        """Register a tenant and install its stacks on EVERY live replica
+        (device writes run in a worker thread; the engine swaps its tenants
+        reference atomically, so in-flight steps are never torn).  Replicas
+        spawned or rolled over later sync from the registry at build time."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "fleet has no adapter registry (serve_max_adapters=0)"
+            )
+        refresh = self.adapters.get(adapter_id) is not None
+        entry = self.adapters.register(adapter_id, lora_tree, alpha, rank,
+                                       meta=meta)
+        for replica in list(self._replicas.values()):
+            await asyncio.to_thread(replica.engine.install_adapter,
+                                    adapter_id)
+            if refresh:
+                # tenant rollover: the deltas changed, so KV cached under
+                # the old weights is poison for the new ones.  Drop AFTER
+                # the (atomic) stack swap: an admission racing the drop can
+                # only re-seed the namespace with NEW-weight KV, whereas
+                # dropping first would let a racing old-stack admission
+                # poison the fresh namespace permanently
+                replica.engine.drop_prefix_namespace(adapter_id)
+        await self._event(
+            "serve-adapter-loaded", adapter=adapter_id, slot=entry.slot,
+            rank=rank,
+        )
+        logger.info("adapter %s installed on %d replica(s) (job=%s slot=%d)",
+                    adapter_id, len(self._replicas), self.job_id, entry.slot)
+        return entry.slot
+
+    async def unregister_adapter(self, adapter_id: str) -> None:
+        """Remove a tenant: refuses while the tenant has queued or decoding
+        requests anywhere in the fleet (its slot id may be reused — evicting
+        live lanes would hand their KV to a stranger), then zeroes the slot
+        and drops the tenant's prefix-cache namespace on every replica."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "fleet has no adapter registry (serve_max_adapters=0)"
+            )
+        busy = 0
+        for replica in self._replicas.values():
+            # _inflight covers the admission window the engine's lane view
+            # misses (a request mid-admit in the worker thread has no lane
+            # yet but HAS already resolved its adapter slot)
+            busy += replica.batcher.inflight_by_tenant().get(adapter_id, 0)
+            busy += replica.batcher.queue_depth_by_tenant().get(adapter_id, 0)
+        if busy:
+            raise AdapterBusy(
+                f"adapter {adapter_id!r} has {busy} request(s) in flight or "
+                "queued; drain them (or wait) before unloading"
+            )
+        entry = self.adapters.unregister(adapter_id)
+        for replica in list(self._replicas.values()):
+            await asyncio.to_thread(
+                replica.engine.remove_adapter, adapter_id, entry.slot
+            )
+        await self._event("serve-adapter-unloaded", adapter=adapter_id)
 
     def healthy_replicas(self) -> list[Replica]:
         return [r for r in self._replicas.values() if r.healthy]
@@ -451,10 +536,18 @@ class ReplicaFleet:
 
     # ---- observability -----------------------------------------------------
 
+    @staticmethod
+    def _sum_dicts(into: dict[str, int], add: dict[str, int]) -> dict[str, int]:
+        for k, v in (add or {}).items():
+            into[k] = into.get(k, 0) + v
+        return into
+
     def _retire(self, replica: Replica) -> None:
         stats = replica.batcher.stats()
         for key in self._COUNTER_KEYS:
             self._retired_totals[key] += stats.get(key, 0)
+        for key in self._DICT_COUNTER_KEYS:
+            self._sum_dicts(self._retired_dict_totals[key], stats.get(key))
 
     def stats(self) -> dict[str, Any]:
         """The PR-4 aggregate stats shape every existing consumer reads —
@@ -462,12 +555,30 @@ class ReplicaFleet:
         sum over live replicas — plus the per-replica rows."""
         replicas = {rid: r.stats() for rid, r in self._replicas.items()}
         agg: dict[str, Any] = {
-            k: sum(r[k] for r in replicas.values()) for k in self._GAUGE_KEYS
+            k: sum(r.get(k, 0) for r in replicas.values())
+            for k in self._GAUGE_KEYS
         }
         for k in self._COUNTER_KEYS:
             agg[k] = self._retired_totals[k] + sum(
-                r[k] for r in replicas.values()
+                r.get(k, 0) for r in replicas.values()
             )
+        for k in self._DICT_COUNTER_KEYS:
+            total = dict(self._retired_dict_totals[k])
+            for r in replicas.values():
+                self._sum_dicts(total, r.get(k) or {})
+            agg[k] = total
+        for k in self._DICT_GAUGE_KEYS:
+            total: dict[str, int] = {}
+            for r in replicas.values():
+                self._sum_dicts(total, r.get(k) or {})
+            agg[k] = total
+        agg["adapters_loaded"] = (
+            len(self.adapters) if self.adapters is not None else 0
+        )
+        agg["adapters"] = (
+            self.adapters.stats()["adapters"]
+            if self.adapters is not None else {}
+        )
         agg.update({
             "replicas": replicas,
             "replicas_total": len(replicas),

@@ -217,9 +217,140 @@ def load_serving_model(
         "lora_merged": merged,
         "vocab_size": model_cfg.vocab_size,
         "max_seq_len": model_cfg.max_seq_len,
+        "weights_dir": pretrained or None,
     }
     logger.info("serving model ready: %s", meta)
     return model, variables, meta
+
+
+def strip_lora_for_multitenant(
+    model: Any, variables: dict
+) -> tuple[Any, dict, Any | None, float, int]:
+    """Split a loaded (unmerged) serving model into the pristine base plus
+    its own adapter, for multi-tenant serving (docs/serving.md §Multi-tenant
+    adapters): returns ``(base_model, base_variables, lora_tree | None,
+    alpha, rank)``.  The base model's config drops to rank 0 — per-lane
+    adapters apply through the ``"tenants"`` stacks instead, so the job's
+    own fine-tune becomes tenant #1 and slot 0 stays the untouched base."""
+    if "lora" not in variables:
+        return model, variables, None, 0.0, 0
+    variables = dict(variables)
+    lora_tree = variables.pop("lora")
+    cfg = model.cfg
+    alpha, rank = cfg.lora.alpha, cfg.lora.rank
+    from ..models.lora import LoRAConfig
+
+    base_cfg = cfg.replace(
+        lora=LoRAConfig(rank=0, alpha=alpha, targets=cfg.lora.targets)
+    )
+    return type(model)(cfg=base_cfg), variables, lora_tree, alpha, rank
+
+
+def _load_adapter_tree(local_dir: Path | str) -> tuple[Any, dict]:
+    """Worker-thread body of :func:`load_adapter`: the staged prefix →
+    ``(lora_tree, adapter_meta)``.  Unlike :func:`load_serving_model` this
+    never builds the model or touches base weights — the checkpoint's
+    trainable tree IS the adapter for a LoRA job (``Trainer._assemble``), so
+    the whole load is one spec read plus one (small) msgpack restore."""
+    local_dir = Path(local_dir)
+    spec_path = local_dir / "resolved_config.json"
+    if not spec_path.exists():
+        raise ServeLoadError(
+            f"{spec_path} missing: the promoted prefix carries no job spec"
+        )
+    with open(spec_path) as f:
+        spec = json.load(f)
+
+    from ..train.checkpoint import CheckpointManager
+    from ..train.cli import build_model_config
+
+    model_cfg = build_model_config(spec)
+    if getattr(model_cfg, "vision", None) is not None:
+        raise ServeLoadError("multimodal adapters are not servable yet")
+    if model_cfg.lora.rank < 1:
+        raise ServeLoadError(
+            "job is not a LoRA job (lora.rank == 0): only LoRA deltas can "
+            "be multiplexed onto a shared base fleet — serve it as its own "
+            "model instead"
+        )
+    ckpt_dir = local_dir / "checkpoints"
+    if not ckpt_dir.is_dir() or not os.listdir(ckpt_dir):
+        raise ServeLoadError(
+            f"no checkpoints under {ckpt_dir} — the job produced none"
+        )
+    ckpt = CheckpointManager(str(ckpt_dir))
+    latest = ckpt.latest_step()
+    if latest is None:
+        raise ServeLoadError(f"no committed checkpoint steps under {ckpt_dir}")
+    host = ckpt.restore(latest)  # raw state dict: no template needed
+    lora_tree = host.get("trainable") if isinstance(host, dict) else None
+    if not isinstance(lora_tree, dict) or not lora_tree:
+        raise ServeLoadError(
+            "checkpoint carries no trainable (LoRA) tree — was this job "
+            "trained by this stack in LoRA mode?"
+        )
+    meta = {
+        "preset": spec.get("model", {}).get("preset"),
+        "weights_dir": spec.get("model", {}).get("weights_dir") or None,
+        "checkpoint_step": latest,
+        "lora_rank": model_cfg.lora.rank,
+        "lora_alpha": model_cfg.lora.alpha,
+    }
+    return lora_tree, meta
+
+
+async def load_adapter(
+    state: StateStore,
+    store: ObjectStore,
+    job_id: str,
+    work_dir: Path | str,
+    *,
+    base_meta: dict | None = None,
+) -> tuple[Any, dict]:
+    """Stage ONLY a promoted LoRA job's adapter deltas for multi-tenant
+    serving (docs/serving.md §Multi-tenant adapters).
+
+    The base fleet already holds the model weights; this path resolves the
+    tenant job's promotion, stages its spec + checkpoints (the trainable
+    tree of a LoRA job is just the adapter — megabytes, not the gigabytes a
+    full model load moves), and returns ``(lora_tree, meta)`` ready for
+    :meth:`~finetune_controller_tpu.serve.adapters.AdapterRegistry.register`.
+
+    ``base_meta`` (the serving session's model meta) guards against serving
+    an adapter on the wrong base: preset and pretrained weights must match —
+    KV and deltas computed against different bases are silently wrong, the
+    worst failure mode a 409 can prevent.
+    """
+    import shutil
+    import uuid
+
+    job = await resolve_promoted(state, job_id)
+    job_dir = Path(work_dir) / job_id
+    local = await fetch_promoted(
+        store, job.promotion_uri, job_dir / f"adapter-{uuid.uuid4().hex[:8]}"
+    )
+    try:
+        lora_tree, meta = await asyncio.to_thread(_load_adapter_tree, local)
+    finally:
+        await asyncio.to_thread(shutil.rmtree, local, ignore_errors=True)
+    if base_meta is not None:
+        for field in ("preset", "weights_dir"):
+            if meta.get(field) != base_meta.get(field):
+                raise ServeLoadError(
+                    f"adapter job {job_id!r} was trained on "
+                    f"{field}={meta.get(field)!r} but the base fleet serves "
+                    f"{field}={base_meta.get(field)!r} — an adapter only "
+                    "composes with the exact base it was trained against"
+                )
+        if base_meta.get("lora_merged"):
+            raise ServeLoadError(
+                "the base fleet serves MERGED weights; multi-tenant "
+                "adapters need the pristine base — reload it with "
+                "serve_merge_lora=false"
+            )
+    meta["job_id"] = job_id
+    meta["promotion_uri"] = job.promotion_uri
+    return lora_tree, meta
 
 
 async def load_promoted(

@@ -73,9 +73,14 @@ def resolve_reuse_length(
 @dataclasses.dataclass
 class _Entry:
     key: tuple[int, ...]
-    cache: Any               # B=1 device KV pytree (full cache_len shape)
+    cache: Any               # B=1 device KV pytree, or a kv_pages.PageRun
     nbytes: int
     node: "_Node"
+    #: adapter namespace (docs/serving.md §Multi-tenant adapters): KV depends
+    #: on the weights that produced it, so a cache key is (base model,
+    #: adapter id, token ids) — a hit under one tenant's adapter must never
+    #: splice into another tenant's lane
+    ns: str = ""
 
 
 class _Node:
@@ -101,15 +106,29 @@ def _lcp(a: tuple[int, ...], b: tuple[int, ...]) -> int:
 
 
 class PrefixCache:
-    """LRU byte-budgeted radix trie of B=1 KV snapshots."""
+    """LRU byte-budgeted radix trie of KV snapshots, one trie per adapter
+    namespace.
 
-    def __init__(self, budget_bytes: int):
+    Two storage flavors share the structure:
+
+    * **unpaged** (``pool=None``): entries are full-shape B=1 device KV
+      pytrees, charged their logical ``nbytes``;
+    * **paged** (``pool`` = the engine's :class:`~finetune_controller_tpu.
+      serve.kv_pages.KVPagePool`): entries are :class:`~finetune_controller_
+      tpu.serve.kv_pages.PageRun` references into the shared pool, charged
+      PHYSICAL bytes — a page shared copy-on-write by several entries (or
+      still held by the lane that wrote it) is charged once, on its first
+      cache reference, and credited when its last cache reference drops.
+    """
+
+    def __init__(self, budget_bytes: int, *, pool: Any = None):
         if budget_bytes <= 0:
             raise ValueError("PrefixCache needs a positive byte budget "
                              "(disable the cache instead of zeroing it)")
         self.budget_bytes = int(budget_bytes)
-        self._root = _Node()
-        self._lru: OrderedDict[tuple[int, ...], _Entry] = OrderedDict()
+        self._pool = pool
+        self._roots: dict[str, _Node] = {}
+        self._lru: OrderedDict[tuple, _Entry] = OrderedDict()
         self.total_bytes = 0
         self.evictions_total = 0
 
@@ -118,14 +137,18 @@ class PrefixCache:
 
     # ---- lookup -----------------------------------------------------------
 
-    def lookup(self, tokens: list[int] | tuple[int, ...]) -> tuple[int, Any]:
-        """Longest common prefix with any stored key.
+    def lookup(self, tokens: list[int] | tuple[int, ...],
+               namespace: str = "") -> tuple[int, Any]:
+        """Longest common prefix with any key stored under ``namespace``.
 
         Returns ``(match_len, cache)``; ``(0, None)`` on a miss.  The hit
         entry is refreshed in the LRU order.
         """
         query = tuple(tokens)
-        node, depth = self._root, 0
+        root = self._roots.get(namespace)
+        if root is None:
+            return 0, None
+        node, depth = root, 0
         while depth < len(query):
             edge = node.edges.get(query[depth])
             if edge is None:
@@ -143,7 +166,7 @@ class PrefixCache:
         entry = self._pick(node)
         if entry is None:  # pragma: no cover - n_entries invariant
             return 0, None
-        self._lru.move_to_end(entry.key)
+        self._lru.move_to_end((entry.ns, entry.key))
         return depth, entry.cache
 
     def _pick(self, node: _Node) -> _Entry | None:
@@ -161,41 +184,54 @@ class PrefixCache:
     # ---- insert / evict ---------------------------------------------------
 
     def insert(self, tokens: list[int] | tuple[int, ...], cache: Any,
-               nbytes: int | None = None) -> bool:
-        """Store ``cache`` under ``tokens``; returns False when refused
-        (empty key, or the snapshot alone exceeds the budget).  Re-inserting
-        an existing key refreshes its LRU slot and keeps the stored snapshot
-        (equal content by construction — same prompt, same weights)."""
+               nbytes: int | None = None, namespace: str = "") -> bool:
+        """Store ``cache`` under ``(namespace, tokens)``; returns False when
+        refused (empty key, or the snapshot alone exceeds the budget).
+        Re-inserting an existing key refreshes its LRU slot and keeps the
+        stored snapshot (equal content by construction — same prompt, same
+        weights, same adapter)."""
         key = tuple(tokens)
         if not key:
             return False
-        existing = self._lru.get(key)
+        existing = self._lru.get((namespace, key))
         if existing is not None:
-            self._lru.move_to_end(key)
+            self._lru.move_to_end((namespace, key))
             return True
-        if nbytes is None:
-            nbytes = _tree_nbytes(cache)
-        if nbytes > self.budget_bytes:
-            return False
-        node = self._attach(key)
-        entry = _Entry(key=key, cache=cache, nbytes=nbytes, node=node)
+        if self._pool is not None:
+            # paged: refuse by the entry's worst-case physical footprint;
+            # the actual charge (below) counts already-shared pages once
+            if len(cache.pages) * self._pool.page_bytes > self.budget_bytes:
+                return False
+        else:
+            if nbytes is None:
+                nbytes = _tree_nbytes(cache)
+            if nbytes > self.budget_bytes:
+                return False
+        node = self._attach(key, namespace)
+        if self._pool is not None:
+            nbytes = self._pool.cache_ref(cache.pages) * self._pool.page_bytes
+        entry = _Entry(key=key, cache=cache, nbytes=nbytes, node=node,
+                       ns=namespace)
         node.entry = entry
         walk = node
         while walk is not None:
             walk.n_entries += 1
             walk = walk.parent
-        self._lru[key] = entry
+        self._lru[(namespace, key)] = entry
         self.total_bytes += nbytes
         while self.total_bytes > self.budget_bytes:
-            oldest_key = next(iter(self._lru))
-            if oldest_key == key:  # pragma: no cover - nbytes<=budget above
+            oldest = next(iter(self._lru))
+            if oldest == (namespace, key):  # pragma: no cover - refused above
                 break
-            self._evict(self._lru[oldest_key])
+            self._evict(self._lru[oldest])
         return True
 
-    def _attach(self, key: tuple[int, ...]) -> _Node:
+    def _attach(self, key: tuple[int, ...], namespace: str = "") -> _Node:
         """Walk/extend the trie to the node for ``key``, splitting edges."""
-        node, i = self._root, 0
+        node = self._roots.get(namespace)
+        if node is None:
+            node = self._roots[namespace] = _Node()
+        i = 0
         while i < len(key):
             edge = node.edges.get(key[i])
             if edge is None:
@@ -220,9 +256,35 @@ class PrefixCache:
             return leaf
         return node
 
+    def evict_oldest(self) -> bool:
+        """Evict the least recently used entry (any namespace) — the paged
+        engine's hook for freeing pool pages under admission pressure."""
+        if not self._lru:
+            return False
+        self._evict(next(iter(self._lru.values())))
+        return True
+
+    def drop_namespace(self, namespace: str) -> int:
+        """Evict every entry stored under ``namespace`` — an unloaded
+        adapter's KV must never be spliceable again (its slot id may be
+        reused by a different tenant)."""
+        victims = [e for e in self._lru.values() if e.ns == namespace]
+        for entry in victims:
+            self._evict(entry)
+        self._roots.pop(namespace, None)
+        return len(victims)
+
     def _evict(self, entry: _Entry) -> None:
-        self._lru.pop(entry.key, None)
-        self.total_bytes -= entry.nbytes
+        self._lru.pop((entry.ns, entry.key), None)
+        if self._pool is not None:
+            # physical credit: only pages dropping their LAST cache
+            # reference (shared pages stay charged to the surviving entries)
+            self.total_bytes -= (
+                self._pool.cache_release(entry.cache.pages)
+                * self._pool.page_bytes
+            )
+        else:
+            self.total_bytes -= entry.nbytes
         self.evictions_total += 1
         node = entry.node
         node.entry = None
@@ -239,6 +301,9 @@ class PrefixCache:
                     del parent.edges[first]
                     break
             node = parent
+        for ns, root in list(self._roots.items()):
+            if root.n_entries == 0 and not root.edges:
+                del self._roots[ns]
 
     def stats(self) -> dict[str, int]:
         return {
@@ -246,6 +311,7 @@ class PrefixCache:
             "bytes": self.total_bytes,
             "budget_bytes": self.budget_bytes,
             "evictions_total": self.evictions_total,
+            "namespaces": len(self._roots),
         }
 
 

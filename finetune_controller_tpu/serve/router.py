@@ -81,9 +81,13 @@ class ReplicaRouter:
 
     # ---- picking -----------------------------------------------------------
 
-    def _pick(self, exclude: set[str]) -> Replica | None:
+    def _pick(self, exclude: set[str],
+              req: GenRequest | None = None) -> Replica | None:
         """Healthy, not yet tried, newest generation first (rollover traffic
-        shift), then least loaded."""
+        shift), then replicas whose KV page pool can host the request NOW
+        (paged engines, docs/serving.md §Paged KV — a replica with slack
+        decodes immediately where a page-starved one would queue), then
+        least loaded."""
         candidates = [
             r for r in self.fleet.healthy_replicas()
             if r.replica_id not in exclude
@@ -92,7 +96,17 @@ class ReplicaRouter:
             return None
         newest = max(r.generation for r in candidates)
         preferred = [r for r in candidates if r.generation == newest]
-        return min(preferred, key=lambda r: (r.load(), r.replica_id))
+
+        def starved(r: Replica) -> int:
+            if req is None:
+                return 0
+            slack = r.engine.kv_slack_pages()
+            if slack is None:
+                return 0
+            return 0 if r.engine.admission_pages(req) <= slack else 1
+
+        return min(preferred,
+                   key=lambda r: (starved(r), r.load(), r.replica_id))
 
     def retry_after_s(self) -> float:
         """The fleet-wide backoff hint: the LEAST loaded healthy replica's
@@ -151,7 +165,7 @@ class ReplicaRouter:
                 raise DeadlineExceeded(
                     f"request {req.request_id} spent its deadline failing over"
                 )
-            replica = self._pick(tried)
+            replica = self._pick(tried, req)
             if replica is None:
                 if tried:
                     # every healthy replica was tried and refused/died

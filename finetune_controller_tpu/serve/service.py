@@ -36,10 +36,16 @@ from typing import Any
 from aiohttp import web
 
 from ..resilience.policy import RetryPolicy
+from .adapters import AdapterError, AdapterRegistry, UnknownAdapter
 from .batcher import DeadlineExceeded, QueueFull, ReplicaUnavailable
 from .engine import EngineConfig, GenRequest, GenResult, PromptTooLong
-from .fleet import ReplicaFleet
-from .loader import ServeLoadError, load_promoted, resolve_promoted
+from .fleet import AdapterBusy, ReplicaFleet
+from .loader import (
+    ServeLoadError,
+    load_adapter as load_adapter_deltas,
+    load_promoted,
+    resolve_promoted,
+)
 from .router import FleetUnavailable, ReplicaRouter
 
 logger = logging.getLogger(__name__)
@@ -55,6 +61,10 @@ class _Session:
     meta: dict[str, Any]
     loaded_at: float
     tenant: Any = None  # sched/serve_tenant.py when autoscale is on
+    #: multiplexed tenant adapters by job id (docs/serving.md §Multi-tenant
+    #: adapters) — metas only; the weights live in the fleet's registry
+    adapters: dict[str, dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
 
 class ServeManager:
@@ -76,6 +86,9 @@ class ServeManager:
         #: future (the ISSUE 10 loader-staleness fix, with the staging race
         #: itself removed by unique stage dirs in ``loader.load_promoted``)
         self._loading: dict[str, asyncio.Future] = {}
+        #: tenant job id → base session job id: POST /jobs/{tenant}/generate
+        #: routes to the base fleet with the tenant's adapter selected
+        self._adapter_routes: dict[str, str] = {}
         self.work_dir = Path(settings.state_path) / "serve_cache"
 
     async def _event(self, job_id: str, event: str, **attrs) -> None:
@@ -93,6 +106,8 @@ class ServeManager:
                 int(s.serve_prefix_cache_mb) * (1 << 20)
                 if s.serve_prefix_cache else 0
             ),
+            page_tokens=(s.serve_kv_page_tokens if s.serve_paged_kv else 0),
+            pool_pages=(s.serve_kv_pool_pages if s.serve_paged_kv else 0),
         )
 
     def _batcher_kwargs(self) -> dict[str, Any]:
@@ -100,10 +115,23 @@ class ServeManager:
             max_queue=self.settings.serve_max_queue,
             max_wait_ms=self.settings.serve_max_wait_ms,
             default_timeout_s=self.settings.serve_request_timeout_s,
+            drr_quantum_tokens=float(self.settings.serve_drr_quantum_tokens),
             ttft_observe=(
                 self.obs.serve_ttft_seconds.observe
                 if self.obs is not None else None
             ),
+        )
+
+    @property
+    def _multi_tenant(self) -> bool:
+        return self.settings.serve_max_adapters > 0
+
+    def _adapter_registry(self) -> AdapterRegistry | None:
+        if not self._multi_tenant:
+            return None
+        return AdapterRegistry(
+            self.settings.serve_max_adapters + 1,  # + the base slot 0
+            self.settings.serve_adapter_rank,
         )
 
     async def _build_session(self, job_id, model, variables, meta) -> _Session:
@@ -112,6 +140,7 @@ class ServeManager:
             job_id, model, variables, self._engine_config(),
             replicas=s.serve_replicas,
             batcher_kwargs=self._batcher_kwargs(),
+            adapters=self._adapter_registry(),
             stall_timeout_s=s.serve_replica_stall_s,
             drain_timeout_s=s.serve_drain_timeout_s,
             restart_policy=RetryPolicy(
@@ -224,8 +253,23 @@ class ServeManager:
                     return existing.meta
         model, variables, meta = await load_promoted(
             self.state, self.store, job_id, self.work_dir,
-            merge_lora=self.settings.serve_merge_lora,
+            # multi-tenant fleets need the pristine base: the job's own
+            # adapter is stripped below and served as tenant #1 instead
+            merge_lora=(self.settings.serve_merge_lora
+                        and not self._multi_tenant),
         )
+        base_adapter = None
+        if self._multi_tenant:
+            from .loader import strip_lora_for_multitenant
+
+            model, variables, lora_tree, alpha, rank = \
+                await asyncio.to_thread(strip_lora_for_multitenant,
+                                        model, variables)
+            meta["lora_merged"] = False
+            meta["multi_tenant"] = True
+            meta["self_adapter"] = lora_tree is not None
+            if lora_tree is not None:
+                base_adapter = (lora_tree, alpha, rank)
         if existing is not None:
             same = (
                 existing.meta.get("checkpoint_step") == meta.get("checkpoint_step")
@@ -240,11 +284,24 @@ class ServeManager:
                 to_step=meta.get("checkpoint_step"),
             )
             await existing.fleet.rollover(model, variables)
+            if base_adapter is not None:
+                # the job's own adapter moved with the checkpoint: refresh
+                # tenant #1 AFTER the rollover so the new generation serves
+                # the new deltas (the registry slot is reused in place)
+                await existing.fleet.register_adapter(
+                    job_id, *base_adapter,
+                    meta={"checkpoint_step": meta.get("checkpoint_step")},
+                )
             existing.meta = meta
             logger.info("serve rollover completed for %s: %s", job_id, meta)
             return meta
         session = await self._build_session(job_id, model, variables, meta)
         self.sessions[job_id] = session
+        if base_adapter is not None:
+            await session.fleet.register_adapter(
+                job_id, *base_adapter,
+                meta={"checkpoint_step": meta.get("checkpoint_step")},
+            )
         await self._event(
             job_id, "serve-loaded",
             checkpoint_step=meta.get("checkpoint_step"),
@@ -254,10 +311,75 @@ class ServeManager:
         logger.info("serve session loaded for %s: %s", job_id, meta)
         return meta
 
+    async def load_adapter(self, base_job_id: str,
+                           adapter_job_id: str) -> dict[str, Any]:
+        """Stage a promoted LoRA job's deltas onto an already-loaded base
+        fleet as a multiplexed tenant (docs/serving.md §Multi-tenant
+        adapters) — a device write per replica, never a fleet rebuild.
+        Re-loading a tenant whose promotion moved refreshes its slot in
+        place (the tenant-rollover path)."""
+        if not self._multi_tenant:
+            raise ServeLoadError(
+                "multi-tenant serving is off (serve_max_adapters=0)",
+                status=409,
+            )
+        session = self.sessions.get(base_job_id)
+        if session is None:
+            raise ServeLoadError(
+                f"base job {base_job_id!r} is not loaded; "
+                f"POST /admin/serve/{base_job_id}/load first", status=409,
+            )
+        if adapter_job_id == base_job_id:
+            raise ServeLoadError(
+                f"job {base_job_id!r} is the base of this fleet — its own "
+                "adapter is already tenant #1", status=409,
+            )
+        routed = self._adapter_routes.get(adapter_job_id)
+        if routed is not None and routed != base_job_id:
+            raise ServeLoadError(
+                f"adapter {adapter_job_id!r} is already multiplexed on base "
+                f"{routed!r}; unload it there first", status=409,
+            )
+        lora_tree, meta = await load_adapter_deltas(
+            self.state, self.store, adapter_job_id, self.work_dir,
+            base_meta=session.meta,
+        )
+        try:
+            slot = await session.fleet.register_adapter(
+                adapter_job_id, lora_tree, meta["lora_alpha"],
+                meta["lora_rank"],
+                meta={"checkpoint_step": meta.get("checkpoint_step")},
+            )
+        except AdapterError as e:
+            raise ServeLoadError(str(e), status=409) from e
+        meta["slot"] = slot
+        meta["base_job_id"] = base_job_id
+        session.adapters[adapter_job_id] = meta
+        self._adapter_routes[adapter_job_id] = base_job_id
+        logger.info("adapter %s multiplexed onto %s: %s",
+                    adapter_job_id, base_job_id, meta)
+        return meta
+
+    async def unload_adapter(self, base_job_id: str,
+                             adapter_job_id: str) -> bool:
+        session = self.sessions.get(base_job_id)
+        if session is None or adapter_job_id not in session.adapters:
+            return False
+        try:
+            await session.fleet.unregister_adapter(adapter_job_id)
+        except AdapterBusy as e:
+            raise ServeLoadError(str(e), status=409) from e
+        session.adapters.pop(adapter_job_id, None)
+        self._adapter_routes.pop(adapter_job_id, None)
+        return True
+
     async def unload(self, job_id: str) -> bool:
         session = self.sessions.pop(job_id, None)
         if session is None:
             return False
+        for tenant_id, base_id in list(self._adapter_routes.items()):
+            if base_id == job_id:
+                self._adapter_routes.pop(tenant_id, None)
         if session.tenant is not None:
             await session.tenant.close()
             session.tenant = None
@@ -270,6 +392,13 @@ class ServeManager:
         self, job_id: str, req: GenRequest, *, timeout_s: float | None = None
     ) -> tuple[GenResult, dict[str, Any]]:
         session = self.sessions.get(job_id)
+        if session is None and not req.adapter_id:
+            # a tenant job id routes to the base fleet multiplexing it
+            base_id = self._adapter_routes.get(job_id)
+            if base_id is not None:
+                session = self.sessions.get(base_id)
+                if session is not None:
+                    req.adapter_id = job_id
         if session is None:
             if not self.settings.serve_autoload:
                 raise ServeLoadError(
@@ -283,8 +412,26 @@ class ServeManager:
                     f"job {job_id!r} was unloaded while loading; retry",
                     status=409,
                 )
+        if not req.adapter_id and session.meta.get("self_adapter"):
+            # multi-tenant base: the job's own fine-tune is tenant #1, so a
+            # plain generate keeps serving the promoted behavior (slot 0
+            # would be the raw pretrained base)
+            req.adapter_id = session.job_id
+        if req.adapter_id and req.adapter_id != session.job_id \
+                and req.adapter_id not in session.adapters:
+            raise UnknownAdapter(
+                f"adapter {req.adapter_id!r} is not loaded on base "
+                f"{session.job_id!r} (loaded: "
+                f"{sorted(session.adapters) or 'none'})"
+            )
         result = await session.router.submit(req, timeout_s=timeout_s)
-        return result, session.meta
+        meta = session.meta
+        if req.adapter_id and req.adapter_id in session.adapters:
+            meta = {**meta, "adapter": req.adapter_id,
+                    "adapter_checkpoint_step":
+                        session.adapters[req.adapter_id].get(
+                            "checkpoint_step")}
+        return result, meta
 
     def stats(self) -> dict[str, Any]:
         out: dict[str, Any] = {}
@@ -293,6 +440,12 @@ class ServeManager:
             stats.update(session.router.stats())
             if session.tenant is not None:
                 stats["autoscale"] = session.tenant.stats()
+            if session.adapters:
+                stats["adapter_jobs"] = {
+                    tid: {"slot": m.get("slot"),
+                          "checkpoint_step": m.get("checkpoint_step")}
+                    for tid, m in session.adapters.items()
+                }
             out[job_id] = stats
         return out
 
@@ -323,6 +476,9 @@ def _parse_gen_request(body: dict[str, Any], settings) -> GenRequest:
     eos_id = body.get("eos_id")
     if eos_id is not None and not isinstance(eos_id, int):
         raise ValueError("'eos_id' must be an integer token id")
+    adapter = body.get("adapter", "")
+    if adapter and not isinstance(adapter, str):
+        raise ValueError("'adapter' must be a job id string")
     return GenRequest(
         request_id=body.get("request_id") or f"gen-{uuid.uuid4().hex[:12]}",
         tokens=tokens,
@@ -331,6 +487,7 @@ def _parse_gen_request(body: dict[str, Any], settings) -> GenRequest:
         top_k=top_k,
         eos_id=eos_id,
         seed=int(body.get("seed", 0)),
+        adapter_id=adapter or "",
     )
 
 
@@ -384,6 +541,8 @@ async def generate_job(request: web.Request) -> web.Response:
         )
     except DeadlineExceeded as e:
         return _json_error(504, str(e))
+    except UnknownAdapter as e:
+        return _json_error(404, str(e))
     except (PromptTooLong, ValueError) as e:
         return _json_error(400, str(e))
     except ServeLoadError as e:
@@ -400,6 +559,7 @@ async def generate_job(request: web.Request) -> web.Response:
             "model": {
                 "checkpoint_step": meta.get("checkpoint_step"),
                 "lora_merged": meta.get("lora_merged"),
+                "adapter": meta.get("adapter") or req.adapter_id or None,
             },
         }
     )
@@ -427,6 +587,41 @@ async def admin_serve_unload(request: web.Request) -> web.Response:
     return web.json_response({"message": "unloaded"})
 
 
+async def admin_adapter_load(request: web.Request) -> web.Response:
+    """POST /admin/serve/{job_id}/adapters/{adapter_job_id}/load — stage a
+    promoted LoRA job's deltas onto the base fleet as a multiplexed tenant
+    (docs/serving.md §Multi-tenant adapters)."""
+    from ..controller.server import _admin
+
+    _admin(request)
+    manager: ServeManager = request.app[SERVE_KEY]
+    try:
+        meta = await manager.load_adapter(
+            request.match_info["job_id"],
+            request.match_info["adapter_job_id"],
+        )
+    except ServeLoadError as e:
+        return _json_error(e.status, str(e))
+    return web.json_response({"message": "adapter loaded", "adapter": meta})
+
+
+async def admin_adapter_unload(request: web.Request) -> web.Response:
+    from ..controller.server import _admin
+
+    _admin(request)
+    manager: ServeManager = request.app[SERVE_KEY]
+    try:
+        ok = await manager.unload_adapter(
+            request.match_info["job_id"],
+            request.match_info["adapter_job_id"],
+        )
+    except ServeLoadError as e:
+        return _json_error(e.status, str(e))
+    if not ok:
+        return _json_error(404, "adapter is not loaded on this base")
+    return web.json_response({"message": "adapter unloaded"})
+
+
 async def admin_serve_status(request: web.Request) -> web.Response:
     from ..controller.server import _admin
 
@@ -442,5 +637,13 @@ def add_serve_routes(app: web.Application, prefix: str) -> None:
     )
     app.router.add_post(
         f"{prefix}/admin/serve/{{job_id}}/unload", admin_serve_unload
+    )
+    app.router.add_post(
+        f"{prefix}/admin/serve/{{job_id}}/adapters/{{adapter_job_id}}/load",
+        admin_adapter_load,
+    )
+    app.router.add_post(
+        f"{prefix}/admin/serve/{{job_id}}/adapters/{{adapter_job_id}}/unload",
+        admin_adapter_unload,
     )
     app.router.add_get(f"{prefix}/admin/serve", admin_serve_status)

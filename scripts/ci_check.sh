@@ -109,13 +109,14 @@ if [ "$serve_chaos_rc" -ne 0 ]; then
     exit "$serve_chaos_rc"
 fi
 
-echo "== serve-fast (batching invariance + prefix cache + metrics) ==" >&2
+echo "== serve-fast (batching invariance + prefix cache + paged KV + adapters + metrics) ==" >&2
 # no 'not slow' filter here: the serve suite IS this stage's whole job, so
 # its slow-marked extras (sampled-decode parity, prefix-cache eviction
-# mid-flight) run too — they are excluded from tier-1 below only to
-# protect that stage's wall-clock budget
-timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+# mid-flight, the multi-tenant HTTP loop) run too — they are excluded from
+# tier-1 below only to protect that stage's wall-clock budget
+timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_serve.py tests/test_prefix_cache.py \
+    tests/test_kv_pages.py tests/test_serve_adapters.py \
     tests/test_metrics_endpoint.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 serve_rc=$?

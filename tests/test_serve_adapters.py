@@ -1,0 +1,624 @@
+"""Multi-tenant unmerged-LoRA multiplexing (ISSUE 11, docs/serving.md
+§Multi-tenant adapters).
+
+Anchors: N tenants multiplexed on ONE engine are bit-identical to N
+dedicated single-tenant engines (greedy and sampled, staggered mixed
+batches); rank padding is bit-neutral; the gathered-einsum math matches a
+merged-weights model to float tolerance (merged differs only by fp
+reassociation); prefix-cache keys include the adapter id so one tenant's KV
+never splices into another's; deficit-round-robin admission keeps a hot
+tenant from starving the rest; and the whole surface rides the HTTP loop —
+load base unmerged, stage adapter deltas, generate per tenant, unload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import run_async
+from finetune_controller_tpu.models.generate import cached_generate
+from finetune_controller_tpu.models.llama import PRESETS, LlamaForCausalLM
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.serve.adapters import (
+    AdapterError,
+    AdapterRegistry,
+    UnknownAdapter,
+)
+from finetune_controller_tpu.serve.batcher import Batcher
+from finetune_controller_tpu.serve.engine import (
+    BatchEngine,
+    EngineConfig,
+    GenRequest,
+)
+
+BASE_CFG = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=0))
+
+
+@pytest.fixture(scope="module")
+def base_model():
+    model = LlamaForCausalLM(BASE_CFG)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 4), jnp.int32)
+    )
+    return model, {"params": variables["params"]}
+
+
+def _lora_shapes(rank):
+    cfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=rank))
+    return jax.eval_shape(
+        LlamaForCausalLM(cfg).init,
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 4), jnp.int32),
+    )["lora"]
+
+
+def _make_adapter(seed, rank):
+    """Random nonzero A and B (B nonzero so tenants actually diverge)."""
+    return jax.tree.map(
+        lambda s: 0.05 * np.asarray(
+            jax.random.normal(jax.random.PRNGKey(seed), s.shape), np.float32
+        ),
+        _lora_shapes(rank),
+    )
+
+
+def _tenant_engine(model, variables, n_tenants, **kw):
+    defaults = dict(slots=4, prompt_buckets=(8, 16), max_new_tokens=24,
+                    page_tokens=8, tenant_slots=n_tenants + 1, tenant_rank=8)
+    defaults.update(kw)
+    return BatchEngine(model, variables, EngineConfig(**defaults))
+
+
+def _dedicated(model, variables, aid, tree, alpha, rank, req, **kw):
+    """One single-tenant engine — the deployment alternative multiplexing
+    displaces (a whole replica set per fine-tuned job)."""
+    eng = _tenant_engine(model, variables, 1, slots=2, **kw)
+    eng.adapters.register(aid, tree, alpha, rank)
+    eng.install_adapter(aid)
+    return eng.run([req])[req.request_id].generated
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_slots_capacity_and_reuse():
+    reg = AdapterRegistry(capacity=3, max_rank=8)  # 2 tenant slots
+    a = reg.register("a", {}, 16.0, 4)
+    b = reg.register("b", {}, 16.0, 4)
+    assert {a.slot, b.slot} == {1, 2}
+    with pytest.raises(AdapterError, match="full"):
+        reg.register("c", {}, 16.0, 4)
+    assert reg.resolve("") == 0
+    assert reg.resolve("a") == a.slot
+    with pytest.raises(UnknownAdapter):
+        reg.resolve("ghost")
+    # re-register refreshes IN PLACE (tenant checkpoint rollover)
+    a2 = reg.register("a", {"new": True}, 16.0, 6)
+    assert a2.slot == a.slot and a2.rank == 6
+    # unregister frees the slot for a different tenant
+    reg.unregister("b")
+    c = reg.register("c", {}, 16.0, 2)
+    assert c.slot == b.slot
+
+
+def test_registry_refuses_bad_ranks():
+    reg = AdapterRegistry(capacity=3, max_rank=4)
+    with pytest.raises(AdapterError, match="rank"):
+        reg.register("big", {}, 16.0, 8)
+    with pytest.raises(AdapterError, match="rank"):
+        reg.register("zero", {}, 16.0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Numerics: multiplexed == dedicated, padding bit-neutral, merged ~= unmerged
+# ---------------------------------------------------------------------------
+
+
+def test_multiplexed_bit_identical_to_dedicated_mixed_ranks(base_model):
+    """Four tenants of DIFFERENT ranks multiplexed on one engine, staggered
+    with base-model traffic: every output is bit-identical to a dedicated
+    single-tenant engine (rank padding in the shared stack is bit-neutral),
+    and the base lane is bit-identical to cached_generate."""
+    model, variables = base_model
+    tenants = {f"t{i}": (_make_adapter(60 + i, 2 * (i % 3) + 2),
+                         2 * (i % 3) + 2)
+               for i in range(4)}
+    eng = _tenant_engine(model, variables, 4, slots=3)
+    for aid, (tree, rank) in tenants.items():
+        eng.adapters.register(aid, tree, 16.0, rank)
+        eng.install_adapter(aid)
+    prompt = [3, 1, 4, 1, 5, 9]
+    reqs = [
+        GenRequest(request_id=f"m-{aid}", tokens=prompt,
+                   max_new_tokens=6 + i, adapter_id=aid)
+        for i, aid in enumerate(tenants)
+    ]
+    reqs.append(GenRequest(request_id="m-base", tokens=prompt,
+                           max_new_tokens=8))
+    res = eng.run(reqs)  # slots=3 < 5 requests: tenants share steps
+    outs = {}
+    for i, (aid, (tree, rank)) in enumerate(tenants.items()):
+        outs[aid] = _dedicated(
+            model, variables, aid, tree, 16.0, rank,
+            GenRequest(request_id="d", tokens=prompt, max_new_tokens=6 + i,
+                       adapter_id=aid),
+        )
+        assert res[f"m-{aid}"].generated == outs[aid], f"{aid} diverged"
+    base = cached_generate(model, variables, jnp.asarray([prompt], jnp.int32),
+                           max_new_tokens=8)
+    assert res["m-base"].generated == list(np.asarray(base[0, len(prompt):]))
+    # the tenants genuinely compute different things
+    assert len({tuple(v) for v in outs.values()}) >= 2
+    # per-tenant accounting followed the lanes
+    for aid in tenants:
+        assert eng.tokens_by_tenant[aid] == len(res[f"m-{aid}"].generated)
+
+
+def test_multiplexed_sampled_reproducible_per_tenant(base_model):
+    model, variables = base_model
+    tree = _make_adapter(77, 4)
+    eng = _tenant_engine(model, variables, 2)
+    eng.adapters.register("s", tree, 16.0, 4)
+    eng.install_adapter("s")
+    req = GenRequest(request_id="r", tokens=[7, 7, 2, 9], max_new_tokens=8,
+                     temperature=0.9, top_k=5, seed=123, adapter_id="s")
+    got = eng.run([req])["r"].generated
+    want = _dedicated(
+        model, variables, "s", tree, 16.0, 4,
+        GenRequest(request_id="d", tokens=[7, 7, 2, 9], max_new_tokens=8,
+                   temperature=0.9, top_k=5, seed=123, adapter_id="s"),
+    )
+    assert got == want
+
+
+def test_unmerged_tenant_logits_match_merged_model():
+    """The gathered-stack math computes the same function as merging
+    ``W + (alpha/r) A B`` into the kernels — to float tolerance: the two
+    evaluation orders differ by fp reassociation, which is why the serve
+    gates compare multiplexed against DEDICATED UNMERGED engines for bit
+    identity and against merged weights only at this tolerance."""
+    from finetune_controller_tpu.serve.loader import merge_lora_variables
+
+    # f32 compute isolates the reassociation claim from bf16 rounding
+    # (in bf16 the two orders differ at bf16 epsilon, far above 1e-4)
+    f32_cfg = BASE_CFG.replace(dtype=jnp.float32)
+    model = LlamaForCausalLM(f32_cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    tree = _make_adapter(88, 4)
+    tokens = jnp.asarray([[3, 1, 4, 1, 5, 9]], jnp.int32)
+
+    # unmerged: tenant stacks on the base model
+    tcfg = f32_cfg.replace(lora_tenant_slots=2, lora_tenant_rank=4)
+    tmodel = LlamaForCausalLM(tcfg)
+    _, tvars = tmodel.apply(
+        {"params": params}, tokens, deterministic=True,
+        mutable=("tenants",), adapter_ids=jnp.zeros((1,), jnp.int32),
+    )
+    from finetune_controller_tpu.serve.adapters import install_into
+
+    tenants = install_into(tvars["tenants"], 1, tree, 16.0, 4)
+    lo_t = tmodel.apply(
+        {"params": params, "tenants": tenants}, tokens, deterministic=True,
+        adapter_ids=jnp.ones((1,), jnp.int32),
+    )
+
+    # merged: the production merge math folds the same deltas into W
+    lcfg = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=4),
+                                        dtype=jnp.float32)
+    mcfg, mvars = merge_lora_variables(
+        lcfg, {"params": params, "lora": jax.tree.map(jnp.asarray, tree)}
+    )
+    lo_m = LlamaForCausalLM(mcfg).apply(mvars, tokens, deterministic=True)
+    np.testing.assert_allclose(
+        np.asarray(lo_t, np.float32), np.asarray(lo_m, np.float32),
+        rtol=2e-4, atol=2e-4,
+    )
+    # and they are NOT bit-equal — the documented reason merged engines are
+    # not the bit-identity comparator
+    assert lo_t.shape == lo_m.shape
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache: adapter-namespaced keys (the divergence satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_never_splices_across_adapters(base_model):
+    """THE cross-tenant poisoning pin: with the prefix cache on, tenant B
+    sending the exact prompt tenant A just cached must MISS (KV depends on
+    the adapter that computed it) and produce B's own bit-exact output,
+    while a same-tenant repeat still HITS."""
+    model, variables = base_model
+    ta, tb = _make_adapter(91, 4), _make_adapter(92, 4)
+    eng = _tenant_engine(model, variables, 2, slots=2,
+                         prefix_cache_bytes=1 << 20)
+    eng.adapters.register("A", ta, 16.0, 4)
+    eng.adapters.register("B", tb, 16.0, 4)
+    eng.sync_adapters()
+    shared = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def req(rid, aid, tail):
+        return GenRequest(request_id=rid, tokens=shared + [tail],
+                          max_new_tokens=8, adapter_id=aid)
+
+    out_a = eng.run([req("a1", "A", 30)])["a1"].generated
+    misses0, hits0 = eng.prefix_misses_total, eng.prefix_hits_total
+    # same prompt, OTHER adapter: must not touch A's cached KV
+    out_b = eng.run([req("b1", "B", 30)])["b1"].generated
+    assert eng.prefix_misses_total == misses0 + 1
+    assert eng.prefix_hits_total == hits0
+    # same prompt, SAME adapter: the hit path still works per namespace
+    out_a2 = eng.run([req("a2", "A", 31)])["a2"].generated
+    assert eng.prefix_hits_total == hits0 + 1
+    # both tenants match their dedicated engines bit-for-bit
+    assert out_a == _dedicated(model, variables, "A", ta, 16.0, 4,
+                               req("d", "A", 30))
+    assert out_b == _dedicated(model, variables, "B", tb, 16.0, 4,
+                               req("d", "B", 30))
+    assert out_a2 == _dedicated(model, variables, "A", ta, 16.0, 4,
+                                req("d", "A", 31))
+    assert out_a != out_b  # the adapters genuinely diverge on this prompt
+
+
+def test_unload_drops_namespace_and_slot_reuse_is_clean(base_model):
+    """After unregister, a NEW tenant reusing the slot id must not see the
+    old tenant's cached KV (the namespace is the adapter id, dropped on
+    unload) and must compute its own weights."""
+    model, variables = base_model
+    old, new = _make_adapter(93, 4), _make_adapter(94, 4)
+    eng = _tenant_engine(model, variables, 1, slots=2,
+                         prefix_cache_bytes=1 << 20)
+    eng.adapters.register("old", old, 16.0, 4)
+    eng.install_adapter("old")
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    eng.run([GenRequest(request_id="o", tokens=prompt + [1],
+                        max_new_tokens=4, adapter_id="old")])
+    entry = eng.adapters.get("old")
+    eng.adapters.unregister("old")
+    eng.remove_adapter("old", entry.slot)
+    reused = eng.adapters.register("new", new, 16.0, 4)
+    assert reused.slot == entry.slot
+    eng.install_adapter("new")
+    misses0 = eng.prefix_misses_total
+    got = eng.run([GenRequest(request_id="n", tokens=prompt + [1],
+                              max_new_tokens=6, adapter_id="new")])
+    assert eng.prefix_misses_total == misses0 + 1  # old namespace is gone
+    want = _dedicated(model, variables, "new", new, 16.0, 4,
+                      GenRequest(request_id="d", tokens=prompt + [1],
+                                 max_new_tokens=6, adapter_id="new"))
+    assert got["n"].generated == want
+
+
+def test_unknown_adapter_fails_the_request(base_model):
+    model, variables = base_model
+    eng = _tenant_engine(model, variables, 1)
+    with pytest.raises(UnknownAdapter, match="ghost"):
+        eng.admit(GenRequest(request_id="x", tokens=[1, 2],
+                             max_new_tokens=4, adapter_id="ghost"))
+    # an engine with NO registry names the knob
+    plain = BatchEngine(model, variables, EngineConfig(
+        slots=2, prompt_buckets=(8, 16), max_new_tokens=24))
+    with pytest.raises(UnknownAdapter, match="serve_max_adapters"):
+        plain.admit(GenRequest(request_id="x", tokens=[1, 2],
+                               max_new_tokens=4, adapter_id="ghost"))
+
+
+# ---------------------------------------------------------------------------
+# Fairness: deficit round robin
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_refresh_drops_stale_prefix_namespace(base_model):
+    """Tenant rollover (re-register of an existing adapter id with NEW
+    deltas): KV cached under the old weights must be dropped, or the next
+    same-prompt request would splice old-checkpoint KV into a lane decoding
+    with the new deltas — silently wrong output."""
+    from finetune_controller_tpu.serve.fleet import ReplicaFleet
+
+    model, variables = base_model
+    old, new = _make_adapter(97, 4), _make_adapter(98, 4)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    async def main():
+        fleet = ReplicaFleet(
+            "job-y", model, variables,
+            EngineConfig(slots=2, prompt_buckets=(8, 16), max_new_tokens=24,
+                         page_tokens=8, prefix_cache_bytes=1 << 20),
+            replicas=1, warm_start=False,
+            adapters=AdapterRegistry(capacity=2, max_rank=8),
+        )
+        await fleet.start()
+        await fleet.register_adapter("t", old, 16.0, 4)
+        eng = fleet.replicas["r0"].engine
+        eng.run([GenRequest(request_id="seed", tokens=prompt + [1],
+                            max_new_tokens=4, adapter_id="t")])
+        assert eng.prefix_cache_entries >= 1
+        # refresh IN PLACE with new deltas (the tenant-rollover path)
+        await fleet.register_adapter("t", new, 16.0, 4)
+        misses0 = eng.prefix_misses_total
+        got = eng.run([GenRequest(request_id="after", tokens=prompt + [1],
+                                  max_new_tokens=8, adapter_id="t")])
+        # the old-weights entry is GONE: this admission missed, and the
+        # output matches a dedicated engine running only the new deltas
+        assert eng.prefix_misses_total == misses0 + 1
+        want = _dedicated(model, variables, "t", new, 16.0, 4,
+                          GenRequest(request_id="d", tokens=prompt + [1],
+                                     max_new_tokens=8, adapter_id="t"))
+        assert got["after"].generated == want
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_unregister_busy_check_sees_mid_admission_requests(base_model):
+    """A request can sit in batcher._inflight (mid-admission in the worker
+    thread) before the engine shows a lane for it — the unload busy check
+    must count that window, or the tenant's slot could be zeroed under a
+    request that already resolved it."""
+    from finetune_controller_tpu.serve.batcher import _Pending
+    from finetune_controller_tpu.serve.fleet import AdapterBusy, ReplicaFleet
+
+    model, variables = base_model
+    tree = _make_adapter(99, 4)
+
+    async def main():
+        fleet = ReplicaFleet(
+            "job-z", model, variables,
+            EngineConfig(slots=2, prompt_buckets=(8, 16), max_new_tokens=24),
+            replicas=1, warm_start=False,
+            adapters=AdapterRegistry(capacity=2, max_rank=8),
+        )
+        await fleet.start()
+        await fleet.register_adapter("t", tree, 16.0, 4)
+        batcher = fleet.replicas["r0"].batcher
+        req = GenRequest(request_id="mid", tokens=[1, 2], max_new_tokens=4,
+                         adapter_id="t")
+        # simulate the admission window: in _inflight, no engine lane yet
+        batcher._inflight["mid"] = _Pending(
+            req=req, future=asyncio.get_running_loop().create_future(),
+            enqueued_at=0.0, deadline=None,
+        )
+        assert fleet.replicas["r0"].engine.active_by_tenant().get("t", 0) == 0
+        with pytest.raises(AdapterBusy):
+            await fleet.unregister_adapter("t")
+        batcher._inflight.pop("mid").future.cancel()
+        await fleet.unregister_adapter("t")  # idle now: unload succeeds
+        assert fleet.adapters.get("t") is None
+        await fleet.close()
+
+    run_async(main())
+
+
+def test_fleet_rollover_keeps_adapters_installed(base_model):
+    """A rollover generation's replicas sync the adapter registry at build
+    time: tenant traffic keeps decoding bit-identically after the swap."""
+    from finetune_controller_tpu.serve.fleet import ReplicaFleet
+    from finetune_controller_tpu.serve.router import ReplicaRouter
+
+    model, variables = base_model
+    tree = _make_adapter(96, 4)
+
+    async def main():
+        fleet = ReplicaFleet(
+            "job-x", model, variables,
+            EngineConfig(slots=2, prompt_buckets=(8, 16), max_new_tokens=24,
+                         page_tokens=8),
+            replicas=1, warm_start=False,
+            adapters=AdapterRegistry(capacity=2, max_rank=8),
+        )
+        await fleet.start()
+        await fleet.register_adapter("t", tree, 16.0, 4)
+        router = ReplicaRouter(fleet)
+        req = GenRequest(request_id="r1", tokens=[3, 1, 4, 1],
+                         max_new_tokens=6, adapter_id="t")
+        before = (await router.submit(req)).generated
+        assert before == _dedicated(
+            model, variables, "t", tree, 16.0, 4,
+            GenRequest(request_id="d", tokens=[3, 1, 4, 1],
+                       max_new_tokens=6, adapter_id="t"))
+        await fleet.rollover(model, variables)
+        assert fleet.generation == 1
+        req2 = GenRequest(request_id="r2", tokens=[3, 1, 4, 1],
+                          max_new_tokens=6, adapter_id="t")
+        after = (await router.submit(req2)).generated
+        assert after == before
+        # aggregate stats carry the tenant counters across the retirement
+        assert fleet.stats()["tokens_by_tenant"]["t"] == 12
+        await fleet.close()
+
+    run_async(main())
+
+
+@pytest.mark.slow  # HTTP loop; runs on every ci_check gate via serve-fast
+def test_multitenant_adapters_http_loop(tmp_path):
+    """The whole multi-tenant surface over HTTP: base loads UNMERGED with
+    its own adapter as tenant #1, a second promoted LoRA job stages only
+    its deltas onto the running fleet, generate routes per tenant (body
+    field AND the tenant job id directly), /metrics exports the page-pool
+    and per-tenant gauges, and mismatched bases are refused."""
+    import json as _json
+
+    from test_api import _client
+    from test_serve import _fabricate_promoted_job, _serve_runtime
+
+    async def main():
+        rt = _serve_runtime(tmp_path)
+        rt.settings.serve_max_adapters = 2
+        rt.settings.serve_paged_kv = True
+        rt.settings.serve_kv_page_tokens = 8
+        client = await _client(rt)
+        base_id = await _fabricate_promoted_job(rt, "tiny-base-0001")
+        tenant_id = await _fabricate_promoted_job(rt, "tiny-tena-0001")
+
+        # adapter-load on a not-yet-loaded base refuses with direction
+        r = await client.post(
+            f"/api/v1/admin/serve/{base_id}/adapters/{tenant_id}/load")
+        assert r.status == 409
+        assert "load first" in (await r.json())["detail"]
+
+        r = await client.post(f"/api/v1/admin/serve/{base_id}/load")
+        assert r.status == 200, await r.text()
+        meta = (await r.json())["model"]
+        assert meta["multi_tenant"] is True
+        assert meta["lora_merged"] is False
+        assert meta["self_adapter"] is True  # the job's own fine-tune
+
+        r = await client.post(
+            f"/api/v1/admin/serve/{base_id}/adapters/{tenant_id}/load")
+        assert r.status == 200, await r.text()
+        ameta = (await r.json())["adapter"]
+        assert ameta["base_job_id"] == base_id and ameta["slot"] >= 1
+
+        # generate against the base with the tenant selected in the body
+        body = {"tokens": [5, 9, 2, 7], "max_new_tokens": 6,
+                "adapter": tenant_id}
+        r = await client.post(f"/api/v1/jobs/{base_id}/generate", json=body)
+        assert r.status == 200, await r.text()
+        out = await r.json()
+        assert out["model"]["adapter"] == tenant_id
+        assert len(out["tokens"]) == 6
+
+        # the tenant's own job id routes to the base fleet transparently
+        r = await client.post(
+            f"/api/v1/jobs/{tenant_id}/generate",
+            json={"tokens": [5, 9, 2, 7], "max_new_tokens": 6},
+        )
+        assert r.status == 200, await r.text()
+        assert (await r.json())["tokens"] == out["tokens"]
+
+        # unknown adapter: 404 naming what IS loaded
+        r = await client.post(
+            f"/api/v1/jobs/{base_id}/generate",
+            json={"tokens": [1, 2], "adapter": "ghost"},
+        )
+        assert r.status == 404
+        assert tenant_id in (await r.json())["detail"]
+
+        # admin view: adapters + page pool visible
+        sessions = (await (await client.get("/api/v1/admin/serve")).json())[
+            "sessions"]
+        s = sessions[base_id]
+        assert s["adapters_loaded"] == 2       # self adapter + tenant
+        assert tenant_id in s["adapters"]
+        assert s["kv_pages_total"] > 0
+        assert s["kv_pages_used"] >= 0
+
+        # /metrics: page-pool gauges + per-tenant series with labels
+        text = await (await client.get("/metrics")).text()
+        assert "ftc_serve_kv_pages_free" in text
+        assert "ftc_serve_adapters_loaded" in text
+        assert f'ftc_serve_tenant_tokens_total{{job_id="{base_id}",' \
+               f'adapter="{tenant_id}"}}' in text
+
+        # unload the tenant; its route disappears
+        r = await client.post(
+            f"/api/v1/admin/serve/{base_id}/adapters/{tenant_id}/unload")
+        assert r.status == 200
+        r = await client.post(
+            f"/api/v1/admin/serve/{base_id}/adapters/{tenant_id}/unload")
+        assert r.status == 404
+        r = await client.post(
+            f"/api/v1/jobs/{base_id}/generate",
+            json={"tokens": [1, 2], "adapter": tenant_id},
+        )
+        assert r.status == 404
+
+        # a job trained on a DIFFERENT base refuses with both bases named
+        from finetune_controller_tpu.controller.schemas import (
+            DatabaseStatus,
+            JobRecord,
+            PromotionStatus,
+        )
+        from finetune_controller_tpu.train.checkpoint import CheckpointManager
+        from finetune_controller_tpu.train.cli import (
+            build_model_config,
+            build_train_config,
+        )
+        from finetune_controller_tpu.train.trainer import Trainer
+        import tempfile
+        from pathlib import Path
+
+        other_id = "tiny-qwen-0001"
+        spec = {
+            "job_id": other_id,
+            "model": {"preset": "tiny-qwen-test", "lora": {"rank": 2}},
+            "training": {
+                "mode": "lora", "total_steps": 2, "batch_size": 2,
+                "seq_len": 16, "log_every": 10**9,
+                "checkpoint_every": 10**9,
+            },
+            "artifacts_dir": "unused",
+        }
+        trainer = Trainer(build_model_config(spec), build_train_config(spec))
+        host = trainer.state_to_host(trainer.init_state())
+        prefix = f"obj://{rt.settings.deploy_bucket}/models/{other_id}"
+        with tempfile.TemporaryDirectory() as d:
+            CheckpointManager(f"{d}/checkpoints").save(1, host, blocking=True)
+            (Path(d) / "resolved_config.json").write_text(_json.dumps(spec))
+            for path in Path(d).rglob("*"):
+                if path.is_file():
+                    rel = path.relative_to(d)
+                    await rt.store.put_file(f"{prefix}/{rel}", path)
+        await rt.state.create_job(JobRecord(
+            job_id=other_id, user_id="dev-user", model_name="tiny-qwen-lora",
+            status=DatabaseStatus.SUCCEEDED,
+            promotion_status=PromotionStatus.COMPLETED,
+            promotion_uri=prefix,
+        ))
+        r = await client.post(
+            f"/api/v1/admin/serve/{base_id}/adapters/{other_id}/load")
+        assert r.status == 409
+        assert "preset" in (await r.json())["detail"]
+        await client.close()
+
+    run_async(main())
+
+
+def test_drr_hot_tenant_cannot_starve_cold_tenant(base_model):
+    """A hot tenant floods the queue; a cold tenant's two requests arrive
+    after all of them.  Deficit round robin must interleave: the cold
+    requests finish well before the hot backlog drains."""
+    model, variables = base_model
+    tree = _make_adapter(95, 2)
+
+    async def main():
+        eng = _tenant_engine(model, variables, 1, slots=2)
+        eng.adapters.register("cold", tree, 16.0, 2)
+        eng.install_adapter("cold")
+        b = Batcher(eng, max_queue=64, drr_quantum_tokens=16.0)
+        order: list[str] = []
+
+        async def track(req):
+            await b.submit(req, timeout_s=120)
+            order.append(req.request_id)
+
+        hot = [
+            GenRequest(request_id=f"hot{i}", tokens=[5, 9, 2, 7],
+                       max_new_tokens=6)
+            for i in range(20)
+        ]
+        cold = [
+            GenRequest(request_id=f"cold{i}", tokens=[5, 9, 2, 7],
+                       max_new_tokens=6, adapter_id="cold")
+            for i in range(2)
+        ]
+        tasks = [asyncio.ensure_future(track(r)) for r in hot]
+        await asyncio.sleep(0)  # the hot backlog is queued first
+        tasks += [asyncio.ensure_future(track(r)) for r in cold]
+        await asyncio.gather(*tasks)
+        cold_pos = sorted(order.index(r.request_id) for r in cold)
+        # both cold requests must land in the first half of completions —
+        # FIFO would have put them dead last (positions 20, 21)
+        assert cold_pos[-1] < len(order) // 2, (
+            f"cold tenant starved: completion order {order}"
+        )
+        await b.close()
+
+    run_async(main())

@@ -330,7 +330,8 @@ def test_router_passes_unlimited_timeout_through(tiny_model):
         ))
         pend: list = []
         for _ in range(400):
-            pend = r0.batcher._queue + list(r0.batcher._inflight.values())
+            pend = (list(r0.batcher.queued())
+                    + list(r0.batcher._inflight.values()))
             if pend:
                 break
             await asyncio.sleep(0.002)
@@ -514,7 +515,8 @@ def test_failover_keeps_original_deadline_and_drops_once(tiny_model):
         # failed over to r1 with the ORIGINAL absolute deadline
         pend: list = []
         for _ in range(100):
-            pend = list(r1.batcher._inflight.values()) + r1.batcher._queue
+            pend = (list(r1.batcher._inflight.values())
+                    + list(r1.batcher.queued()))
             if pend:
                 break
             await asyncio.sleep(0.005)
@@ -626,11 +628,12 @@ def test_retry_after_derived_from_queue_depth_and_decode_rate(tiny_model):
         assert base >= 1.0
         # a (much) deeper queue means a later retry hint: deep enough that
         # the estimate clears the 1 s floor regardless of box speed
-        b._queue = [object()] * 5000  # type: ignore[assignment]
+        import collections
+        b._queues[""] = collections.deque([object()] * 5000)  # type: ignore
         deep = b.retry_after_s()
         assert deep > base
         assert deep <= 120.0
-        b._queue = []
+        b._queues.clear()
         await b.close()
 
     run_async(main())
