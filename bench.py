@@ -52,7 +52,13 @@ default on), BENCH_SERVE_PAGE_TOKENS (page size, default 16) and
 BENCH_SERVE_ADAPTERS (multiplexed tenants, default 4) — gated on >= 2x
 concurrent lanes at a fixed KV byte budget, >= 0.9x mixed-workload tok/s at
 equal concurrency (bit-identical outputs), and multiplexed-vs-dedicated
-bit-identity across adapters.
+bit-identity across adapters.  Cross-process transport gates (ISSUE 12):
+BENCH_SERVE_TRANSPORT (1 = run the process-mode A/B; default on),
+BENCH_SERVE_CONC (concurrent mixed-length requests, floor 64),
+BENCH_SERVE_TRANSPORT_WORKERS / _SLOTS — gated (multi-core hosts) on
+process-mode N-worker throughput >= 1.5x one worker, beating the
+in-process contention baseline, and the 64+-concurrent p95 latency
+fair-share bound.
 
 Observability knobs (BENCH_MODE=obs, gated <2% overhead): BENCH_OBS_STEPS,
 BENCH_OBS_ROUNDS, BENCH_BATCH, BENCH_SEQ (docs/observability.md).
@@ -1203,6 +1209,12 @@ def _measure_serve() -> dict:
         )
         adapter_metrics = _measure_serve_adapters(cfg, variables, max_new=max_new)
 
+    # --- cross-process transport A/B + 64-concurrency gate (ISSUE 12) ----
+    transport_metrics: dict = {}
+    if os.environ.get("BENCH_SERVE_TRANSPORT", "1").strip().lower() not in (
+            "0", "false", "no"):
+        transport_metrics = _measure_serve_transport(max_new=max_new)
+
     return {
         "metric": f"serve_tokens_per_sec[{preset},req{n_requests},"
                   f"new{max_new},slots{slots}]",
@@ -1231,7 +1243,244 @@ def _measure_serve() -> dict:
         "fleet": fleet_metrics,
         "paged": paged_metrics,
         "adapters": adapter_metrics,
+        "transport": transport_metrics,
         "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def _measure_serve_transport(*, max_new) -> dict:
+    """The ISSUE 12 cross-process gates, run inside ``BENCH_MODE=serve``:
+
+    1. **scaling A/B**: the same 64+-concurrent mixed-length workload runs
+       on four fleets — in-process 1 and N replicas, process-mode 1 and N
+       workers.  On a multi-core host, N process workers must reach >= 1.5x
+       the single worker's throughput AND beat the in-process N-replica
+       ratio (in-process replicas share one JAX runtime, so their "scaling"
+       is contention — measuring that baseline is part of the gate);
+    2. **the deferred 64+-concurrent mixed-length latency gate** (ISSUE 10
+       deferred it until replicas stopped sharing cores): every accepted
+       request completes exactly once, and p95 completion latency on the
+       N-worker process fleet stays within the fair-share queueing bound
+       ``(conc / (workers * slots) + 2) x solo-request latency``.
+
+    Every leg uses the deterministic ``tiny_test`` payload so in-process
+    and worker processes decode identical weights.  Gates are enforced only
+    on hosts with >= 2 cores per worker (BENCH notes in ROADMAP.md: this
+    box is 2-CPU — numbers are recorded, the scaling assertion needs real
+    cores); ``BENCH_SERVE_TRANSPORT=0`` skips the whole leg.
+    """
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    from finetune_controller_tpu.serve.engine import EngineConfig, GenRequest
+    from finetune_controller_tpu.serve.fleet import ReplicaFleet
+    from finetune_controller_tpu.serve.router import ReplicaRouter
+    from finetune_controller_tpu.transport.builders import tiny_test
+    from finetune_controller_tpu.transport.process import ProcessTransport
+
+    conc = max(64, int(os.environ.get("BENCH_SERVE_CONC", "64")))
+    workers = max(2, int(os.environ.get("BENCH_SERVE_TRANSPORT_WORKERS", "2")))
+    slots = int(os.environ.get("BENCH_SERVE_TRANSPORT_SLOTS", "4"))
+    new_tokens = min(max_new, 16)  # bounds the 4-leg wall clock
+    ecfg = EngineConfig(slots=slots, prompt_buckets=(16, 32),
+                        max_new_tokens=new_tokens + 8)
+    model, variables = tiny_test()
+    rng = np.random.default_rng(7)
+    prompts = [
+        [int(t) for t in rng.integers(1, model.cfg.vocab_size - 1, size=int(n))]
+        for n in rng.integers(4, 30, size=conc)
+    ]
+
+    def reqs(tag, subset=None):
+        chosen = prompts if subset is None else prompts[:subset]
+        return [
+            GenRequest(request_id=f"{tag}{i}", tokens=p,
+                       max_new_tokens=new_tokens)
+            for i, p in enumerate(chosen)
+        ]
+
+    def pct(xs, p):
+        return float(np.percentile(np.asarray(xs), p))
+
+    async def leg(mode: str, replicas: int, root) -> dict:
+        if mode == "process":
+            transport = ProcessTransport(
+                job_id="bench-transport", root=Path(root),
+                payload={"builder": "tiny_test", "kwargs": {}},
+                spawn_timeout_s=600.0,
+            )
+            fleet = ReplicaFleet("bench-transport", None, None, ecfg,
+                                 replicas=replicas, transport=transport)
+        else:
+            fleet = ReplicaFleet("bench-transport", model, variables, ecfg,
+                                 replicas=replicas)
+        t_spawn = time.perf_counter()
+        await fleet.start()
+        spawn_s = time.perf_counter() - t_spawn
+        router = ReplicaRouter(fleet, default_timeout_s=600,
+                               failover_retries=2)
+        # engines warm-start at spawn; this wave warms the routing/RPC path
+        await asyncio.gather(*(
+            router.submit(r) for r in reqs("w", subset=replicas * slots)
+        ))
+        t1 = time.perf_counter()
+        await router.submit(GenRequest(
+            request_id="solo", tokens=prompts[0], max_new_tokens=new_tokens,
+        ))
+        solo_s = time.perf_counter() - t1
+        lat: list[float] = []
+
+        outputs: dict[str, list[int]] = {}
+
+        async def one(r):
+            t2 = time.perf_counter()
+            res = await router.submit(r)
+            lat.append(time.perf_counter() - t2)
+            outputs[res.request_id] = [int(t) for t in res.generated]
+            return len(res.generated)
+
+        t0 = time.perf_counter()
+        tokens = sum(await asyncio.gather(*(one(r) for r in reqs("m"))))
+        window = time.perf_counter() - t0
+        stats = fleet.stats()
+        await fleet.close()
+        completed_wave = len(lat)
+        if completed_wave != conc:
+            fail("transport leg lost requests", mode=mode,
+                 replicas=replicas, completed=completed_wave, expected=conc)
+        return {
+            "tokens_per_sec": round(tokens / window, 1),
+            "window_s": round(window, 3),
+            "spawn_s": round(spawn_s, 2),
+            "solo_latency_s": round(solo_s, 4),
+            "p50_latency_s": round(pct(lat, 50), 4),
+            "p95_latency_s": round(pct(lat, 95), 4),
+            "transport": stats["transport"],
+            "worker_pids": stats.get("worker_pids", []),
+            "_outputs": outputs,
+        }
+
+    async def chaos_leg(root, baseline: dict[str, list[int]]) -> dict:
+        """The serve-chaos satellite in PROCESS mode: the same
+        ``FTC_FAULT_SERVE_*`` env, forwarded into the worker spawn, makes
+        the victim REALLY SIGKILL itself mid-decode — exactly-once and
+        bit-identity are then proven against genuine process death."""
+        from finetune_controller_tpu.resilience.faults import ServeFault
+        from finetune_controller_tpu.resilience.policy import RetryPolicy
+
+        once = Path(root) / "fault-spent"
+        transport = ProcessTransport(
+            job_id="bench-transport-chaos", root=Path(root),
+            payload={"builder": "tiny_test", "kwargs": {}},
+            spawn_timeout_s=600.0,
+            extra_env=ServeFault(
+                replica_id="r0", at_step=2, mode="kill",
+                once_file=str(once),
+            ).to_env(),
+        )
+        fleet = ReplicaFleet(
+            "bench-transport-chaos", None, None, ecfg, replicas=workers,
+            transport=transport,
+            restart_policy=RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                                       max_delay_s=0.3, seed=0),
+        )
+        await fleet.start()
+        router = ReplicaRouter(fleet, default_timeout_s=600,
+                               failover_retries=2)
+
+        async def health_loop():
+            while True:
+                await fleet.health_tick()
+                await asyncio.sleep(0.1)
+
+        hl = asyncio.ensure_future(health_loop())
+        try:
+            results = await asyncio.gather(
+                *(router.submit(r) for r in reqs("m", subset=16))
+            )
+            seen: dict[str, list[int]] = {}
+            for r in results:
+                if r.request_id in seen:
+                    fail("process serve-chaos: request completed twice",
+                         request_id=r.request_id)
+                seen[r.request_id] = [int(t) for t in r.generated]
+            if len(seen) != 16:
+                fail("process serve-chaos: accepted requests were lost",
+                     completed=len(seen))
+            if not once.exists():
+                fail("process serve-chaos: the forwarded SIGKILL fault "
+                     "never fired")
+            for rid, toks in seen.items():
+                if toks != baseline.get(rid):
+                    fail("process serve-chaos: output diverged from the "
+                         "unkilled run", request_id=rid)
+            stats = fleet.stats()
+        finally:
+            hl.cancel()
+            await fleet.close()
+        return {
+            "real_sigkill": True,
+            "exactly_once": True,
+            "bit_identical_to_unkilled": True,
+            "failovers": router.failovers_total,
+            "replica_restarts": stats["replica_restarts_total"],
+        }
+
+    async def all_legs() -> dict:
+        with tempfile.TemporaryDirectory(prefix="ftc-bench-transport-") as td:
+            out = {
+                "inproc_1r": await leg("inproc", 1, None),
+                "inproc_multi": await leg("inproc", workers, None),
+                "process_1w": await leg("process", 1, Path(td) / "w1"),
+                "process_multi": await leg("process", workers, Path(td) / "wN"),
+            }
+            out["serve_chaos_process"] = await chaos_leg(
+                Path(td) / "chaos", out["inproc_1r"]["_outputs"],
+            )
+            return out
+
+    legs = asyncio.run(all_legs())
+    chaos_process = legs.pop("serve_chaos_process")
+    for doc in legs.values():
+        doc.pop("_outputs", None)
+    proc_ratio = (legs["process_multi"]["tokens_per_sec"]
+                  / max(1e-9, legs["process_1w"]["tokens_per_sec"]))
+    inproc_ratio = (legs["inproc_multi"]["tokens_per_sec"]
+                    / max(1e-9, legs["inproc_1r"]["tokens_per_sec"]))
+    # fair-share queueing bound for the latency gate: conc requests over
+    # workers*slots lanes, two requests' slack for admission jitter
+    waves = conc / (workers * slots) + 2
+    latency_bound = waves * max(1e-3, legs["process_multi"]["solo_latency_s"])
+    gates_enforced = (os.cpu_count() or 1) >= 2 * workers
+    if gates_enforced:
+        if proc_ratio < 1.5:
+            fail("process-mode workers did not scale >= 1.5x",
+                 process_ratio=round(proc_ratio, 2), workers=workers)
+        if proc_ratio <= inproc_ratio:
+            fail("process-mode scaling did not beat the in-process "
+                 "contention baseline",
+                 process_ratio=round(proc_ratio, 2),
+                 inproc_ratio=round(inproc_ratio, 2))
+        if legs["process_multi"]["p95_latency_s"] > latency_bound:
+            fail("64-concurrent mixed-length p95 exceeded the fair-share "
+                 "bound on process workers",
+                 p95_s=legs["process_multi"]["p95_latency_s"],
+                 bound_s=round(latency_bound, 3))
+    return {
+        "concurrency": conc,
+        "workers": workers,
+        "slots_per_replica": slots,
+        "new_tokens": new_tokens,
+        "process_scaling_x": round(proc_ratio, 2),
+        "inproc_scaling_x": round(inproc_ratio, 2),
+        "latency_gate_bound_s": round(latency_bound, 3),
+        "gates_enforced": gates_enforced,
+        "cpu_count": os.cpu_count(),
+        "serve_chaos_process": chaos_process,
+        "legs": legs,
     }
 
 

@@ -113,6 +113,16 @@ class TrainingBackend(abc.ABC):
         access report False (not delivered)."""
         return False
 
+    def serve_worker_root(self, job_id: str) -> Any | None:
+        """Root directory for cross-process serve-worker sandboxes of one
+        served job (docs/serving.md §Cross-process transport).  The local
+        backend hosts worker sandboxes next to its trainer sandboxes so the
+        spawn/kill lifecycle and debugging surface ride the same substrate;
+        backends without local process access return None and the serve
+        manager falls back to its own state dir (or, on k8s, to rendering
+        one worker POD per replica — ``k8s.render_serve_worker_pod``)."""
+        return None
+
     async def close(self) -> None:
         """Release resources (subprocesses, watch tasks)."""
         return None

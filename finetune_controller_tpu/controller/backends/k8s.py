@@ -318,6 +318,82 @@ def render_spec_configmap(
     }
 
 
+def render_serve_worker_pod(
+    job_id: str,
+    replica_id: str,
+    *,
+    namespace: str,
+    image: str,
+    worker_spec: dict[str, Any],
+    flavor: DeviceFlavor | None = None,
+    port: int = 7077,
+    extra_env: dict[str, str] | None = None,
+) -> dict[str, Any]:
+    """One POD per serve replica (docs/serving.md §Cross-process transport):
+    the k8s rendering of the worker sandbox the local backend spawns as a
+    subprocess.  The worker spec rides an inline env var (it is a small JSON
+    document — the payload itself is staged from the object store by the
+    builder inside the pod), the heartbeat/sandbox dir is an ``emptyDir``,
+    and the RPC port is fixed per pod because every pod has its own IP —
+    ``serve_worker_port_base`` only matters when replicas share a host.
+    ``FTC_FAULT_SERVE_*`` rides ``extra_env`` so the chaos hand crosses the
+    pod boundary exactly as it crosses the local process boundary."""
+    spec_doc = dict(worker_spec)
+    spec_doc.setdefault("sandbox", "/var/run/ftc-serve")
+    spec_doc.setdefault("host", "0.0.0.0")
+    spec_doc["port"] = port
+    env = [
+        {"name": "FTC_SERVE_WORKER_SPEC", "value": json.dumps(spec_doc)},
+        *({"name": k, "value": v} for k, v in (extra_env or {}).items()),
+    ]
+    resources: dict[str, Any] = {}
+    if flavor is not None and flavor.runtime != "cpu":
+        resources = {
+            "limits": {flavor.k8s_resource_name(): flavor.chips_per_host}
+        }
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{job_id}-serve-{replica_id}",
+            "namespace": namespace,
+            "labels": {
+                "app": "ftc-serve-worker",
+                "ftc/job": _sanitize_label(job_id),
+                "ftc/replica": _sanitize_label(replica_id),
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",  # the FLEET respawns with backoff
+            "containers": [{
+                "name": "serve-worker",
+                "image": image,
+                "command": [
+                    "/bin/sh", "-c",
+                    "mkdir -p /var/run/ftc-serve && "
+                    "printf '%s' \"$FTC_SERVE_WORKER_SPEC\" "
+                    "> /var/run/ftc-serve/worker_spec.json && "
+                    "python -m finetune_controller_tpu.transport.worker "
+                    "--spec /var/run/ftc-serve/worker_spec.json",
+                ],
+                "env": env,
+                "ports": [{"containerPort": port, "name": "ftc-rpc"}],
+                "volumeMounts": [{
+                    "name": "serve-sandbox",
+                    "mountPath": "/var/run/ftc-serve",
+                }],
+                **({"resources": resources} if resources else {}),
+            }],
+            "volumes": [{"name": "serve-sandbox", "emptyDir": {}}],
+        },
+    }
+    if flavor is not None:
+        selectors = flavor.accelerator_selectors()
+        if selectors:
+            pod["spec"]["nodeSelector"] = selectors
+    return pod
+
+
 def render_kueue_crds(
     catalog: DeviceCatalog, *, namespace: str = "default",
     cluster_queue: str = "ftc-cluster-queue",

@@ -907,6 +907,16 @@ class LocalProcessBackend(TrainingBackend):
         self._admit_pending()
         return True
 
+    def serve_worker_root(self, job_id: str) -> Path:
+        """Serve-worker sandboxes live NEXT to the trainer sandboxes
+        (docs/serving.md §Cross-process transport): a worker process gets
+        the same debugging surface a failed trainer attempt does — spec,
+        log, heartbeat and socket file under one per-replica dir — and the
+        spawn/kill lifecycle rides this backend's substrate."""
+        root = self.root / "serve_workers" / job_id
+        root.mkdir(parents=True, exist_ok=True)
+        return root
+
     async def inject_fault(self, job_id: str, *, signum: int = 15) -> bool:
         """Fault injection (SURVEY.md §5.3 gap): kill the running process;
         the restart loop then exercises the elastic/backoff path."""

@@ -239,6 +239,22 @@ class Settings(BaseModel):
     #: device flavor for replica workloads ("" = the catalog's default)
     serve_flavor: str = ""
 
+    # --- Serve transport (docs/serving.md §Cross-process transport) ---
+    #: where replicas run: "inproc" (engines share the API process's JAX
+    #: runtime — tests/dev footprint) or "process" (one worker PROCESS per
+    #: replica with its own runtime behind the RPC socket — replicas stop
+    #: sharing cores, which is what makes 2 replicas actually ~2x)
+    serve_transport: str = "inproc"
+    #: first worker port; 0 = ephemeral ports (collision-free; the bound
+    #: port is read back from the worker sandbox's transport.json)
+    serve_worker_port_base: int = 0
+    #: spawn handshake budget per worker (payload build + engine warm-start
+    #: compiles happen inside it; raise for big presets on cold caches)
+    serve_worker_spawn_timeout_s: float = 300.0
+    #: worker heartbeat cadence; the fleet's liveness lease is 3x this
+    #: (floored) — a SIGKILLed or wedged worker is declared dead past it
+    serve_worker_heartbeat_s: float = 2.0
+
     # --- Resilience (finetune_controller_tpu/resilience/, docs/resilience.md) ---
     #: total run attempts per job before a retryable failure becomes terminal
     #: (0 disables the retry supervisor entirely — reference-parity behavior:

@@ -301,7 +301,7 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
     if not sessions:
         print("no serving sessions loaded")
         return 0
-    header = (f"{'JOB':<24} {'REPL':>5} {'SLOTS':>7} {'QUEUE':>5} "
+    header = (f"{'JOB':<24} {'MODE':>7} {'REPL':>5} {'SLOTS':>7} {'QUEUE':>5} "
               f"{'TOKENS':>8} {'HITS':>5} {'MISS':>5} {'SAVED':>8} "
               f"{'CACHE_MB':>8} {'PAGES':>9} {'ADPT':>4}")
     print(header)
@@ -313,8 +313,10 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
         pages_total = s.get("kv_pages_total", 0)
         pages = (f"{s.get('kv_pages_used', 0)}/{pages_total}"
                  if pages_total else "-")
+        mode = s.get("transport", "inproc")
         print(
-            f"{job_id:<24} {repl:>5} {slots:>7} {s['queue_depth']:>5} "
+            f"{job_id:<24} {mode:>7} {repl:>5} {slots:>7} "
+            f"{s['queue_depth']:>5} "
             f"{s['tokens_generated_total']:>8} "
             f"{s.get('prefix_hits_total', 0):>5} "
             f"{s.get('prefix_misses_total', 0):>5} "
@@ -325,9 +327,13 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
             rpages = (f" pages {r.get('kv_pages_used', 0)}/"
                       f"{r.get('kv_pages_total', 0)}"
                       if r.get("kv_pages_total") else "")
+            # a process-mode replica names its worker pid — the operator's
+            # hook into the sandbox (docs/serving.md §Cross-process
+            # transport); in-process replicas render '-'
+            pid = f"pid {r['pid']} " if r.get("pid") else ""
             print(
                 f"  {rid:<10} gen{r.get('generation', 0):<3} "
-                f"{r.get('state', '?'):<9} "
+                f"{r.get('state', '?'):<9} {pid}"
                 f"slots {r.get('slots_busy', 0)}/{r.get('slots_total', 0)} "
                 f"queue {r.get('queue_depth', 0)} "
                 f"tokens {r.get('tokens_generated_total', 0)}{rpages}"

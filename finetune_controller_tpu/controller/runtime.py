@@ -177,6 +177,9 @@ def build_runtime(
                               FairShareScheduler)
                 else None
             ),
+            # serve_transport=process: worker sandboxes ride the backend's
+            # substrate (docs/serving.md §Cross-process transport)
+            backend=backend,
         ),
         obs=obs,
     )

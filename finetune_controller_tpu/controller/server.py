@@ -1196,6 +1196,21 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
                     f'{metric}{{job_id="{prom_escape(job_id)}",'
                     f'adapter="{prom_escape(tenant or "base")}"}} {value}'
                 )
+        # cross-process transport (docs/serving.md §Cross-process
+        # transport): process-wide RPC/byte/respawn counters shared by
+        # every process-mode fleet in this control plane
+        from ..transport import metrics_snapshot as transport_snapshot
+
+        tsnap = transport_snapshot()
+        for metric, key in (
+            ("ftc_serve_transport_rpcs_total", "rpcs_total"),
+            ("ftc_serve_transport_rpc_errors_total", "rpc_errors_total"),
+            ("ftc_serve_transport_worker_respawns_total",
+             "worker_respawns_total"),
+            ("ftc_serve_transport_bytes_total", "bytes_total"),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {tsnap.get(key, 0)}")
     # preference-optimization gauges (docs/preference.md): surfaced from the
     # newest synced metrics row of every ACTIVE dpo/rlhf job — reward margin
     # is the number a healthy DPO run drives up, and the rollout triple
@@ -1359,7 +1374,8 @@ def build_app(runtime: Runtime, *, with_monitor: bool | None = None) -> web.Appl
 
     if runtime.serve is None:
         runtime.serve = ServeManager(
-            runtime.state, runtime.store, settings, obs=runtime.obs
+            runtime.state, runtime.store, settings, obs=runtime.obs,
+            backend=runtime.backend,
         )
     elif getattr(runtime.serve, "obs", None) is None:
         runtime.serve.obs = runtime.obs

@@ -268,9 +268,17 @@ class ServeFaultInjector:
                 f.write(f"serve fault fired ({self.fault.mode})\n")
         return True
 
-    def arm(self, replica_id: str, engine) -> bool:
+    def arm(self, replica_id: str, engine, *, hard_kill: bool = False) -> bool:
         """Wrap ``engine.step`` when ``replica_id`` matches; returns whether
-        the replica was armed."""
+        the replica was armed.
+
+        ``hard_kill=True`` is the cross-process variant (the transport worker
+        arms it, docs/serving.md §Cross-process transport): ``mode="kill"``
+        sends a REAL ``SIGKILL`` to the worker process instead of raising —
+        the socket drops, the heartbeat stops, and the fleet exercises the
+        genuine crashed-worker detection path rather than an in-process
+        stand-in.  ``mode="stall"`` behaves identically in both variants.
+        """
         if replica_id != self.fault.replica_id:
             return False
         real_step = engine.step
@@ -290,6 +298,8 @@ class ServeFaultInjector:
                 )
             if self.fired:
                 if fault.mode == "kill":
+                    if hard_kill:
+                        os.kill(os.getpid(), signal.SIGKILL)
                     raise ReplicaKilled(
                         f"serve fault injection: replica {replica_id} killed "
                         f"at decode step {engine.steps_total}"

@@ -167,3 +167,18 @@ class LeaseChecker:
         if hb["ts"] < start:
             return False  # previous attempt's heartbeat — current one has grace
         return self._clock() - hb["ts"] > self.lease_s
+
+
+def read_heartbeat_file(path: str) -> dict[str, Any] | None:
+    """Read + parse a LOCAL heartbeat file (the serve-worker liveness path,
+    docs/serving.md §Cross-process transport — the trainer/monitor pair reads
+    through the object store instead, :class:`LeaseChecker`).  None when the
+    file is missing, torn, or unreadable: the caller's lease must never bind
+    on a beat the worker has not proven it can write.  Synchronous — async
+    callers wrap it in ``asyncio.to_thread``."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    return parse_heartbeat(raw)

@@ -284,10 +284,27 @@ class ServeTenant:
             )
 
     def stats(self) -> dict[str, Any]:
+        def pid_of(replica_id: str | None) -> int | None:
+            """Real process placement for transport fleets: the admitted
+            workload's chips are held by THIS worker pid (docs/serving.md
+            §Cross-process transport) — '-' for in-process replicas."""
+            replica = (
+                self.fleet.replicas.get(replica_id)
+                if replica_id is not None else None
+            )
+            if replica is None or not replica.remote:
+                return None
+            return replica.batcher.pid
+
         return {
             "workloads": {
                 wid: wl.replica_id for wid, wl in self._workloads.items()
             },
+            "worker_pids": {
+                wid: pid_of(wl.replica_id)
+                for wid, wl in self._workloads.items()
+            },
+            "transport": self.fleet.transport_mode,
             "queue": self.queue,
             "flavor": self.flavor,
             "scale_ups_total": self.scale_ups_total,

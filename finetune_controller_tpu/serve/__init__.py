@@ -21,6 +21,11 @@ external inference stack (SURVEY.md §3.4); this package serves them.
 * :mod:`loader`  — promoted-checkpoint resolution/loading + LoRA merge +
   adapter-only staging for multi-tenant fleets;
 * :mod:`service` — aiohttp routes mounted on the controller server.
+
+With ``serve_transport=process`` the fleet's replicas are worker PROCESSES
+behind an RPC socket (``finetune_controller_tpu/transport/``,
+docs/serving.md §Cross-process transport) — same fleet/router semantics,
+real core-level scaling.
 """
 
 from .adapters import AdapterRegistry, UnknownAdapter

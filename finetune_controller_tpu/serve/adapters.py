@@ -206,3 +206,43 @@ class AdapterRegistry:
                 for e in self.entries()
             },
         }
+
+
+# ---------------------------------------------------------------------------
+# Wire serialization (transport/: the registry-sync RPC)
+# ---------------------------------------------------------------------------
+
+
+def entry_to_wire(entry: AdapterEntry) -> dict[str, Any]:
+    """Serialize one registry entry for the worker stack-sync RPC
+    (docs/serving.md §Cross-process transport).  The adapter tree rides as a
+    flax msgpack blob — megabytes of deltas, never model weights."""
+    from flax import serialization
+
+    host_tree = _to_host(entry.tree)
+    return {
+        "adapter_id": entry.adapter_id,
+        "alpha": float(entry.alpha),
+        "rank": int(entry.rank),
+        "meta": dict(entry.meta),
+        "tree": serialization.msgpack_serialize(host_tree),
+    }
+
+
+def entry_from_wire(doc: dict[str, Any]) -> tuple[str, Any, float, int, dict]:
+    """Inverse of :func:`entry_to_wire` → ``(adapter_id, tree, alpha, rank,
+    meta)``, the :meth:`AdapterRegistry.register` argument shape."""
+    from flax import serialization
+
+    tree = serialization.msgpack_restore(doc["tree"])
+    return (
+        str(doc["adapter_id"]), tree, float(doc["alpha"]), int(doc["rank"]),
+        dict(doc.get("meta") or {}),
+    )
+
+
+def _to_host(tree: Any) -> Any:
+    """Device arrays → numpy (msgpack_serialize refuses jax.Array leaves)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

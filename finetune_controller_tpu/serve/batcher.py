@@ -238,6 +238,36 @@ class Batcher:
     def draining(self) -> bool:
         return self._draining
 
+    async def health_probe(self) -> dict[str, Any]:
+        """Liveness + decode-progress snapshot, the fleet health check's ONE
+        input (docs/serving.md §Fleet).  Async so the in-process Batcher and
+        the cross-process :class:`~finetune_controller_tpu.transport.client.
+        RemoteReplica` (where this is an RPC with a heartbeat-lease check in
+        front) share a surface — the fleet cannot tell them apart."""
+        return {
+            "steps_total": self.engine.steps_total,
+            "slots_busy": self.slots_busy,
+            "queue_depth": self.queue_depth,
+            "step_errors_total": self.step_errors_total,
+            "last_step_error": (
+                str(self.last_step_error)
+                if self.last_step_error is not None else None
+            ),
+            "draining": self._draining,
+            "inflight_by_tenant": self.inflight_by_tenant(),
+        }
+
+    async def tenant_busy(self, adapter_id: str) -> int:
+        """Requests queued or in flight for one tenant — the adapter-unload
+        busy check.  Async for the same transport-symmetry reason as
+        :meth:`health_probe` (remote replicas answer with a fresh RPC, not a
+        stale cache)."""
+        tenant = adapter_id or ""
+        return (
+            self.inflight_by_tenant().get(tenant, 0)
+            + self.queue_depth_by_tenant().get(tenant, 0)
+        )
+
     def retry_after_s(self, extra_requests: int = 1) -> float:
         """Estimated seconds until ``extra_requests`` more requests queued NOW
         would complete — queue depth × observed steps-per-request over the

@@ -1061,3 +1061,27 @@ class BatchEngine:
         if missing:  # pragma: no cover - engine invariant
             raise RuntimeError(f"requests did not finish: {missing}")
         return results
+
+
+def warm_engine(engine: "BatchEngine", *, warm_new: int | None = None) -> None:
+    """Pay every compile an engine will ever need BEFORE it takes traffic:
+    one dummy request per prompt bucket plus a decode step.  The zero-downtime
+    rollover contract depends on a fresh replica not compiling under load —
+    the in-process fleet and the transport worker share this exact warmup so
+    process-mode replicas are warm-started too (docs/serving.md §Fleet).
+    Warmup counter noise is zeroed; the shapes are exactly the budgeted ones,
+    so the recompile guard stays armed and accurate."""
+    new_tokens = warm_new if warm_new is not None \
+        else min(2, engine.config.max_new_tokens)
+    for bucket in engine.config.prompt_buckets:
+        engine.run([GenRequest(
+            request_id=f"_warm-{bucket}", tokens=[1] * bucket,
+            max_new_tokens=new_tokens,
+        )])
+    engine.steps_total = 0
+    engine.tokens_generated_total = 0
+    engine.requests_finished_total = 0
+    engine.prefix_hits_total = 0
+    engine.prefix_misses_total = 0
+    engine.prefill_tokens_saved_total = 0
+    engine.tokens_by_tenant = {}

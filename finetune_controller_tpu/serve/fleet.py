@@ -26,7 +26,15 @@ checkpoints are immutable artifacts, so replicas are cattle
   generation — no stop-the-world swap;
 * the seeded chaos hand (``resilience/faults.py::ServeFault``) can kill or
   wedge a chosen replica at a chosen decode step, the injection path the
-  serve-chaos tests and ``BENCH_MODE=serve`` share.
+  serve-chaos tests and ``BENCH_MODE=serve`` share;
+* **transport** (docs/serving.md §Cross-process transport): with a
+  ``transport`` attached (``serve_transport=process``), every replica is a
+  separate WORKER PROCESS — its own JAX runtime behind an RPC socket — and
+  the fleet consumes it through the same batcher-shaped surface
+  (``transport/client.py::RemoteReplica``), so health checks, failover,
+  drain, rollover, adapter sync and autoscale run unchanged; detection adds
+  a heartbeat lease (a SIGKILLed or wedged worker stops beating) and the
+  respawn path spawns a fresh sandboxed process instead of an engine.
 """
 
 from __future__ import annotations
@@ -42,7 +50,7 @@ from typing import Any, Awaitable, Callable
 from ..resilience.faults import ServeFaultInjector
 from ..resilience.policy import RETRYABLE, RetryPolicy, classify_failure
 from .batcher import Batcher, ReplicaUnavailable
-from .engine import BatchEngine, EngineConfig, GenRequest
+from .engine import BatchEngine, EngineConfig, warm_engine
 
 logger = logging.getLogger(__name__)
 
@@ -60,11 +68,15 @@ class ReplicaState(str, enum.Enum):
 
 @dataclasses.dataclass
 class Replica:
-    """One serving stack inside the fleet."""
+    """One serving stack inside the fleet — in-process (``batcher`` is a
+    :class:`Batcher`) or a worker process (``batcher`` is a
+    :class:`~finetune_controller_tpu.transport.client.RemoteReplica`, which
+    implements the same surface)."""
 
     replica_id: str
     generation: int
-    batcher: Batcher
+    batcher: Any
+    remote: bool = False
     state: ReplicaState = ReplicaState.HEALTHY
     started_at: float = 0.0
     #: clock reading when the engine last made observable progress (a decode
@@ -148,8 +160,19 @@ class ReplicaFleet:
         clock: Callable[[], float] = time.monotonic,
         warm_start: bool = True,
         adapters: "Any | None" = None,
+        transport: "Any | None" = None,
     ):
         self.job_id = job_id
+        #: cross-process mode: a ``transport/process.py::ProcessTransport``
+        #: (or anything with its ``spawn``/``mode`` surface) — replicas are
+        #: worker processes and ``model``/``variables`` may be None (the
+        #: control plane never holds serving weights in that mode)
+        self.transport = transport
+        if transport is None and model is None:
+            raise ValueError(
+                "an in-process fleet needs (model, variables); pass a "
+                "transport for process-mode replicas"
+            )
         self._model = model
         self._variables = variables
         self._engine_config = engine_config
@@ -213,53 +236,82 @@ class ReplicaFleet:
     # ---- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
-        """Spawn the initial replica set."""
+        """Spawn the initial replica set.  Worker processes spawn
+        CONCURRENTLY (each builds in its own process; the wall-clock is one
+        spawn, not the fleet size times one); in-process engines build
+        serially — parallel first-use XLA compiles in one runtime would
+        race."""
+        if self.transport is not None:
+            await asyncio.gather(
+                *(self.spawn_replica() for _ in range(self.target_replicas))
+            )
+            return
         for _ in range(self.target_replicas):
             await self.spawn_replica()
 
     def _build_engine(self) -> BatchEngine:
         """Worker-thread body: construct and (by default) WARM the engine —
-        one dummy request per prompt bucket plus a decode step, so every
-        compile this replica will ever need lands before it serves traffic.
-        The warmup's counter noise is zeroed; its shapes are exactly the
-        budgeted ones, so the recompile guard stays armed and accurate."""
+        every compile this replica will ever need lands before it serves
+        traffic (``engine.warm_engine``, shared with the transport worker's
+        startup so process-mode replicas warm-start identically)."""
         engine = BatchEngine(self._model, self._variables,
                              self._engine_config, adapters=self.adapters)
         if self.warm_start:
-            warm_new = min(2, engine.config.max_new_tokens)
-            for bucket in engine.config.prompt_buckets:
-                engine.run([GenRequest(
-                    request_id=f"_warm-{bucket}", tokens=[1] * bucket,
-                    max_new_tokens=warm_new,
-                )])
-            engine.steps_total = 0
-            engine.tokens_generated_total = 0
-            engine.requests_finished_total = 0
-            engine.prefix_hits_total = 0
-            engine.prefix_misses_total = 0
-            engine.prefill_tokens_saved_total = 0
-            engine.tokens_by_tenant = {}
+            warm_engine(engine)
         return engine
 
     async def spawn_replica(self) -> Replica:
-        """Build one engine (worker thread) and put a replica in service."""
+        """Put one replica in service: build an engine in a worker thread
+        (in-process), or spawn + handshake a worker process (transport) and
+        sync the adapter registry onto it."""
         rid = f"r{next(self._seq)}"
-        engine = await asyncio.to_thread(self._build_engine)
-        if self._fault is not None and self._fault.arm(rid, engine):
-            logger.warning("replica %s armed with a serve fault", rid)
-        batcher = Batcher(engine, **self._batcher_kwargs)
+        if self.transport is not None:
+            batcher = await self.transport.spawn(
+                rid, self.generation,
+                engine_config=self._engine_config,
+                batcher_kwargs=self._batcher_kwargs,
+                adapters=self.adapters,
+                warm_start=self.warm_start,
+            )
+            if self.adapters is not None and len(self.adapters):
+                try:
+                    from .adapters import entry_to_wire
+
+                    wires = await asyncio.to_thread(
+                        lambda: [entry_to_wire(e)
+                                 for e in self.adapters.entries()]
+                    )
+                    await batcher.stack_sync(wires)
+                except BaseException:
+                    # the worker is alive but not yet in _replicas: nothing
+                    # else will ever kill it — reap before propagating, or
+                    # every failed respawn leaks one live process
+                    await batcher.close()
+                    raise
+            remote = True
+        else:
+            engine = await asyncio.to_thread(self._build_engine)
+            if self._fault is not None and self._fault.arm(rid, engine):
+                logger.warning("replica %s armed with a serve fault", rid)
+            batcher = Batcher(engine, **self._batcher_kwargs)
+            remote = False
         now = self._clock()
         replica = Replica(
             replica_id=rid, generation=self.generation, batcher=batcher,
-            started_at=now, last_progress=now,
+            remote=remote, started_at=now, last_progress=now,
         )
         self._replicas[rid] = replica
         await self._event(
             "serve-replica-started", replica=rid, generation=self.generation,
+            transport=self.transport_mode,
         )
-        logger.info("serve replica %s started (job=%s gen=%d)",
-                    rid, self.job_id, self.generation)
+        logger.info("serve replica %s started (job=%s gen=%d transport=%s)",
+                    rid, self.job_id, self.generation, self.transport_mode)
         return replica
+
+    @property
+    def transport_mode(self) -> str:
+        return getattr(self.transport, "mode", None) or "inproc"
 
     # ---- multi-tenant adapters ---------------------------------------------
 
@@ -277,7 +329,19 @@ class ReplicaFleet:
         refresh = self.adapters.get(adapter_id) is not None
         entry = self.adapters.register(adapter_id, lora_tree, alpha, rank,
                                        meta=meta)
+        entry_wire = None
+        if any(r.remote for r in self._replicas.values()):
+            from .adapters import entry_to_wire
+
+            entry_wire = await asyncio.to_thread(entry_to_wire, entry)
         for replica in list(self._replicas.values()):
+            if replica.remote:
+                # registry-sync RPC: the worker registers + installs + (on
+                # refresh) drops the namespace itself, same ordering as the
+                # in-process path below
+                await replica.batcher.adapter_register(entry_wire,
+                                                       refresh=refresh)
+                continue
             await asyncio.to_thread(replica.engine.install_adapter,
                                     adapter_id)
             if refresh:
@@ -307,11 +371,11 @@ class ReplicaFleet:
             )
         busy = 0
         for replica in self._replicas.values():
-            # _inflight covers the admission window the engine's lane view
+            # tenant_busy covers the admission window the engine's lane view
             # misses (a request mid-admit in the worker thread has no lane
-            # yet but HAS already resolved its adapter slot)
-            busy += replica.batcher.inflight_by_tenant().get(adapter_id, 0)
-            busy += replica.batcher.queue_depth_by_tenant().get(adapter_id, 0)
+            # yet but HAS already resolved its adapter slot); for remote
+            # replicas it is a FRESH rpc, never a stale probe cache
+            busy += await replica.batcher.tenant_busy(adapter_id)
         if busy:
             raise AdapterBusy(
                 f"adapter {adapter_id!r} has {busy} request(s) in flight or "
@@ -319,6 +383,9 @@ class ReplicaFleet:
             )
         entry = self.adapters.unregister(adapter_id)
         for replica in list(self._replicas.values()):
+            if replica.remote:
+                await replica.batcher.adapter_unregister(adapter_id)
+                continue
             await asyncio.to_thread(
                 replica.engine.remove_adapter, adapter_id, entry.slot
             )
@@ -419,29 +486,62 @@ class ReplicaFleet:
     # ---- health ------------------------------------------------------------
 
     async def health_tick(self) -> dict[str, list[str]]:
-        """One health pass: catch faulted and stalled replicas, run due
-        restarts.  Returns the actions taken (tests assert on them)."""
+        """One health pass: catch dead, faulted and stalled replicas, run
+        due restarts.  Returns the actions taken (tests assert on them).
+
+        Every replica answers ONE :meth:`~finetune_controller_tpu.serve.
+        batcher.Batcher.health_probe` — live values in-process; for a worker
+        process the probe stack is process-exit check → heartbeat lease →
+        RPC, so a SIGKILLed worker, a wedged event loop, and a stalled
+        decode are all caught here and answered with a kill + respawn
+        (the LeaseChecker pattern, docs/serving.md §Cross-process
+        transport).  Probes run concurrently: a slow worker costs one
+        timeout, not the whole tick times the fleet size.
+        """
         now = self._clock()
         actions: dict[str, list[str]] = {"failed": [], "restarted": []}
-        for replica in list(self._replicas.values()):
-            if not replica.healthy:
+        checked = [r for r in list(self._replicas.values()) if r.healthy]
+
+        async def probe_one(replica: Replica):
+            try:
+                return await replica.batcher.health_probe(), None
+            # ftc: ignore[silent-except] -- not swallowed: a failed probe fails the replica below
+            except Exception as exc:
+                return None, exc
+
+        probes = await asyncio.gather(*(probe_one(r) for r in checked))
+        for replica, (probe, probe_err) in zip(checked, probes):
+            if replica.replica_id not in self._replicas \
+                    or not replica.healthy:
+                # removed by an earlier failure this tick, or a concurrent
+                # drain flipped it mid-probe (a draining replica's torn
+                # connection fails the probe — that is the drain, not a
+                # crash; failing it here would double-retire its counters
+                # and queue a spurious restart)
                 continue
-            batcher = replica.batcher
-            engine = replica.engine
-            if batcher.step_errors_total > replica.last_step_errors:
-                # the drive loop survived a decode fault (it keeps serving),
-                # but a faulting engine is a crashed replica from the
-                # fleet's point of view: tear down + restart with backoff
-                err = batcher.last_step_error
+            if probe_err is not None:
+                # dead process, stale heartbeat, torn socket, rpc timeout —
+                # the replica cannot prove liveness, so it is failed (and,
+                # for a worker process, killed) + restarted with backoff
                 actions["failed"].append(replica.replica_id)
                 await self.fail_replica(
                     replica.replica_id,
-                    error=f"decode step fault: {err}",
+                    error=f"liveness probe failed: {probe_err}",
                 )
                 continue
-            if engine.steps_total > replica.last_steps_total \
-                    or batcher.slots_busy == 0:
-                replica.last_steps_total = engine.steps_total
+            if probe["step_errors_total"] > replica.last_step_errors:
+                # the drive loop survived a decode fault (it keeps serving),
+                # but a faulting engine is a crashed replica from the
+                # fleet's point of view: tear down + restart with backoff
+                actions["failed"].append(replica.replica_id)
+                await self.fail_replica(
+                    replica.replica_id,
+                    error=f"decode step fault: {probe['last_step_error']}",
+                )
+                continue
+            if probe["steps_total"] > replica.last_steps_total \
+                    or probe["slots_busy"] == 0:
+                replica.last_steps_total = probe["steps_total"]
                 replica.last_progress = now
             elif now - replica.last_progress > self.stall_timeout_s:
                 # work in flight, no decode step completing: the
@@ -453,7 +553,7 @@ class ReplicaFleet:
                     error=(
                         f"stuck decode: no step completed in "
                         f"{now - replica.last_progress:.1f}s with "
-                        f"{batcher.slots_busy} request(s) in flight"
+                        f"{probe['slots_busy']} request(s) in flight"
                     ),
                 )
                 continue
@@ -469,8 +569,29 @@ class ReplicaFleet:
             self._restarts_pending.remove(pending)
             if self._closed or len(self._replicas) >= self.target_replicas:
                 continue
-            replica = await self.spawn_replica()
+            try:
+                replica = await self.spawn_replica()
+            # ftc: ignore[silent-except] -- not swallowed: logged and rescheduled with grown backoff
+            except Exception:
+                # a worker-process spawn can itself fail (port races, a sick
+                # host); reschedule the restart with the next backoff step
+                # instead of silently dropping the slot from the fleet
+                delay = self.restart_policy.next_delay(self._last_restart_delay)
+                self._last_restart_delay = delay
+                self._restarts_pending.append(_PendingRestart(
+                    due_at=self._clock() + delay, prev_delay_s=delay,
+                    reason=f"respawn failed after: {pending.reason}",
+                ))
+                logger.exception(
+                    "serve replica respawn failed (job=%s); retrying in "
+                    "%.1fs", self.job_id, delay,
+                )
+                continue
             self.replica_restarts_total += 1
+            if replica.remote:
+                from ..transport import incr as _transport_incr
+
+                _transport_incr("worker_respawns_total")
             actions["restarted"].append(replica.replica_id)
             await self._event(
                 "serve-replica-restarted", replica=replica.replica_id,
@@ -502,18 +623,29 @@ class ReplicaFleet:
         """Zero-downtime payload swap: spin up the new generation FIRST,
         shift traffic (the router prefers the newest generation), then drain
         the old generation — in-flight lanes finish on the weights they
-        started on."""
+        started on.
+
+        Process mode: the caller repoints the transport's payload (a freshly
+        staged deploy dir) BEFORE calling this with ``model=variables=None``
+        — new-generation workers rebuild from it; the control plane never
+        holds the weights."""
         old = [r for r in self._replicas.values() if r.healthy]
-        self._model = model
-        self._variables = variables
+        if self.transport is None:
+            self._model = model
+            self._variables = variables
         self.generation += 1
         self.rollovers_total += 1
         await self._event(
             "serve-rollover-started", generation=self.generation,
             reason=reason, old_replicas=len(old),
         )
-        for _ in range(max(1, len(old))):
-            await self.spawn_replica()
+        if self.transport is not None:
+            await asyncio.gather(
+                *(self.spawn_replica() for _ in range(max(1, len(old))))
+            )
+        else:
+            for _ in range(max(1, len(old))):
+                await self.spawn_replica()
         await asyncio.gather(*(
             self.drain_replica(r.replica_id, reason=reason) for r in old
         ))
@@ -591,6 +723,10 @@ class ReplicaFleet:
             ),
             "generation": self.generation,
             "target_replicas": self.target_replicas,
+            "transport": self.transport_mode,
+            "worker_pids": sorted(
+                r.batcher.pid for r in self._replicas.values() if r.remote
+            ),
             "replica_restarts_total": self.replica_restarts_total,
             "replicas_failed_total": self.replicas_failed_total,
             "drains_total": self.drains_total,

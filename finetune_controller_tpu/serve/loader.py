@@ -153,15 +153,13 @@ def merge_lora_variables(model_cfg: Any, variables: dict) -> tuple[Any, dict]:
     return merged_cfg, merged
 
 
-def load_serving_model(
-    local_dir: Path | str, *, merge_lora: bool = True
-) -> tuple[Any, dict, dict]:
-    """Build ``(model, variables, meta)`` from a staged promoted prefix.
-
-    Heavy (JAX init + checkpoint IO) and synchronous — callers run it in a
-    thread (``asyncio.to_thread``) off the event loop.
-    """
-    local_dir = Path(local_dir)
+def _resolve_staged_spec(local_dir: Path) -> tuple[dict, Any, int]:
+    """Shared validation for a staged promoted prefix: spec read,
+    serving-eligibility guards, latest committed checkpoint step.  BOTH
+    serve-side load paths run it — the in-process weight load below and the
+    weights-free :func:`stage_meta` the cross-process transport uses — so a
+    checkpoint is servable (or refused, with the same error) regardless of
+    ``serve_transport``."""
     spec_path = local_dir / "resolved_config.json"
     if not spec_path.exists():
         raise ServeLoadError(
@@ -171,8 +169,7 @@ def load_serving_model(
         spec = json.load(f)
 
     from ..train.checkpoint import CheckpointManager
-    from ..train.cli import build_model_config, build_train_config
-    from ..train.trainer import Trainer
+    from ..train.cli import build_model_config
 
     model_cfg = build_model_config(spec)
     if getattr(model_cfg, "vision", None) is not None:
@@ -182,19 +179,36 @@ def load_serving_model(
             "serving MoE checkpoints is not supported (batching invariance "
             "does not hold under capacity routing)"
         )
-    train_cfg = build_train_config(spec)
-    trainer = Trainer(model_cfg, train_cfg)
-    state = trainer.init_state()
-
     ckpt_dir = local_dir / "checkpoints"
     if not ckpt_dir.is_dir() or not os.listdir(ckpt_dir):
         raise ServeLoadError(
             f"no checkpoints under {ckpt_dir} — the job produced none"
         )
-    ckpt = CheckpointManager(str(ckpt_dir))
-    latest = ckpt.latest_step()
+    latest = CheckpointManager(str(ckpt_dir)).latest_step()
     if latest is None:
         raise ServeLoadError(f"no committed checkpoint steps under {ckpt_dir}")
+    return spec, model_cfg, latest
+
+
+def load_serving_model(
+    local_dir: Path | str, *, merge_lora: bool = True
+) -> tuple[Any, dict, dict]:
+    """Build ``(model, variables, meta)`` from a staged promoted prefix.
+
+    Heavy (JAX init + checkpoint IO) and synchronous — callers run it in a
+    thread (``asyncio.to_thread``) off the event loop.
+    """
+    local_dir = Path(local_dir)
+    spec, model_cfg, latest = _resolve_staged_spec(local_dir)
+
+    from ..train.checkpoint import CheckpointManager
+    from ..train.cli import build_train_config
+    from ..train.trainer import Trainer
+
+    train_cfg = build_train_config(spec)
+    trainer = Trainer(model_cfg, train_cfg)
+    state = trainer.init_state()
+    ckpt = CheckpointManager(str(local_dir / "checkpoints"))
     template = trainer.state_to_host(state)
     host = ckpt.restore(latest, like=template)
 
@@ -351,6 +365,62 @@ async def load_adapter(
     meta["job_id"] = job_id
     meta["promotion_uri"] = job.promotion_uri
     return lora_tree, meta
+
+
+def stage_meta(local_dir: Path | str, *, merge_lora: bool = True) -> dict:
+    """Serving meta from a STAGED promoted prefix without building the model
+    or touching weights — the process-transport path (docs/serving.md
+    §Cross-process transport): the control plane stages the prefix once and
+    the worker processes rebuild the weights themselves
+    (``transport/builders.py::deploy_dir``), so the API process only ever
+    reads the spec + the checkpoint directory listing.  Eligibility guards
+    are :func:`_resolve_staged_spec`, shared with the in-process load path
+    — both transports accept and refuse exactly the same checkpoints."""
+    local_dir = Path(local_dir)
+    spec, model_cfg, latest = _resolve_staged_spec(local_dir)
+    # predicts what load_serving_model's merge does in the worker: a LoRA
+    # checkpoint ("lora" in the assembled variables ⇔ rank > 0) folds into
+    # the base unless quantized
+    merged = bool(
+        merge_lora and model_cfg.lora.rank > 0
+        and not getattr(model_cfg, "quantize_base", False)
+    )
+    pretrained = spec.get("model", {}).get("weights_dir")
+    return {
+        "preset": spec.get("model", {}).get("preset"),
+        "checkpoint_step": latest,
+        "lora_merged": merged,
+        "lora_rank": model_cfg.lora.rank,
+        "lora_alpha": model_cfg.lora.alpha,
+        "vocab_size": model_cfg.vocab_size,
+        "max_seq_len": model_cfg.max_seq_len,
+        "weights_dir": pretrained or None,
+    }
+
+
+async def stage_for_workers(
+    state: StateStore,
+    store: ObjectStore,
+    job_id: str,
+    work_dir: Path | str,
+    *,
+    merge_lora: bool = True,
+) -> tuple[Path, dict]:
+    """resolve → stage → meta, WITHOUT loading weights into this process —
+    the serve-side path when replicas are worker processes.  The staged dir
+    is returned (NOT removed: workers read it for as long as the generation
+    serves) along with the same meta shape :func:`load_promoted` produces."""
+    import uuid
+
+    job = await resolve_promoted(state, job_id)
+    job_dir = Path(work_dir) / job_id
+    local = await fetch_promoted(
+        store, job.promotion_uri, job_dir / f"workers-{uuid.uuid4().hex[:8]}"
+    )
+    meta = await asyncio.to_thread(stage_meta, local, merge_lora=merge_lora)
+    meta["job_id"] = job_id
+    meta["promotion_uri"] = job.promotion_uri
+    return local, meta
 
 
 async def load_promoted(

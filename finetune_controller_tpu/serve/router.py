@@ -201,7 +201,11 @@ class ReplicaRouter:
                 )
                 result.replica_id = replica.replica_id
                 return result
-            except ReplicaUnavailable as exc:
+            except (ReplicaUnavailable, ConnectionError) as exc:
+                # ConnectionError defends the cross-process transport seam:
+                # RemoteReplica maps socket loss to ReplicaUnavailable, but a
+                # raw OS-level error escaping that mapping is the same shape
+                # — the worker never delivered a result, so failover is safe
                 tried.add(replica.replica_id)
                 attempts += 1
                 if attempts > self.failover_retries:

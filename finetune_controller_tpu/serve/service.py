@@ -65,12 +65,16 @@ class _Session:
     #: adapters) — metas only; the weights live in the fleet's registry
     adapters: dict[str, dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    #: process transport: the staged deploy prefix this generation's worker
+    #: processes rebuild their weights from (removed on unload/rollover)
+    worker_stage_dir: str | None = None
 
 
 class ServeManager:
     """Loaded serving sessions, one replica fleet + router per promoted job."""
 
-    def __init__(self, state, store, settings, *, obs=None, scheduler=None):
+    def __init__(self, state, store, settings, *, obs=None, scheduler=None,
+                 backend=None):
         self.state = state
         self.store = store
         self.settings = settings
@@ -80,6 +84,11 @@ class ServeManager:
         #: the backend's fair-share scheduler (serve-as-a-tenant autoscale,
         #: docs/scheduling.md §Serve tenant); None = static fleets
         self.scheduler = scheduler
+        #: the training backend (docs/serving.md §Cross-process transport):
+        #: with ``serve_transport=process`` worker sandboxes live under its
+        #: substrate (``backend.serve_worker_root``); None falls back to the
+        #: state dir
+        self.backend = backend
         self.sessions: dict[str, _Session] = {}
         #: per-job single-flight loads: the dict insert is the CAS — exactly
         #: one racing ``load`` wins and does the work, the rest await its
@@ -126,6 +135,39 @@ class ServeManager:
     def _multi_tenant(self) -> bool:
         return self.settings.serve_max_adapters > 0
 
+    @property
+    def _transport_mode(self) -> str:
+        mode = (self.settings.serve_transport or "inproc").strip().lower()
+        if mode not in ("inproc", "process"):
+            raise ServeLoadError(
+                f"unknown serve_transport {mode!r} (expected 'inproc' or "
+                "'process')", status=500,
+            )
+        return mode
+
+    def _make_transport(self, job_id: str, payload_kwargs: dict[str, Any]):
+        """Process-mode replica substrate: sandboxes under the backend's
+        work dir when it offers one (the local backend does), else the state
+        dir — one dir per worker with spec/log/heartbeat/socket file."""
+        from ..transport.process import ProcessTransport
+
+        s = self.settings
+        root = None
+        if self.backend is not None:
+            root = self.backend.serve_worker_root(job_id)
+        if root is None:
+            root = Path(s.state_path) / "serve_workers" / job_id
+            root.mkdir(parents=True, exist_ok=True)
+        return ProcessTransport(
+            job_id=job_id,
+            root=root,
+            payload={"builder": "deploy_dir", "kwargs": payload_kwargs},
+            port_base=s.serve_worker_port_base,
+            spawn_timeout_s=s.serve_worker_spawn_timeout_s,
+            heartbeat_interval_s=s.serve_worker_heartbeat_s,
+            probe_timeout_s=max(10.0, s.serve_health_interval_s * 5),
+        )
+
     def _adapter_registry(self) -> AdapterRegistry | None:
         if not self._multi_tenant:
             return None
@@ -134,13 +176,15 @@ class ServeManager:
             self.settings.serve_adapter_rank,
         )
 
-    async def _build_session(self, job_id, model, variables, meta) -> _Session:
+    async def _build_session(self, job_id, model, variables, meta,
+                             *, transport=None) -> _Session:
         s = self.settings
         fleet = ReplicaFleet(
             job_id, model, variables, self._engine_config(),
             replicas=s.serve_replicas,
             batcher_kwargs=self._batcher_kwargs(),
             adapters=self._adapter_registry(),
+            transport=transport,
             stall_timeout_s=s.serve_replica_stall_s,
             drain_timeout_s=s.serve_drain_timeout_s,
             restart_policy=RetryPolicy(
@@ -239,7 +283,109 @@ class ServeManager:
                     steps.append(int(raw))
         return max(steps) if steps else None
 
+    async def _load_or_rollover_process(self, job_id: str) -> dict[str, Any]:
+        """The ``serve_transport=process`` load path (docs/serving.md
+        §Cross-process transport): the control plane STAGES the promoted
+        prefix and reads its meta, but never loads the weights — each worker
+        process rebuilds them from the staged dir with its own JAX runtime
+        (``transport/builders.py::deploy_dir``).  A rollover stages the new
+        checkpoint, repoints the transport payload, and lets the fleet spin
+        the next worker generation on it before draining the old one."""
+        import shutil
+
+        from .loader import stage_for_workers
+
+        existing = self.sessions.get(job_id)
+        if existing is not None:
+            job = await resolve_promoted(self.state, job_id)
+            if job.promotion_uri == existing.meta.get("promotion_uri"):
+                peek = await self._peek_latest_step(job.promotion_uri)
+                if peek is not None \
+                        and peek == existing.meta.get("checkpoint_step"):
+                    return existing.meta
+        merge = self.settings.serve_merge_lora and not self._multi_tenant
+        stage_dir, meta = await stage_for_workers(
+            self.state, self.store, job_id, self.work_dir, merge_lora=merge,
+        )
+        base_adapter = None
+        if self._multi_tenant:
+            meta["lora_merged"] = False
+            meta["multi_tenant"] = True
+            meta["self_adapter"] = meta.get("lora_rank", 0) > 0
+            if meta["self_adapter"]:
+                # the job's own fine-tune serves as tenant #1; only the
+                # DELTAS load here (megabytes) — the base stays in workers
+                from .loader import _load_adapter_tree
+
+                lora_tree, ameta = await asyncio.to_thread(
+                    _load_adapter_tree, stage_dir
+                )
+                base_adapter = (
+                    lora_tree, ameta["lora_alpha"], ameta["lora_rank"],
+                )
+        payload_kwargs = {
+            "dir": str(stage_dir), "merge_lora": merge,
+            "multi_tenant": self._multi_tenant,
+        }
+        if existing is not None:
+            same = (
+                existing.meta.get("checkpoint_step") == meta.get("checkpoint_step")
+                and existing.meta.get("promotion_uri") == meta.get("promotion_uri")
+            )
+            if same:
+                await asyncio.to_thread(
+                    shutil.rmtree, stage_dir, ignore_errors=True
+                )
+                return existing.meta
+            await self._event(
+                job_id, "serve-rollover-requested",
+                from_step=existing.meta.get("checkpoint_step"),
+                to_step=meta.get("checkpoint_step"),
+            )
+            existing.fleet.transport.set_payload("deploy_dir", payload_kwargs)
+            old_stage = existing.worker_stage_dir
+            await existing.fleet.rollover(None, None)
+            if base_adapter is not None:
+                await existing.fleet.register_adapter(
+                    job_id, *base_adapter,
+                    meta={"checkpoint_step": meta.get("checkpoint_step")},
+                )
+            existing.meta = meta
+            existing.worker_stage_dir = str(stage_dir)
+            if old_stage:
+                # the old generation drained inside rollover(): nothing
+                # reads the superseded stage anymore
+                await asyncio.to_thread(
+                    shutil.rmtree, old_stage, ignore_errors=True
+                )
+            logger.info("serve rollover completed for %s (process): %s",
+                        job_id, meta)
+            return meta
+        transport = self._make_transport(job_id, payload_kwargs)
+        session = await self._build_session(
+            job_id, None, None, meta, transport=transport
+        )
+        session.worker_stage_dir = str(stage_dir)
+        self.sessions[job_id] = session
+        if base_adapter is not None:
+            await session.fleet.register_adapter(
+                job_id, *base_adapter,
+                meta={"checkpoint_step": meta.get("checkpoint_step")},
+            )
+        await self._event(
+            job_id, "serve-loaded",
+            checkpoint_step=meta.get("checkpoint_step"),
+            lora_merged=meta.get("lora_merged"),
+            replicas=session.fleet.target_replicas,
+            transport="process",
+        )
+        logger.info("serve session loaded for %s (process workers): %s",
+                    job_id, meta)
+        return meta
+
     async def _load_or_rollover(self, job_id: str) -> dict[str, Any]:
+        if self._transport_mode == "process":
+            return await self._load_or_rollover_process(job_id)
         existing = self.sessions.get(job_id)
         if existing is not None:
             # cheap idempotence check BEFORE staging gigabytes: same deploy
@@ -384,6 +530,12 @@ class ServeManager:
             await session.tenant.close()
             session.tenant = None
         await session.fleet.close()
+        if session.worker_stage_dir:
+            import shutil
+
+            await asyncio.to_thread(
+                shutil.rmtree, session.worker_stage_dir, ignore_errors=True
+            )
         await self._event(job_id, "serve-unloaded")
         logger.info("serve session unloaded for %s", job_id)
         return True

@@ -1,0 +1,80 @@
+"""Cross-process serve transport (docs/serving.md §Cross-process transport).
+
+The distribution half of ROADMAP item 2: every serve replica moves into its
+OWN worker process — one engine+batcher per process, its own JAX runtime —
+behind a length-prefixed msgpack/JSON RPC protocol on a local socket, so the
+fleet's replicas stop sharing cores with each other and with the control
+plane.  The fleet/router layer (``serve/fleet.py``/``serve/router.py``) is
+transport-agnostic: a :class:`~finetune_controller_tpu.transport.client.
+RemoteReplica` implements the same surface the in-process ``Batcher``
+exposes, so failover, exactly-once request ids, drain, rollover, DRR tenancy
+and autoscale work unchanged in either mode (``serve_transport=inproc`` |
+``process``).
+
+Module map:
+
+* ``wire``     — framing + codec (u32 length prefix, msgpack when available,
+  JSON with base64 bytes otherwise) and the byte counters ``/metrics`` reads;
+* ``worker``   — the worker process entrypoint
+  (``python -m finetune_controller_tpu.transport.worker --spec …``);
+* ``client``   — ``RemoteReplica``: async-socket RPC client with cached
+  health snapshots, heartbeat lease checks and process teardown;
+* ``process``  — ``ProcessTransport``: spawn/kill of worker sandboxes on the
+  local host (the k8s backend renders one pod per replica instead);
+* ``builders`` — how a worker process reconstructs its serving payload
+  (a staged deploy dir, or the deterministic tiny test model).
+
+Podracer-shape rollout actors (ROADMAP item 4) and MPMD pipeline stages
+(item 5) are the next consumers of this same point-to-point transport.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: process-wide transport counters (rendered as ``ftc_serve_transport_*`` by
+#: the server's /metrics handler, docs/observability.md) — one flat dict like
+#: the obs hub's process counters, shared by client, wire and fleet layers
+METRICS: dict[str, int] = {
+    "rpcs_total": 0,
+    "rpc_errors_total": 0,
+    "worker_respawns_total": 0,
+    "workers_spawned_total": 0,
+    "bytes_sent_total": 0,
+    "bytes_received_total": 0,
+}
+
+
+def incr(name: str, n: int = 1) -> None:
+    METRICS[name] = METRICS.get(name, 0) + n
+
+
+def metrics_snapshot() -> dict[str, int]:
+    snap = dict(METRICS)
+    snap["bytes_total"] = (
+        snap.get("bytes_sent_total", 0) + snap.get("bytes_received_total", 0)
+    )
+    return snap
+
+
+class TransportError(RuntimeError):
+    """The worker process or its socket failed — retryable from the fleet's
+    point of view (the router never sees this type: ``RemoteReplica`` maps it
+    to :class:`~finetune_controller_tpu.serve.batcher.ReplicaUnavailable` so
+    the failover path is byte-for-byte the in-process one)."""
+
+
+class RemoteError(RuntimeError):
+    """An exception raised INSIDE the worker, re-raised here with its remote
+    type preserved in the message (``SomeError: detail``) so
+    ``resilience.policy.classify_failure`` has the same text a local raise
+    would produce."""
+
+    def __init__(self, remote_type: str, message: str):
+        super().__init__(f"{remote_type}: {message}")
+        self.remote_type = remote_type
+
+
+def transport_stats() -> dict[str, Any]:
+    """Back-compat alias used by admin surfaces."""
+    return metrics_snapshot()

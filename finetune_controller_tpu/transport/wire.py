@@ -1,0 +1,104 @@
+"""Length-prefixed msgpack/JSON framing for the serve worker protocol.
+
+One frame per message::
+
+    u32 big-endian payload length | payload bytes
+
+The payload is a single document: msgpack when the interpreter has it (it
+ships with the flax toolchain, and carries adapter weight blobs as native
+``bytes``), JSON with base64-wrapped bytes otherwise — the codec is
+negotiated implicitly because both ends run the same image; a mixed
+deployment can pin ``FTC_TRANSPORT_CODEC=json``.
+
+Messages are small dicts::
+
+    request:  {"op": str, "id": int, "payload": {...}}
+    response: {"id": int, "ok": bool, "payload": {...}}            # success
+              {"id": int, "ok": false,
+               "error": {"type": str, "message": str, ...extras}}  # failure
+
+``MAX_FRAME`` bounds a single message (adapter stacks are megabytes; a
+gigabyte frame is a bug, not a payload) — an oversized length prefix tears
+the connection down instead of allocating it.
+
+Byte counters feed the process-wide ``ftc_serve_transport_bytes_total``
+metric (``transport.METRICS``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+from typing import Any
+
+from . import incr
+
+try:  # pragma: no cover - availability depends on the image
+    import msgpack  # type: ignore
+# ftc: ignore[silent-except] -- deliberate degrade: the JSON codec below is the documented fallback
+except Exception:  # pragma: no cover
+    msgpack = None
+
+#: hard per-frame ceiling: large enough for stacked adapter trees, far below
+#: anything a model-weight transfer would need (weights never ride this wire
+#: — workers stage checkpoints from disk/object store themselves)
+MAX_FRAME = 256 * (1 << 20)
+
+_FORCE_JSON = os.environ.get("FTC_TRANSPORT_CODEC", "").strip().lower() == "json"
+
+_B64_KEY = "__ftc_b64__"
+
+
+def codec_name() -> str:
+    return "msgpack" if (msgpack is not None and not _FORCE_JSON) else "json"
+
+
+def _json_default(obj: Any) -> Any:
+    if isinstance(obj, (bytes, bytearray)):
+        return {_B64_KEY: base64.b64encode(bytes(obj)).decode("ascii")}
+    raise TypeError(f"unserializable wire object: {type(obj)!r}")
+
+
+def _json_hook(obj: dict) -> Any:
+    if len(obj) == 1 and _B64_KEY in obj:
+        return base64.b64decode(obj[_B64_KEY])
+    return obj
+
+
+def dumps(obj: Any) -> bytes:
+    if msgpack is not None and not _FORCE_JSON:
+        return msgpack.packb(obj, use_bin_type=True)
+    return json.dumps(obj, default=_json_default).encode("utf-8")
+
+
+def loads(data: bytes) -> Any:
+    if msgpack is not None and not _FORCE_JSON:
+        return msgpack.unpackb(data, raw=False, strict_map_key=False)
+    return json.loads(data.decode("utf-8"), object_hook=_json_hook)
+
+
+class FrameError(RuntimeError):
+    """A torn or oversized frame — the connection is unusable afterwards."""
+
+
+async def write_msg(writer: asyncio.StreamWriter, obj: Any) -> None:
+    data = dumps(obj)
+    if len(data) > MAX_FRAME:
+        raise FrameError(f"frame of {len(data)} bytes exceeds MAX_FRAME")
+    writer.write(len(data).to_bytes(4, "big") + data)
+    incr("bytes_sent_total", len(data) + 4)
+    await writer.drain()
+
+
+async def read_msg(reader: asyncio.StreamReader) -> Any:
+    """Read one frame; raises :class:`asyncio.IncompleteReadError` (EOF) or
+    :class:`FrameError` (oversized/torn)."""
+    header = await reader.readexactly(4)
+    length = int.from_bytes(header, "big")
+    if length > MAX_FRAME:
+        raise FrameError(f"frame length {length} exceeds MAX_FRAME")
+    data = await reader.readexactly(length)
+    incr("bytes_received_total", length + 4)
+    return loads(data)

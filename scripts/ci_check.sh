@@ -94,6 +94,21 @@ if [ "$sched_rc" -ne 0 ]; then
     exit "$sched_rc"
 fi
 
+echo "== transport-fast (worker spawn, RPC protocol, cross-process failover) ==" >&2
+# The cross-process serve transport (docs/serving.md §Cross-process
+# transport): wire framing, the worker RPC protocol (in-process loopback),
+# real worker-process spawn/probe/drain, the SIGKILLed-worker exactly-once
+# proof, and the adapter registry-sync RPCs — the transport layer fails in
+# minutes here, before the fleet suite that rides it.
+timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_transport.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+transport_rc=$?
+if [ "$transport_rc" -ne 0 ]; then
+    echo "ci_check: transport-fast failed (exit $transport_rc)" >&2
+    exit "$transport_rc"
+fi
+
 echo "== serve-chaos-fast (replica kill, drain, failover, autoscale) ==" >&2
 # The fleet robustness anchors (docs/serving.md §Fleet): the 'not slow'
 # replica-kill/drain/failover/autoscale tests lead, and the slow-marked

@@ -949,3 +949,95 @@ def test_take_preemptions_owner_filter():
     serve_side = sched.take_preemptions(owner="serve")
     assert {d.job_id for d in serve_side} == {"serve-w0"}
     assert sched.take_preemptions() == []
+
+
+# ---------------------------------------------------------------------------
+# Cross-process transport (ISSUE 12): the same chaos, against a REAL process
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_replica_kill_is_a_real_sigkill_in_process_mode(tmp_path):
+    """The ISSUE 12 satellite pin for THIS suite: the identical
+    ``FTC_FAULT_SERVE_*`` env that drives the in-process kill above is
+    forwarded into worker-process spawns (``serve_transport=process``), so
+    the victim worker REALLY SIGKILLs itself mid-decode — detection,
+    failover, exactly-once and respawn all run against genuine process
+    death.  The deeper protocol proofs live in ``tests/test_transport.py``;
+    this test keeps the serve-chaos suite honest about which fault it
+    exercises."""
+    import os
+
+    from finetune_controller_tpu.transport.process import ProcessTransport
+
+    async def main():
+        once = tmp_path / "spent"
+        transport = ProcessTransport(
+            job_id="job-under-test", root=tmp_path / "workers",
+            payload={"builder": "tiny_test", "kwargs": {"lora_rank": 4}},
+            spawn_timeout_s=240.0, heartbeat_interval_s=0.5,
+            extra_env=ServeFault(
+                replica_id="r0", at_step=2, mode="kill",
+                once_file=str(once),
+            ).to_env(),
+        )
+        fleet = ReplicaFleet(
+            "job-under-test", None, None, EngineConfig(**ENGINE_CFG),
+            replicas=2, transport=transport, stall_timeout_s=30.0,
+            restart_policy=RetryPolicy(
+                max_attempts=3, base_delay_s=0.1, max_delay_s=0.3, seed=0
+            ),
+        )
+        await fleet.start()
+        victim_pids = set(fleet.stats()["worker_pids"])
+        router = ReplicaRouter(fleet, default_timeout_s=120,
+                               failover_retries=2)
+
+        async def health_loop():
+            while True:
+                await fleet.health_tick()
+                await asyncio.sleep(0.1)
+
+        hl = asyncio.ensure_future(health_loop())
+        try:
+            results = await asyncio.gather(
+                *(router.submit(r) for r in _reqs(max_new=8))
+            )
+            seen = {r.request_id: r.generated for r in results}
+            assert len(seen) == len(PROMPTS)
+            assert once.exists(), "the forwarded fault never fired"
+            # bit-identical to cached_generate — across process boundaries
+            model, variables = _worker_payload()
+            for rid, toks in seen.items():
+                i = int(rid[1:])
+                assert [int(t) for t in toks] == \
+                    _baseline(model, variables, PROMPTS[i], 8), rid
+            # the SIGKILLed pid is gone and a FRESH process respawned
+            for _ in range(150):
+                if len(fleet.healthy_replicas()) >= 2 \
+                        and fleet.replica_restarts_total >= 1:
+                    break
+                await asyncio.sleep(0.2)
+            assert fleet.replica_restarts_total >= 1
+            new_pids = set(fleet.stats()["worker_pids"])
+            assert new_pids - victim_pids, "no fresh worker process spawned"
+            dead = victim_pids - new_pids
+            assert dead, "the victim pid is still in the fleet"
+            for pid in dead:
+                with pytest.raises(ProcessLookupError):
+                    os.kill(pid, 0)
+        finally:
+            hl.cancel()
+            await fleet.close()
+
+    run_async(main())
+
+
+def _worker_payload():
+    """EXACTLY the worker builder's payload (transport/builders.py
+    tiny_test(lora_rank=4)) — the same weights this module's ``tiny_model``
+    fixture builds, constructed here so the bit-identity assertion names
+    its comparator explicitly."""
+    from finetune_controller_tpu.transport.builders import tiny_test
+
+    return tiny_test(lora_rank=4)
