@@ -31,7 +31,10 @@ Env knobs: BENCH_PRESET, BENCH_STEPS, BENCH_BATCH, BENCH_SEQ, BENCH_TINY=1
 (CI-sized run), BENCH_MODE=qlora (int4 config #3), BENCH_REMAT_POLICY,
 BENCH_ATTN_IMPL, BENCH_FROZEN_DTYPE, BENCH_LOGITS_DTYPE (perf experiments),
 BENCH_RECOMPILE_BUDGET (distinct jit signatures allowed before the run is
-declared a measurement bug and aborted — analysis/recompile_guard.py; 0 off).
+declared a measurement bug and aborted — analysis/recompile_guard.py; 0 off),
+BENCH_TRANSFER_GUARD (default on: the trainer step and serve decode hot
+windows run under FTC_TRANSFER_GUARD=raise — analysis/transfer_guard.py — so
+a reintroduced device<->host sync ABORTS the timed window; 0 disables).
 
 Input-pipeline knobs (round 6): BENCH_PREFETCH (background prefetch depth
 for the batch stream, default 2; 0 = synchronous host build on the timing
@@ -1015,6 +1018,18 @@ def _measure_serve() -> dict:
         GenRequest,
     )
 
+    from finetune_controller_tpu.platform import env_flag
+
+    # transfer guard (analysis/transfer_guard.py): every engine this bench
+    # builds — including process-mode workers, which inherit the env — runs
+    # its decode dispatch under FTC_TRANSFER_GUARD=raise, so a reintroduced
+    # device<->host sync ABORTS the timed window instead of deflating the
+    # measured tok/s. BENCH_TRANSFER_GUARD=0 disables; an explicit
+    # FTC_TRANSFER_GUARD in the env wins.
+    transfer_guard_armed = env_flag("BENCH_TRANSFER_GUARD", default=True)
+    if transfer_guard_armed:
+        os.environ.setdefault("FTC_TRANSFER_GUARD", "raise")
+
     preset = os.environ.get("BENCH_PRESET", "tiny-test")
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "8"))
     max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "32"))
@@ -1231,6 +1246,13 @@ def _measure_serve() -> dict:
         "slots": slots,
         "compilations": engine.compilations,
         "recompile_budget": engine.guard.budget,
+        # the timed windows above ran to completion, so an armed guard saw
+        # ZERO device<->host syncs in the decode hot path (it aborts on one)
+        "transfer_guard_armed": transfer_guard_armed,
+        "transfer_guard_trips": (
+            engine._transfer_guard.trips
+            if engine._transfer_guard is not None else 0
+        ),
         "mixed_prefix_on_tokens_per_sec": round(mixed_on_tps, 1),
         "prefix_ab": {
             "ttft_speedup": round(ttft_speedup, 2),
@@ -2027,6 +2049,14 @@ def main() -> None:
         # legs) while a per-step shape leak burns through it immediately.
         recompile_budget=int(os.environ.get("BENCH_RECOMPILE_BUDGET", "4")),
         recompile_action="raise",
+        # transfer guard (analysis/transfer_guard.py): same contract for
+        # device<->host syncs — a stray device_get / implicit np transfer
+        # inside the timed step window ABORTS the bench instead of silently
+        # serializing the dispatch pipeline. BENCH_TRANSFER_GUARD=0 disables.
+        transfer_guard=(
+            "raise" if env_flag("BENCH_TRANSFER_GUARD", default=True)
+            else "off"
+        ),
     )
     trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
     state = trainer.init_state()

@@ -1,9 +1,20 @@
-"""The ftc-lint engine: file walker, rule registry, suppressions, reporting.
+"""The ftc-lint engine: file walker, rule registries, suppressions, reporting.
 
-A rule is a callable ``(module: ast.Module, src: str, path: str) -> iterable
-of (line, col, message)`` registered under a kebab-case id with
-:func:`register`.  The engine parses each file once, runs every selected rule
-over the tree, then drops findings covered by an inline suppression::
+Two kinds of rule:
+
+* a **per-file rule** is a callable ``(module: ast.Module, src: str, path:
+  str) -> iterable of (line, col, message)`` registered under a kebab-case
+  id with :func:`register`;
+* a **project rule** is a callable ``(project: analysis.project.Project) ->
+  iterable of (path, line, col, message)`` registered with
+  :func:`register_project` — it sees the whole package at once (call graph,
+  symbol table, thread/async/jit classification) and powers the
+  interprocedural rules (``rules_flow``, ``rules_concurrency``,
+  ``rules_protocol``).
+
+The engine parses each file once, runs every selected per-file rule over
+the tree, builds the project index (shared by all project rules), then
+drops findings covered by an inline suppression::
 
     risky_line()  # ftc: ignore[rule-id] -- why this is intentional
 
@@ -12,6 +23,10 @@ above it (for statements too long to share a line with their justification),
 and may carry several ids: ``# ftc: ignore[silent-except,host-sync-in-jit]``.
 The ``-- reason`` tail is free text; CI policy (docs/static_analysis.md) is
 that every suppression carries one.
+
+Output formats: ``text`` and ``json`` (byte-compatible with PR 2) plus
+``sarif`` (SARIF 2.1.0 for CI annotations and editors).  ``--rules`` /
+``--exclude-rules`` are selector aliases of ``--select`` / ``--ignore``.
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 parse/usage errors.
 """
@@ -31,8 +46,11 @@ __all__ = [
     "Finding",
     "LintResult",
     "Rule",
+    "ProjectRule",
     "register",
+    "register_project",
     "all_rules",
+    "all_project_rules",
     "lint_source",
     "lint_paths",
     "main",
@@ -72,7 +90,19 @@ class Rule:
     check: Callable[[ast.Module, str, str], Iterable[tuple[int, int, str]]]
 
 
+@dataclasses.dataclass(frozen=True)
+class ProjectRule:
+    """A rule over the whole-package index (``analysis/project.py``):
+    ``check(project)`` yields ``(path, line, col, message)``."""
+
+    id: str
+    plane: str  # "flow" | "concurrency" | "protocol"
+    summary: str
+    check: Callable[[object], Iterable[tuple[str, int, int, str]]]
+
+
 _REGISTRY: dict[str, Rule] = {}
+_PROJECT_REGISTRY: dict[str, ProjectRule] = {}
 
 
 def register(rule_id: str, plane: str, summary: str):
@@ -87,13 +117,34 @@ def register(rule_id: str, plane: str, summary: str):
     return deco
 
 
+def register_project(rule_id: str, plane: str, summary: str):
+    """Decorator: register a project-wide ``check(project)`` under
+    ``rule_id``.  Ids share one namespace with per-file rules (selectors
+    don't care which kind they name)."""
+
+    def deco(fn):
+        if rule_id in _PROJECT_REGISTRY or rule_id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        _PROJECT_REGISTRY[rule_id] = ProjectRule(rule_id, plane, summary, fn)
+        return fn
+
+    return deco
+
+
 def all_rules() -> dict[str, Rule]:
-    """The full registry (importing the rule modules on first use)."""
+    """The per-file registry (importing the rule modules on first use)."""
     # imported lazily so `from .engine import register` inside the rule
     # modules doesn't cycle at package import time
     from . import rules_compute, rules_controller  # noqa: F401
 
     return dict(_REGISTRY)
+
+
+def all_project_rules() -> dict[str, ProjectRule]:
+    """The project-wide registry (importing its rule modules on first use)."""
+    from . import rules_concurrency, rules_flow, rules_protocol  # noqa: F401
+
+    return dict(_PROJECT_REGISTRY)
 
 
 # ---- suppression handling --------------------------------------------------
@@ -136,15 +187,9 @@ class LintResult:
         return 1 if self.active else 0
 
 
-def lint_source(
-    src: str,
-    path: str = "<string>",
-    rules: dict[str, Rule] | None = None,
+def _lint_parsed(
+    module: ast.Module, src: str, path: str, rules: dict[str, Rule]
 ) -> list[Finding]:
-    """Lint one source string; returns findings with suppressions applied
-    (suppressed findings are kept, flagged, for ``--show-suppressed``)."""
-    rules = rules if rules is not None else all_rules()
-    module = ast.parse(src, filename=path)
     supp = _suppressions(src)
     findings: list[Finding] = []
     seen: set[tuple] = set()
@@ -162,6 +207,18 @@ def lint_source(
     return findings
 
 
+def lint_source(
+    src: str,
+    path: str = "<string>",
+    rules: dict[str, Rule] | None = None,
+) -> list[Finding]:
+    """Lint one source string; returns findings with suppressions applied
+    (suppressed findings are kept, flagged, for ``--show-suppressed``)."""
+    rules = rules if rules is not None else all_rules()
+    module = ast.parse(src, filename=path)
+    return _lint_parsed(module, src, path, rules)
+
+
 def _iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
     for raw in paths:
         p = Path(raw)
@@ -174,41 +231,166 @@ def _iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
 def lint_paths(
     paths: Iterable[str],
     rules: dict[str, Rule] | None = None,
+    *,
+    project_rules: dict[str, ProjectRule] | None = None,
+    source_overrides: dict[str, str] | None = None,
 ) -> LintResult:
+    """Lint files/directories with per-file AND project-wide rules.
+
+    ``project_rules=None`` runs the full project registry; pass ``{}`` to
+    skip the interprocedural pass.  ``source_overrides`` (absolute path ->
+    source text) lints the tree with files swapped in memory — the
+    mutation-test hook (delete an RPC handler, watch the lint turn red).
+    """
     rules = rules if rules is not None else all_rules()
+    project_rules = (
+        project_rules if project_rules is not None else all_project_rules()
+    )
+    overrides = {str(Path(k)): v for k, v in (source_overrides or {}).items()}
     findings: list[Finding] = []
     errors: list[str] = []
-    for path in _iter_py_files(paths):
+    sources: dict[str, str] = {}
+    path_list = list(paths)
+    # ONE parse per file: the project index doubles as the parse cache for
+    # the per-file pass (the 10s CI budget covers both passes together)
+    project = None
+    if project_rules:
+        from .project import build_project
+
+        project = build_project(path_list, source_overrides=overrides)
+    for path in _iter_py_files(path_list):
+        key = str(path)
+        mod = project.modules_by_path.get(key) if project is not None else None
+        if mod is not None:
+            src = mod.src
+        else:
+            src = overrides.get(key)
+            if src is None:
+                try:
+                    src = path.read_text(encoding="utf-8")
+                except OSError as exc:
+                    errors.append(f"{path}: unreadable: {exc}")
+                    continue
+        sources[key] = src
         try:
-            src = path.read_text(encoding="utf-8")
-        except OSError as exc:
-            errors.append(f"{path}: unreadable: {exc}")
-            continue
-        try:
-            findings.extend(lint_source(src, str(path), rules))
+            if mod is not None:
+                findings.extend(_lint_parsed(mod.tree, src, key, rules))
+            else:
+                findings.extend(lint_source(src, key, rules))
         except SyntaxError as exc:
             errors.append(f"{path}: parse error: {exc}")
+    if project_rules:
+        supp_cache: dict[str, dict[int, set[str]]] = {}
+
+        def suppressions_for(path: str) -> dict[int, set[str]]:
+            supp = supp_cache.get(path)
+            if supp is None:
+                src = sources.get(path)
+                if src is None:  # e.g. a finding anchored in docs/*.md
+                    try:
+                        src = Path(path).read_text(encoding="utf-8")
+                    except OSError:
+                        src = ""
+                supp = supp_cache[path] = _suppressions(src)
+            return supp
+
+        seen: set[tuple] = set()
+        for rule in project_rules.values():
+            for fpath, line, col, message in rule.check(project):
+                # message included: one call site can carry DISTINCT findings
+                # (a required key missing AND a sent key unread, same line)
+                key = (rule.id, fpath, line, col, message)
+                if key in seen:
+                    continue
+                seen.add(key)
+                f = Finding(rule.id, fpath, line, col, message)
+                if _is_suppressed(f, suppressions_for(fpath)):
+                    f = dataclasses.replace(f, suppressed=True)
+                findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return LintResult(findings=findings, errors=errors)
 
 
 # ---- CLI -------------------------------------------------------------------
 
 
-def _select_rules(select: str | None, ignore: str | None) -> dict[str, Rule]:
+def _select_rules(
+    select: str | None, ignore: str | None
+) -> tuple[dict[str, Rule], dict[str, ProjectRule]]:
+    """Apply ``--select``/``--ignore`` (aka ``--rules``/``--exclude-rules``)
+    across BOTH registries — selectors name rule ids, not rule kinds."""
     rules = all_rules()
+    project_rules = all_project_rules()
+    known = rules.keys() | project_rules.keys()
     if select:
         wanted = {s.strip() for s in select.split(",") if s.strip()}
-        unknown = wanted - rules.keys()
+        unknown = wanted - known
         if unknown:
             raise SystemExit(f"ftc-lint: unknown rule(s): {sorted(unknown)}")
         rules = {k: v for k, v in rules.items() if k in wanted}
+        project_rules = {k: v for k, v in project_rules.items() if k in wanted}
     if ignore:
         dropped = {s.strip() for s in ignore.split(",") if s.strip()}
-        unknown = dropped - all_rules().keys()
+        unknown = dropped - known
         if unknown:
             raise SystemExit(f"ftc-lint: unknown rule(s): {sorted(unknown)}")
         rules = {k: v for k, v in rules.items() if k not in dropped}
-    return rules
+        project_rules = {
+            k: v for k, v in project_rules.items() if k not in dropped
+        }
+    return rules, project_rules
+
+
+def _sarif_doc(shown: list[Finding], errors: list[str]) -> dict:
+    """SARIF 2.1.0 payload: one run, findings as results, suppressed ones
+    carrying an ``inSource`` suppression so viewers render them greyed."""
+    metas: dict[str, str] = {}
+    for reg in (all_rules(), all_project_rules()):
+        for rid, rule in reg.items():
+            metas[rid] = rule.summary
+    used = sorted({f.rule for f in shown})
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                   "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ftc-lint",
+                "informationUri": "docs/static_analysis.md",
+                "rules": [
+                    {"id": rid,
+                     "shortDescription": {"text": metas.get(rid, rid)}}
+                    for rid in used
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": "warning",
+                    "message": {"text": f.message},
+                    **({"suppressions": [{"kind": "inSource"}]}
+                       if f.suppressed else {}),
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {
+                                "startLine": f.line,
+                                "startColumn": f.col + 1,
+                            },
+                        },
+                    }],
+                }
+                for f in shown
+            ],
+            "invocations": [{
+                "executionSuccessful": not errors,
+                **({"toolExecutionNotifications": [
+                    {"level": "error", "message": {"text": e}}
+                    for e in errors
+                ]} if errors else {}),
+            }],
+        }],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -219,24 +401,34 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("paths", nargs="*", default=["finetune_controller_tpu"],
                    help="files or directories (default: the package)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
-    p.add_argument("--select", help="comma-separated rule ids to run")
-    p.add_argument("--ignore", help="comma-separated rule ids to skip")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
+    p.add_argument("--select", "--rules", dest="select",
+                   help="comma-separated rule ids to run")
+    p.add_argument("--ignore", "--exclude-rules", dest="ignore",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--no-project", action="store_true",
+                   help="skip the project-wide (interprocedural) pass")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print findings silenced by ftc: ignore")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
     if args.list_rules:
-        for rule in sorted(all_rules().values(), key=lambda r: (r.plane, r.id)):
-            print(f"{rule.id:30} [{rule.plane:10}] {rule.summary}")
+        rows = list(all_rules().values()) + list(all_project_rules().values())
+        for rule in sorted(rows, key=lambda r: (r.plane, r.id)):
+            print(f"{rule.id:30} [{rule.plane:11}] {rule.summary}")
         return 0
 
-    rules = _select_rules(args.select, args.ignore)
-    result = lint_paths(args.paths, rules)
+    rules, project_rules = _select_rules(args.select, args.ignore)
+    if args.no_project:
+        project_rules = {}
+    result = lint_paths(args.paths, rules, project_rules=project_rules)
 
     shown = result.findings if args.show_suppressed else result.active
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(_sarif_doc(shown, result.errors), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [f.to_json() for f in shown],
             "errors": result.errors,

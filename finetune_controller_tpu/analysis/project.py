@@ -1,0 +1,524 @@
+"""Project-wide analysis core: module index, symbol table, call graph.
+
+PR 2's rules see one file at a time; the bugs that bite this codebase now
+cross boundaries — an ``async def`` that blocks the event loop *through two
+helpers*, a worker-thread mutation racing an event-loop read, an RPC client
+whose op table drifted from the worker's.  This module parses the whole
+package ONCE into:
+
+* a **module index** (dotted name -> AST + source + per-line suppressions),
+  with import resolution (absolute, ``import a.b as c``, and
+  level-counted relative forms);
+* a **symbol table** of every module-level function and class (methods,
+  resolved base classes, and ``self.<attr>`` types inferred from annotated
+  assignments, annotated ``__init__`` params, and direct construction);
+* a conservative **call graph**: edges only where the callee provably
+  resolves to a project symbol (local names, imports, ``self.method``,
+  ``self.attr.method`` via the attr's inferred type, annotated params and
+  locally-constructed variables).  Unresolvable calls produce NO edge —
+  the graph under-approximates, which is the right bias for lint: every
+  rendered call chain is real;
+* **execution-context classification**: async functions (event-loop code),
+  thread entries (``asyncio.to_thread(f)``, ``loop.run_in_executor(_, f)``,
+  ``threading.Thread(target=f)``) and everything reachable from them, and
+  jitted functions (the per-file ``jitted_functions`` detection, pooled).
+
+Calls inside a nested ``def``/``lambda`` are deferral boundaries exactly as
+in the per-file rules: they are not edges of the enclosing function.
+
+The rule modules built on top: ``rules_flow`` (transitive async/jit),
+``rules_concurrency`` (lock discipline), ``rules_protocol`` (RPC + metric
+conformance).  ``source_overrides`` lets mutation tests lint the real
+package with one file's source swapped in memory (delete a handler, watch
+the lint turn red) without touching the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from ._astutil import FuncDef, dotted_name, jitted_functions, terminal_name
+
+__all__ = ["Project", "ModuleInfo", "ClassInfo", "FunctionInfo", "CallSite",
+           "build_project"]
+
+#: ``asyncio.to_thread(f, ...)`` / bare ``to_thread`` — first arg is deferred
+_TO_THREAD = {"asyncio.to_thread", "to_thread"}
+#: ``loop.run_in_executor(executor, f, ...)`` — second arg is deferred
+_RUN_IN_EXECUTOR = "run_in_executor"
+#: ``threading.Thread(target=f)`` — the ``target`` kwarg is a thread entry
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    """One resolved edge of the call graph."""
+
+    callee: str          # qualname of the resolved target
+    line: int
+    col: int
+    #: "sync"     — plain call, runs in the caller's execution context
+    #: "deferred" — handed to a worker thread (to_thread/executor/Thread);
+    #:              runs CONCURRENTLY with the caller's context
+    context: str = "sync"
+
+
+@dataclasses.dataclass(eq=False)
+class FunctionInfo:
+    qualname: str        # "pkg.mod.func" or "pkg.mod.Class.method"
+    name: str
+    module: "ModuleInfo"
+    node: FuncDef
+    cls: "ClassInfo | None" = None
+    is_async: bool = False
+    calls: list[CallSite] = dataclasses.field(default_factory=list)
+
+    @property
+    def path(self) -> str:
+        return self.module.path
+
+    @property
+    def display(self) -> str:
+        """Short human name for chain rendering: ``Class.method`` / ``func``."""
+        if self.cls is not None:
+            return f"{self.cls.name}.{self.name}"
+        return self.name
+
+
+@dataclasses.dataclass(eq=False)
+class ClassInfo:
+    qualname: str
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    base_names: list[str] = dataclasses.field(default_factory=list)
+    #: ``self.<attr>`` -> class qualname, where inferable
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(eq=False)
+class ModuleInfo:
+    name: str            # dotted module name
+    path: str
+    tree: ast.Module
+    src: str
+    #: local alias -> absolute dotted target ("np" -> "numpy",
+    #: "register" -> "pkg.analysis.engine.register")
+    imports: dict[str, str] = dataclasses.field(default_factory=dict)
+    functions: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    classes: dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+
+
+def _module_name_for(path: Path) -> str:
+    """Dotted module name by walking up while ``__init__.py`` exists (a file
+    outside any package keeps its stem)."""
+    parts = [path.stem] if path.stem != "__init__" else []
+    parent = path.parent
+    while (parent / "__init__.py").exists():
+        parts.insert(0, parent.name)
+        parent = parent.parent
+    return ".".join(parts) if parts else path.stem
+
+
+def _resolve_relative(module_name: str, level: int, target: str | None) -> str:
+    """``from ..a import b`` inside ``pkg.sub.mod`` -> ``pkg.a``.
+
+    ``level`` counts the leading dots; the current module's last ``level``
+    components are stripped (a module's own name counts as one)."""
+    parts = module_name.split(".")
+    base = parts[: len(parts) - level] if level <= len(parts) else []
+    if target:
+        base = base + target.split(".")
+    return ".".join(base)
+
+
+class Project:
+    """The whole-package index the project rules consume."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.modules_by_path: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        #: qualnames handed to a worker thread somewhere in the project
+        self.thread_roots: set[str] = set()
+        #: qualname -> "decorated" | "referenced" (per-file jit detection)
+        self.jitted: dict[str, str] = {}
+        self.roots: list[Path] = []
+
+    # ---- lookups -----------------------------------------------------------
+
+    def function(self, qualname: str) -> FunctionInfo | None:
+        return self.functions.get(qualname)
+
+    def sync_callees(self, qualname: str) -> list[CallSite]:
+        fn = self.functions.get(qualname)
+        if fn is None:
+            return []
+        return [c for c in fn.calls if c.context == "sync"]
+
+    def async_functions(self) -> Iterator[FunctionInfo]:
+        for fn in self.functions.values():
+            if fn.is_async:
+                yield fn
+
+    def thread_reachable(self) -> set[str]:
+        """Thread roots plus everything reachable from them via sync edges."""
+        seen = set()
+        stack = [q for q in self.thread_roots if q in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(c.callee for c in self.sync_callees(q))
+        return seen
+
+    def resolve_class(self, module: ModuleInfo, dotted: str) -> ClassInfo | None:
+        q = self._resolve_name(module, dotted)
+        return self.classes.get(q) if q else None
+
+    def method_of(self, cls: ClassInfo, name: str) -> FunctionInfo | None:
+        """Method lookup walking project-resolvable base classes."""
+        seen: set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            if name in c.methods:
+                return c.methods[name]
+            for base in c.base_names:
+                b = self.resolve_class(c.module, base)
+                if b is not None:
+                    stack.append(b)
+        return None
+
+    def docs_file(self, filename: str) -> Path | None:
+        """Locate ``docs/<filename>`` next to (or above) the linted roots —
+        the metric-conformance rule reads the catalog from it."""
+        for root in self.roots:
+            base = root if root.is_dir() else root.parent
+            for candidate in (base / "docs" / filename,
+                              base.parent / "docs" / filename):
+                if candidate.exists():
+                    return candidate
+        return None
+
+    # ---- name resolution ---------------------------------------------------
+
+    def _resolve_name(self, module: ModuleInfo, dotted: str) -> str | None:
+        """Absolute qualname for a dotted local name, or None."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        target = module.imports.get(head)
+        if target is None:
+            # a module-local symbol?
+            local = f"{module.name}.{dotted}" if module.name else dotted
+            if local in self.functions or local in self.classes:
+                return local
+            # "pkg.sub.mod.sym" spelled absolutely
+            if dotted in self.functions or dotted in self.classes:
+                return dotted
+            return None
+        full = f"{target}.{rest}" if rest else target
+        if full in self.functions or full in self.classes:
+            return full
+        return None
+
+    def resolve_callable(
+        self,
+        module: ModuleInfo,
+        fn: FunctionInfo | None,
+        expr: ast.AST,
+        local_types: dict[str, str] | None = None,
+    ) -> str | None:
+        """Qualname of the function ``expr`` names in this scope, or None.
+
+        Handles plain/dotted names, ``self.method``, ``self.attr.method``
+        (via inferred attr types), ``var.method`` (via annotated params or
+        local construction), and class references (-> ``__init__``)."""
+        local_types = local_types or {}
+        dotted = dotted_name(expr)
+        if dotted:
+            parts = dotted.split(".")
+            if parts[0] == "self" and fn is not None and fn.cls is not None:
+                if len(parts) == 2:  # self.method
+                    m = self.method_of(fn.cls, parts[1])
+                    return m.qualname if m else None
+                if len(parts) == 3:  # self.attr.method
+                    cls_q = fn.cls.attr_types.get(parts[1])
+                    cls = self.classes.get(cls_q) if cls_q else None
+                    if cls is not None:
+                        m = self.method_of(cls, parts[2])
+                        return m.qualname if m else None
+                return None
+            if len(parts) >= 2 and parts[0] in local_types:
+                cls = self.classes.get(local_types[parts[0]])
+                if cls is not None and len(parts) == 2:
+                    m = self.method_of(cls, parts[1])
+                    return m.qualname if m else None
+                return None
+            q = self._resolve_name(module, dotted)
+            if q is None:
+                return None
+            if q in self.classes:  # constructing a class calls its __init__
+                init = self.classes[q].methods.get("__init__")
+                return init.qualname if init else q
+            return q
+        return None
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(paths: Iterable[str | Path]) -> Iterator[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def _collect_imports(module: ModuleInfo) -> None:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                module.imports[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            base = (
+                _resolve_relative(module.name, node.level, node.module)
+                if node.level else (node.module or "")
+            )
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                module.imports[local] = f"{base}.{alias.name}" if base else alias.name
+
+
+def _collect_symbols(project: Project, module: ModuleInfo) -> None:
+    prefix = f"{module.name}." if module.name else ""
+    for node in module.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            q = f"{prefix}{node.name}"
+            fi = FunctionInfo(
+                qualname=q, name=node.name, module=module, node=node,
+                is_async=isinstance(node, ast.AsyncFunctionDef),
+            )
+            module.functions[node.name] = fi
+            project.functions[q] = fi
+        elif isinstance(node, ast.ClassDef):
+            cq = f"{prefix}{node.name}"
+            ci = ClassInfo(
+                qualname=cq, name=node.name, module=module, node=node,
+                base_names=[dotted_name(b) for b in node.bases if dotted_name(b)],
+            )
+            module.classes[node.name] = ci
+            project.classes[cq] = ci
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    mq = f"{cq}.{item.name}"
+                    fi = FunctionInfo(
+                        qualname=mq, name=item.name, module=module,
+                        node=item, cls=ci,
+                        is_async=isinstance(item, ast.AsyncFunctionDef),
+                    )
+                    ci.methods[item.name] = fi
+                    project.functions[mq] = fi
+
+
+def _param_annotations(fn: FuncDef) -> dict[str, str]:
+    out: dict[str, str] = {}
+    a = fn.args
+    for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+        if p.annotation is not None:
+            ann = _annotation_name(p.annotation)
+            if ann:
+                out[p.arg] = ann
+    return out
+
+
+def _annotation_name(ann: ast.AST) -> str:
+    """The class name an annotation spells: ``Batcher``, ``"Batcher"``
+    (string form), ``Batcher | None`` / ``Optional[Batcher]``."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip().split("|")[0].strip().strip("\"'")
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        left = _annotation_name(ann.left)
+        return left if left and left != "None" else _annotation_name(ann.right)
+    if isinstance(ann, ast.Subscript):
+        base = dotted_name(ann.value)
+        if terminal_name(ann.value) == "Optional":
+            return _annotation_name(ann.slice)
+        return base
+    name = dotted_name(ann)
+    return "" if name == "None" else name
+
+
+def _infer_attr_types(project: Project, ci: ClassInfo) -> None:
+    """``self.<attr>`` -> project class, from (a) annotated assignment,
+    (b) ``self.attr = <annotated __init__ param>``, (c) ``self.attr =
+    ClassName(...)`` direct construction."""
+    module = ci.module
+    for method in ci.methods.values():
+        params = _param_annotations(method.node)
+        for node in ast.walk(method.node):
+            attr = None
+            value = None
+            ann = None
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Attribute):
+                if dotted_name(node.target).startswith("self."):
+                    attr = node.target.attr
+                    ann = _annotation_name(node.annotation)
+                    value = node.value
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Attribute)
+                and dotted_name(node.targets[0]) == f"self.{node.targets[0].attr}"
+            ):
+                attr = node.targets[0].attr
+                value = node.value
+            if attr is None or attr in ci.attr_types:
+                continue
+            cls_q = None
+            if ann:
+                c = project.resolve_class(module, ann)
+                cls_q = c.qualname if c else None
+            if cls_q is None and isinstance(value, ast.Name):
+                pann = params.get(value.id)
+                if pann:
+                    c = project.resolve_class(module, pann)
+                    cls_q = c.qualname if c else None
+            if cls_q is None and isinstance(value, ast.Call):
+                c = project.resolve_class(module, dotted_name(value.func))
+                cls_q = c.qualname if c else None
+            if cls_q is not None:
+                ci.attr_types[attr] = cls_q
+
+
+def _local_var_types(project: Project, module: ModuleInfo, fn: FunctionInfo) -> dict[str, str]:
+    """Function-local ``var -> class qualname``: annotated params plus
+    single-name assignments from direct construction."""
+    out: dict[str, str] = {}
+    for name, ann in _param_annotations(fn.node).items():
+        c = project.resolve_class(module, ann)
+        if c is not None:
+            out[name] = c.qualname
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+        ):
+            c = project.resolve_class(module, dotted_name(node.value.func)) \
+                if isinstance(node.value, ast.Call) else None
+            if c is not None:
+                out[node.targets[0].id] = c.qualname
+    return out
+
+
+def _own_nodes(fn: FuncDef) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function/class
+    scopes — a nested def is a deferral boundary, its calls are not the
+    enclosing function's edges."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _deferred_target(call: ast.Call) -> ast.AST | None:
+    """The callable expression this call hands to a worker thread, if any."""
+    name = dotted_name(call.func)
+    if name in _TO_THREAD and call.args:
+        return call.args[0]
+    if terminal_name(call.func) == _RUN_IN_EXECUTOR and len(call.args) >= 2:
+        return call.args[1]
+    if name in _THREAD_CTORS:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+    return None
+
+
+def _build_edges(project: Project) -> None:
+    for fn in list(project.functions.values()):
+        module = fn.module
+        local_types = _local_var_types(project, module, fn)
+        for node in _own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            deferred = _deferred_target(node)
+            if deferred is not None:
+                q = project.resolve_callable(module, fn, deferred, local_types)
+                if q is not None:
+                    fn.calls.append(CallSite(q, node.lineno, node.col_offset,
+                                             context="deferred"))
+                    project.thread_roots.add(q)
+                continue
+            q = project.resolve_callable(module, fn, node.func, local_types)
+            if q is not None and q != fn.qualname:
+                fn.calls.append(CallSite(q, node.lineno, node.col_offset))
+
+
+def _classify_jitted(project: Project) -> None:
+    for module in project.modules.values():
+        node_to_fn = {
+            fi.node: fi for fi in project.functions.values()
+            if fi.module is module
+        }
+        for node, how in jitted_functions(module.tree).items():
+            fi = node_to_fn.get(node)
+            if fi is not None:
+                project.jitted[fi.qualname] = how
+
+
+def build_project(
+    paths: Iterable[str | Path],
+    *,
+    source_overrides: dict[str, str] | None = None,
+) -> Project:
+    """Parse every ``.py`` under ``paths`` into a :class:`Project`.
+
+    ``source_overrides`` maps absolute path strings to replacement source —
+    the mutation-test hook: lint the real package with one file edited in
+    memory.  Unparseable files are skipped (the per-file pass reports them).
+    """
+    overrides = {str(Path(k)): v for k, v in (source_overrides or {}).items()}
+    project = Project()
+    project.roots = [Path(p) for p in paths]
+    for path in _iter_py_files(paths):
+        key = str(path)
+        try:
+            src = overrides.get(key)
+            if src is None:
+                src = path.read_text(encoding="utf-8")
+            tree = ast.parse(src, filename=key)
+        except (OSError, SyntaxError):
+            continue
+        module = ModuleInfo(
+            name=_module_name_for(path), path=key, tree=tree, src=src
+        )
+        project.modules[module.name] = module
+        project.modules_by_path[key] = module
+    for module in project.modules.values():
+        _collect_imports(module)
+        _collect_symbols(project, module)
+    for ci in project.classes.values():
+        _infer_attr_types(project, ci)
+    _build_edges(project)
+    _classify_jitted(project)
+    return project

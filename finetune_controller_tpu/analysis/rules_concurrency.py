@@ -1,0 +1,286 @@
+"""Lock-discipline rule: races the per-file thread rule cannot see.
+
+PRs 10–12 hand-fixed three races between the event loop and worker threads
+(batcher ``_inflight`` registration, DRR rotation, adapter-unload busy
+checks).  The shape is always the same: a field touched from a
+``asyncio.to_thread``/``threading.Thread`` context AND from loop/main-thread
+code, with at least one side doing a non-atomic read-modify-write.  The
+per-file ``shared-mutable-without-lock`` rule only sees literal
+``threading.Thread(target=...)`` in the same module; this rule uses the
+project call graph to classify every method's execution context.
+
+Two prongs, one rule id (``lock-discipline``):
+
+**A — lock-holding classes.**  A class that creates a ``threading.Lock`` /
+``RLock`` / ``Condition`` has declared itself multi-threaded.  The guarded
+set is inferred: every ``self.<field>`` *mutated* under a ``with
+self.<lock>:`` block somewhere in the class.  Findings: (1) any access
+(read or write) of a guarded field outside the lock, and (2) any non-atomic
+mutation (``+=`` / ``.append()``-family) of ANY field outside the lock —
+the unguarded-counter shape.  ``__init__`` is exempt (construction
+happens-before publication).
+
+**B — lock-less classes provably touched from multiple threads.**  A class
+with no lock whose bound methods are thread entries (handed to
+``asyncio.to_thread`` / ``run_in_executor`` / ``Thread(target=...)``
+anywhere in the project, or a ``threading.Thread`` subclass's ``run``).
+Methods reachable from a thread root via sync edges are *thread-side*;
+the rest are *loop-side*.  A field written from BOTH sides, with at least
+one side non-atomic, is flagged once per (class, field) at the non-atomic
+site — the message names the thread entry and the other-side writer so the
+reader sees the interleaving without rebuilding the graph.  Sites where
+the overlap is intentionally serialized (e.g. the batcher drive loop owns
+the engine between steps) carry ``# ftc: ignore[lock-discipline]`` with
+the ownership argument spelled out.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ._astutil import FuncDef, dotted_name, parent_map
+from .engine import register_project
+
+#: threading (NOT asyncio) synchronisation primitives
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+_THREAD_BASES = {"threading.Thread", "Thread"}
+
+#: in-place mutators whose read-modify-write spans bytecodes (mirrors the
+#: per-file shared-mutable-without-lock table)
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "add", "discard", "setdefault", "appendleft", "extendleft",
+    "popleft", "move_to_end",
+}
+
+
+def _resolved(module, dotted: str) -> str:
+    """Absolute dotted form via the module's import table (``Lock`` imported
+    from threading resolves to ``threading.Lock``)."""
+    if not dotted:
+        return ""
+    head, _, rest = dotted.partition(".")
+    target = module.imports.get(head)
+    if target is None:
+        return dotted
+    return f"{target}.{rest}" if rest else target
+
+
+def _lock_attrs(ci) -> set[str]:
+    out: set[str] = set()
+    for method in ci.methods.values():
+        for node in ast.walk(method.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                ctor = _resolved(ci.module, dotted_name(node.value.func))
+                if ctor not in _LOCK_CTORS:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            dotted_name(t) == f"self.{t.attr}":
+                        out.add(t.attr)
+    return out
+
+
+def _under_lock(node: ast.AST, parents, locks: set[str]) -> bool:
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                target = expr.func if isinstance(expr, ast.Call) else expr
+                name = dotted_name(target)
+                if name.startswith("self.") and name[5:] in locks:
+                    return True
+    return False
+
+
+def _self_field(expr: ast.AST) -> str | None:
+    """``self.<field>`` -> field name (one level only)."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _field_accesses(fn_node: FuncDef):
+    """Yield ``(field, node, kind)`` for every ``self.<field>`` touch in the
+    body; kind is "read", "write" (atomic rebind) or "rmw" (non-atomic).
+    The ``self.f`` receiver inside ``self.f.append(...)`` / ``self.f += 1``
+    / ``self.f[k] = v`` is reported once, under the stronger kind."""
+    claimed: set[int] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.AugAssign):
+            target = node.target
+            field = _self_field(target)
+            if field is None and isinstance(target, ast.Subscript):
+                field = _self_field(target.value)
+                if field is not None:
+                    claimed.add(id(target.value))
+            if field is not None:
+                claimed.add(id(target))
+                yield field, node, "rmw"
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            field = _self_field(node.func.value)
+            if field is not None and node.func.attr in _MUTATORS:
+                claimed.add(id(node.func.value))
+                yield field, node, "rmw"
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                field = _self_field(t)
+                if field is not None:
+                    claimed.add(id(t))
+                    yield field, node, "write"
+                elif isinstance(t, ast.Subscript):
+                    field = _self_field(t.value)
+                    if field is not None:  # self.f[k] = v mutates f in place
+                        claimed.add(id(t.value))
+                        yield field, node, "rmw"
+    for node in ast.walk(fn_node):
+        if id(node) in claimed:
+            continue
+        field = _self_field(node)
+        if field is not None and isinstance(node.ctx, ast.Load):
+            yield field, node, "read"
+
+
+def _thread_root_map(project) -> dict[str, str]:
+    """qualname -> the thread entry it is reachable from (first found)."""
+    out: dict[str, str] = {}
+    roots = sorted(q for q in project.thread_roots if q in project.functions)
+    for cls in project.classes.values():
+        if any(b in _THREAD_BASES or
+               _resolved(cls.module, b) in _THREAD_BASES
+               for b in cls.base_names):
+            run = cls.methods.get("run")
+            if run is not None:
+                roots.append(run.qualname)
+    for root in roots:
+        stack = [root]
+        while stack:
+            q = stack.pop()
+            if q in out:
+                continue
+            out[q] = root
+            stack.extend(c.callee for c in project.sync_callees(q))
+    return out
+
+
+def _loop_reachable(project) -> set[str]:
+    """Functions provably reachable from event-loop code: every async
+    function plus its sync-call closure (deferred edges not crossed)."""
+    seen: set[str] = set()
+    stack = [fn.qualname for fn in project.async_functions()]
+    while stack:
+        q = stack.pop()
+        if q in seen:
+            continue
+        seen.add(q)
+        stack.extend(c.callee for c in project.sync_callees(q))
+    return seen
+
+
+@register_project(
+    "lock-discipline",
+    "concurrency",
+    "field of a multi-threaded class accessed outside its lock (or raced lock-free)",
+)
+def lock_discipline(project):
+    thread_of = _thread_root_map(project)
+    loop_reach = _loop_reachable(project)
+    for ci in sorted(project.classes.values(), key=lambda c: c.qualname):
+        locks = _lock_attrs(ci)
+        if locks:
+            yield from _check_locked_class(ci, locks)
+        else:
+            yield from _check_lockfree_class(project, ci, thread_of,
+                                             loop_reach)
+
+
+def _check_locked_class(ci, locks: set[str]):
+    # infer the guarded set: fields MUTATED under the lock anywhere
+    guarded: set[str] = set()
+    per_method: dict[str, list] = {}
+    for mname, method in ci.methods.items():
+        parents = parent_map(method.node)
+        rows = [
+            (field, node, kind, _under_lock(node, parents, locks))
+            for field, node, kind in _field_accesses(method.node)
+        ]
+        per_method[mname] = rows
+        for field, node, kind, locked in rows:
+            if locked and kind in ("write", "rmw"):
+                guarded.add(field)
+    guarded -= locks
+    for mname, rows in per_method.items():
+        if mname in ("__init__", "__del__"):
+            continue
+        for field, node, kind, locked in rows:
+            if locked or field in locks:
+                continue
+            if field in guarded:
+                yield (
+                    ci.module.path, node.lineno, node.col_offset,
+                    f"`{ci.name}.{field}` is guarded by "
+                    f"`self.{sorted(locks)[0]}` elsewhere in the class but "
+                    f"{'mutated' if kind != 'read' else 'read'} here outside "
+                    "the lock — take the lock or document the happens-before",
+                )
+            elif kind == "rmw":
+                yield (
+                    ci.module.path, node.lineno, node.col_offset,
+                    f"non-atomic mutation of `{ci.name}.{field}` outside the "
+                    f"lock in a lock-holding (multi-threaded) class — a "
+                    "concurrent call loses updates; take "
+                    f"`self.{sorted(locks)[0]}`",
+                )
+
+
+def _check_lockfree_class(project, ci, thread_of: dict[str, str],
+                          loop_reach: set[str]):
+    thread_side = {
+        m for m in ci.methods.values() if m.qualname in thread_of
+    }
+    if not thread_side:
+        return
+    # loop-side must be PROVEN: reachable from an async function through
+    # sync edges.  "not thread-reachable" alone is not evidence — an
+    # unresolved caller would mis-classify a worker-thread helper as loop
+    # code and flag phantom races.
+    loop_side = [
+        m for m in ci.methods.values()
+        if m not in thread_side and m.qualname in loop_reach
+        and m.name not in ("__init__", "__del__")
+    ]
+    #: field -> [(method, node, kind)]
+    t_acc: dict[str, list] = {}
+    l_acc: dict[str, list] = {}
+    for methods, acc in ((thread_side, t_acc), (loop_side, l_acc)):
+        for m in methods:
+            if m.name in ("__init__", "__del__"):
+                continue
+            for field, node, kind in _field_accesses(m.node):
+                acc.setdefault(field, []).append((m, node, kind))
+    for field in sorted(t_acc.keys() & l_acc.keys()):
+        t_writes = [r for r in t_acc[field] if r[2] in ("write", "rmw")]
+        l_writes = [r for r in l_acc[field] if r[2] in ("write", "rmw")]
+        if not t_writes or not l_writes:
+            continue  # read-vs-write tearing is below this rule's bar
+        rmw = [r for r in t_writes if r[2] == "rmw"] or \
+              [r for r in l_writes if r[2] == "rmw"]
+        if not rmw:
+            continue  # both sides atomic rebinds: last-writer-wins, no RMW
+        m, node, _kind = rmw[0]
+        on_thread = m in thread_side
+        entry = thread_of.get(t_writes[0][0].qualname, "?")
+        other = (l_writes if on_thread else t_writes)[0][0]
+        yield (
+            ci.module.path, node.lineno, node.col_offset,
+            f"`{ci.name}.{field}` is written from a worker thread "
+            f"(`{t_writes[0][0].display}`, entered via thread target "
+            f"`{entry.rsplit('.', 2)[-2]}.{entry.rsplit('.', 1)[-1]}`) AND "
+            f"from loop/main-thread code (`{other.display}`) with no lock, "
+            "non-atomically — guard both sides, or make one the single "
+            "writer",
+        )

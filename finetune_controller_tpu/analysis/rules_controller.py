@@ -179,6 +179,28 @@ _BLOCKING_SUBPROCESS = {"run", "check_output", "check_call", "call", "Popen"}
 _PATHLIB_IO = {"read_text", "write_text", "read_bytes", "write_bytes"}
 
 
+def blocking_call_message(node: ast.Call) -> str | None:
+    """Why this call blocks the event loop, or None — the one matcher shared
+    by the per-file rule and the transitive project rule (rules_flow)."""
+    name = dotted_name(node.func)
+    msg = _BLOCKING_EXACT.get(name)
+    if msg is None and name.startswith(_BLOCKING_PREFIXES):
+        msg = f"{name} is a blocking HTTP call on the event loop"
+    if msg is None and name.startswith("subprocess.") and (
+        name.split(".")[-1] in _BLOCKING_SUBPROCESS
+    ):
+        msg = f"{name} blocks the loop — use asyncio.create_subprocess_exec"
+    if msg is None and (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _PATHLIB_IO
+    ):
+        msg = (
+            f".{node.func.attr}() is a blocking whole-file "
+            "read/write — await asyncio.to_thread(...) it"
+        )
+    return msg
+
+
 @register(
     "blocking-io-in-async",
     "controller",
@@ -200,22 +222,7 @@ def blocking_io_in_async(module: ast.Module, src: str, path: str):
         for node in ast.walk(fn):
             if node in skip or not isinstance(node, ast.Call):
                 continue
-            name = dotted_name(node.func)
-            msg = _BLOCKING_EXACT.get(name)
-            if msg is None and name.startswith(_BLOCKING_PREFIXES):
-                msg = f"{name} is a blocking HTTP call on the event loop"
-            if msg is None and name.startswith("subprocess.") and (
-                name.split(".")[-1] in _BLOCKING_SUBPROCESS
-            ):
-                msg = f"{name} blocks the loop — use asyncio.create_subprocess_exec"
-            if msg is None and (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr in _PATHLIB_IO
-            ):
-                msg = (
-                    f".{node.func.attr}() is a blocking whole-file "
-                    "read/write — await asyncio.to_thread(...) it"
-                )
+            msg = blocking_call_message(node)
             if msg:
                 yield (
                     node.lineno, node.col_offset,

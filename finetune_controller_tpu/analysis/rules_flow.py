@@ -1,0 +1,175 @@
+"""Interprocedural flow rules: the PR 2 rules, taken across call boundaries.
+
+``blocking-io-in-async`` and ``host-sync-in-jit`` see one function at a
+time, so the classic evasion is a helper: the async handler calls
+``_stage()``, ``_stage()`` calls ``open()``, and the per-file rule sees two
+innocent functions.  These rules walk the project call graph
+(``analysis/project.py``) from every async function / jitted function
+through *sync* edges only, and flag the FIRST hop out of the root when any
+function in its closure contains a blocking / host-sync leaf — with the
+full call chain rendered in the message so the reader doesn't have to
+rediscover the path.
+
+Traversal rules (all deliberately conservative — every rendered chain is a
+real sequence of resolvable calls):
+
+* only ``context="sync"`` edges are followed — a callee handed to
+  ``asyncio.to_thread`` / ``run_in_executor`` / ``threading.Thread`` runs
+  off the loop and is exactly the sanctioned fix;
+* an async callee is not traversed (its body is its own root — it gets its
+  own analysis, so one hazard yields one finding, not one per caller);
+* a jitted callee of a jitted root is likewise skipped;
+* depth starts at 1: the direct-call case inside the root body stays the
+  per-file rule's finding.
+
+Suppressions anchor at the first-hop call site inside the root — the line
+a reader of the async handler actually sees.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterable
+
+from ._astutil import dotted_name
+from .engine import register_project
+from .rules_controller import blocking_call_message
+
+#: traversal ceiling — chains longer than this are beyond human review and
+#: almost certainly a resolution accident, not a real finding
+_MAX_DEPTH = 12
+
+#: unambiguous host-sync leaves for the transitive jit rule: each of these
+#: forces a device sync (or is trace-time-wrong) in ANY traced context, so
+#: no parameter-flow reasoning is needed to flag them in a helper
+_SYNC_ATTRS = {"item", "tolist"}
+_SYNC_NAMES = {"jax.device_get", "device_get"}
+
+
+def _host_sync_message(node: ast.Call) -> str | None:
+    name = dotted_name(node.func)
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTRS:
+        return f".{node.func.attr}() forces a device->host transfer"
+    if name in _SYNC_NAMES:
+        return "jax.device_get blocks on the device inside the traced body"
+    if name == "print":
+        return ("print() in a traced body runs at TRACE time only (or "
+                "syncs, if the value escapes) — use jax.debug.print")
+    return None
+
+
+def _own_calls(fn_node) -> Iterable[ast.Call]:
+    """Calls in a function body, nested def/lambda/class scopes excluded
+    (same deferral-boundary contract as the per-file rules)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _first_leaf(fn_info, matcher: Callable) -> tuple[ast.Call, str] | None:
+    for call in _own_calls(fn_info.node):
+        msg = matcher(call)
+        if msg is not None:
+            return call, msg
+    return None
+
+
+def _chains_from(project, root, matcher, *, skip: Callable):
+    """For each sync call site in ``root``, BFS its closure; yield
+    ``(site, chain, leaf_fn, leaf_call, msg)`` for the shortest path to a
+    function containing a leaf.  One finding per first-hop site."""
+    for site in project.sync_callees(root.qualname):
+        first = project.function(site.callee)
+        if first is None or skip(first):
+            continue
+        parent: dict[str, str | None] = {first.qualname: None}
+        queue = [first.qualname]
+        depth = {first.qualname: 1}
+        found = None
+        while queue and found is None:
+            q = queue.pop(0)
+            fn = project.function(q)
+            if fn is None:
+                continue
+            leaf = _first_leaf(fn, matcher)
+            if leaf is not None:
+                found = (q, leaf)
+                break
+            if depth[q] >= _MAX_DEPTH:
+                continue
+            for nxt in project.sync_callees(q):
+                callee = project.function(nxt.callee)
+                if callee is None or skip(callee) or nxt.callee in parent:
+                    continue
+                parent[nxt.callee] = q
+                depth[nxt.callee] = depth[q] + 1
+                queue.append(nxt.callee)
+        if found is None:
+            continue
+        leaf_q, (leaf_call, msg) = found
+        chain = []
+        cur: str | None = leaf_q
+        while cur is not None:
+            chain.append(project.function(cur))
+            cur = parent[cur]
+        chain.reverse()
+        yield site, chain, project.function(leaf_q), leaf_call, msg
+
+
+def _render_chain(root, chain, leaf_fn, leaf_call) -> str:
+    hops = " -> ".join(f"`{fn.display}`" for fn in chain)
+    return (f"`{root.display}` -> {hops} "
+            f"(leaf at {leaf_fn.path}:{leaf_call.lineno})")
+
+
+@register_project(
+    "blocking-io-in-async-transitive",
+    "flow",
+    "async def reaches a blocking call (open/sleep/requests/...) through sync helpers",
+)
+def blocking_io_in_async_transitive(project):
+    for root in project.async_functions():
+        def skip(fn):
+            # async callees are their own roots; a helper that is ALSO a
+            # known thread entry still blocks when called synchronously,
+            # so thread roots are NOT skipped
+            return fn.is_async
+        for site, chain, leaf_fn, leaf_call, msg in _chains_from(
+            project, root, blocking_call_message, skip=skip
+        ):
+            yield (
+                root.path, site.line, site.col,
+                f"async `{root.display}` reaches blocking I/O through "
+                f"{_render_chain(root, chain, leaf_fn, leaf_call)}: {msg}",
+            )
+
+
+@register_project(
+    "host-sync-in-jit-transitive",
+    "flow",
+    "jitted function reaches a host sync (.item()/device_get/print) through helpers",
+)
+def host_sync_in_jit_transitive(project):
+    for root_q, how in project.jitted.items():
+        root = project.function(root_q)
+        if root is None:
+            continue
+
+        def skip(fn):
+            # a jitted callee is its own root — one hazard, one finding
+            return fn.qualname in project.jitted
+        for site, chain, leaf_fn, leaf_call, msg in _chains_from(
+            project, root, _host_sync_message, skip=skip
+        ):
+            yield (
+                root.path, site.line, site.col,
+                f"jitted `{root.display}` ({how}) reaches a host sync "
+                f"through {_render_chain(root, chain, leaf_fn, leaf_call)}: "
+                f"{msg}",
+            )

@@ -1,0 +1,466 @@
+"""Protocol-conformance rules: wire tables that nothing verified before.
+
+The repo grew two hand-rolled RPC protocols — the serve transport
+(``transport/worker.py`` dispatches ``_op_<name>`` methods; the fleet-side
+``transport/client.py`` sends ``conn.call("<name>", payload)``) and the
+shared state service (``controller/statestore_service.py`` registers
+``@_rpc("<name>")`` handlers; ``RemoteStateStore._call("<name>",
+**payload)`` is the client).  PR 12 shipped them with nothing but tests
+pinning a few ops; a renamed handler or a dropped payload key compiles
+fine and fails at runtime, on a worker, mid-request.
+
+``rpc-conformance`` statically extracts BOTH halves of each protocol and
+fails the lint on:
+
+* **client-without-handler** — an op sent on the wire that no handler
+  dispatches (the rename/delete case; proven by mutation tests:
+  ``tests/test_project_analysis.py`` deletes a worker handler and watches
+  this rule turn red);
+* **handler-without-client** — a dead op nothing ever sends (this rule
+  found and deleted two on landing: ``drop_namespace`` and ``shutdown``);
+* **payload-key mismatch** — a key a handler requires (``payload["k"]``)
+  that some client call site provably never sends, or a key a client sends
+  that the handler never reads.  A payload passed wholesale to another
+  function (``entry_from_wire(payload)``) makes that handler *opaque* and
+  exempts it from key checks, as does a client literal with ``**spread``.
+
+``metric-doc-drift`` is the same conformance idea for observability:
+every ``ftc_*`` Prometheus family emitted in code must appear in
+``docs/observability.md``'s "Metric catalog" section, and every catalogued
+name must still be emitted — the catalog can neither rot nor lie.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Iterator
+
+from ._astutil import dotted_name, terminal_name
+from .engine import register_project
+
+# ---------------------------------------------------------------------------
+# shared payload-shape extraction
+# ---------------------------------------------------------------------------
+
+
+def _payload_reads(fn_node, param: str) -> tuple[set[str], set[str], bool]:
+    """(required, optional, opaque) keys a handler reads from ``param``.
+
+    ``param["k"]`` is required, ``param.get("k")`` optional; passing the
+    whole ``param`` anywhere else (bare argument, ``**param``, iteration)
+    makes the handler opaque — key checks are skipped for it."""
+    required: set[str] = set()
+    optional: set[str] = set()
+    opaque = False
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name) \
+                and node.value.id == param:
+            if isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                required.add(node.slice.value)
+            else:
+                opaque = True
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "get" and \
+                    isinstance(func.value, ast.Name) and func.value.id == param:
+                if node.args and isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    optional.add(node.args[0].value)
+                else:
+                    opaque = True
+            else:
+                # the payload handed WHOLE to another callable (positional
+                # or **spread): its reads are out of this rule's sight
+                if any(isinstance(a, ast.Name) and a.id == param
+                       for a in node.args):
+                    opaque = True
+                if any(kw.arg is None and isinstance(kw.value, ast.Name)
+                       and kw.value.id == param for kw in node.keywords):
+                    opaque = True
+    return required, optional, opaque
+
+
+def _dict_literal_keys(node: ast.Dict) -> tuple[set[str], bool]:
+    keys: set[str] = set()
+    opaque = False
+    for k in node.keys:
+        if k is None:  # **spread
+            opaque = True
+        elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+        else:
+            opaque = True
+    return keys, opaque
+
+
+def _client_payload_keys(fn_node, expr: ast.AST) -> tuple[set[str], bool]:
+    """Keys a client call site sends: a dict literal's constant keys, or —
+    when the payload is a variable — the keys of its dict-literal binding
+    plus every ``var["k"] = ...`` store in the enclosing function."""
+    if isinstance(expr, ast.Dict):
+        return _dict_literal_keys(expr)
+    if isinstance(expr, ast.Name):
+        keys: set[str] = set()
+        opaque = False
+        bound = False
+        for node in ast.walk(fn_node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if any(isinstance(t, ast.Name) and t.id == expr.id
+                       for t in targets):
+                    if isinstance(node.value, ast.Dict):
+                        bound = True
+                        k, o = _dict_literal_keys(node.value)
+                        keys |= k
+                        opaque = opaque or o
+                    else:
+                        opaque = True
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == expr.id and \
+                    isinstance(node.ctx, ast.Store):
+                if isinstance(node.slice, ast.Constant) and \
+                        isinstance(node.slice.value, str):
+                    keys.add(node.slice.value)
+                else:
+                    opaque = True
+        return keys, opaque or not bound
+    return set(), True
+
+
+# ---------------------------------------------------------------------------
+# family 1: the serve transport worker protocol
+# ---------------------------------------------------------------------------
+
+
+def _worker_op_tables(project) -> Iterator[tuple[Any, dict[str, Any]]]:
+    """Classes dispatching ``_op_<name>`` methods via a ``_dispatch`` that
+    builds the attribute name from the op string."""
+    for ci in project.classes.values():
+        dispatch = ci.methods.get("_dispatch")
+        if dispatch is None:
+            continue
+        if not any(isinstance(n, ast.Constant) and isinstance(n.value, str)
+                   and "_op_" in n.value
+                   for n in ast.walk(dispatch.node)):
+            continue
+        handlers = {
+            m.name[len("_op_"):]: m
+            for m in ci.methods.values() if m.name.startswith("_op_")
+        }
+        if handlers:
+            yield ci, handlers
+
+
+def _conn_call_sites(project) -> Iterator[tuple[Any, ast.Call, str, ast.AST]]:
+    """``<...conn>.call("op", payload)`` sites anywhere in the project —
+    the transport client convention (``self._conn.call`` / ``conn.call``)."""
+    for fn in project.functions.values():
+        for node in ast.walk(fn.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "call"):
+                continue
+            recv = terminal_name(node.func.value)
+            if "conn" not in recv:
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            payload = node.args[1] if len(node.args) > 1 else None
+            yield fn, node, node.args[0].value, payload
+
+
+def _check_worker_protocol(project):
+    tables = list(_worker_op_tables(project))
+    if not tables:
+        return
+    handlers: dict[str, Any] = {}
+    for _ci, table in tables:
+        handlers.update(table)
+    called: set[str] = set()
+    for fn, call, op, payload_expr in _conn_call_sites(project):
+        called.add(op)
+        handler = handlers.get(op)
+        if handler is None:
+            yield (
+                fn.path, call.lineno, call.col_offset,
+                f"client sends transport op {op!r} but no worker handler "
+                f"`_op_{op}` exists — the RPC fails at dispatch "
+                f"(known ops: {', '.join(sorted(handlers))})",
+            )
+            continue
+        hparam = _handler_payload_param(handler.node)
+        if hparam is None:
+            continue
+        required, optional, opaque = _payload_reads(handler.node, hparam)
+        if opaque:
+            continue
+        sent, client_opaque = (
+            _client_payload_keys(fn.node, payload_expr)
+            if payload_expr is not None else (set(), False)
+        )
+        if client_opaque:
+            continue
+        for key in sorted(required - sent):
+            yield (
+                fn.path, call.lineno, call.col_offset,
+                f"transport op {op!r}: handler `_op_{op}` requires payload "
+                f"key {key!r} (subscript read) but this call site never "
+                "sends it",
+            )
+        for key in sorted(sent - required - optional):
+            yield (
+                fn.path, call.lineno, call.col_offset,
+                f"transport op {op!r}: payload key {key!r} is sent but "
+                f"`_op_{op}` never reads it — dead weight or a renamed "
+                "field",
+            )
+    for op, handler in sorted(handlers.items()):
+        if op not in called:
+            yield (
+                handler.path, handler.node.lineno, handler.node.col_offset,
+                f"worker handler `_op_{op}` has no client call site "
+                "anywhere in the project — dead op (delete it, or wire the "
+                "client that should be using it)",
+            )
+
+
+def _handler_payload_param(fn_node) -> str | None:
+    args = [a.arg for a in fn_node.args.args if a.arg != "self"]
+    return args[0] if args else None
+
+
+# ---------------------------------------------------------------------------
+# family 2: the shared state service (@_rpc handlers vs RemoteStateStore)
+# ---------------------------------------------------------------------------
+
+
+def _rpc_handler_tables(project) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for fn in project.functions.values():
+        for dec in getattr(fn.node, "decorator_list", ()):
+            if isinstance(dec, ast.Call) and \
+                    terminal_name(dec.func) == "_rpc" and dec.args and \
+                    isinstance(dec.args[0], ast.Constant) and \
+                    isinstance(dec.args[0].value, str):
+                out[dec.args[0].value] = fn
+    return out
+
+
+def _is_state_rpc_call(call_method) -> bool:
+    """Is this ``_call`` the state-service client (posts to ``/rpc/<m>``)?
+    Object stores (gcs/s3) have their own HTTP ``_call`` helpers whose
+    first argument is an HTTP verb, not an op name — the route marker
+    disambiguates."""
+    return any(
+        isinstance(n, ast.Constant) and isinstance(n.value, str)
+        and "/rpc/" in n.value
+        for n in ast.walk(call_method.node)
+    )
+
+
+def _rpc_client_sites(project):
+    """``self._call("name", **payload)`` sites on classes whose ``_call``
+    posts to the state service's ``/rpc/{method}`` route; the ``_call``
+    signature's own named params (e.g. ``retry_reads``) are client-side
+    knobs, not payload keys."""
+    for fn in project.functions.values():
+        if fn.cls is None or "_call" not in fn.cls.methods:
+            continue
+        if not _is_state_rpc_call(fn.cls.methods["_call"]):
+            continue
+        own_params = {
+            a.arg
+            for a in fn.cls.methods["_call"].node.args.args
+            if a.arg != "self"
+        }
+        for node in ast.walk(fn.node):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func) == "self._call"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            keys: set[str] = set()
+            opaque = False
+            for kw in node.keywords:
+                if kw.arg is None:
+                    opaque = True
+                elif kw.arg not in own_params:
+                    keys.add(kw.arg)
+            yield fn, node, node.args[0].value, keys, opaque
+
+
+def _check_statestore_protocol(project):
+    handlers = _rpc_handler_tables(project)
+    if not handlers:
+        return
+    called: set[str] = set()
+    for fn, call, method, sent, opaque in _rpc_client_sites(project):
+        called.add(method)
+        handler = handlers.get(method)
+        if handler is None:
+            yield (
+                fn.path, call.lineno, call.col_offset,
+                f"client calls state rpc {method!r} but no @_rpc handler "
+                "registers it — the service answers 404",
+            )
+            continue
+        hparam = _payload_param_of_rpc(handler.node)
+        if hparam is None or opaque:
+            continue
+        required, optional, h_opaque = _payload_reads(handler.node, hparam)
+        if h_opaque:
+            continue
+        for key in sorted(required - sent):
+            yield (
+                fn.path, call.lineno, call.col_offset,
+                f"state rpc {method!r}: handler requires payload key "
+                f"{key!r} but this call site never sends it",
+            )
+        for key in sorted(sent - required - optional):
+            yield (
+                fn.path, call.lineno, call.col_offset,
+                f"state rpc {method!r}: payload key {key!r} is sent but the "
+                "handler never reads it",
+            )
+    for method, handler in sorted(handlers.items()):
+        if method not in called:
+            yield (
+                handler.path, handler.node.lineno, handler.node.col_offset,
+                f"state rpc handler {method!r} has no RemoteStateStore call "
+                "site — dead op",
+            )
+
+
+def _payload_param_of_rpc(fn_node) -> str | None:
+    #: ``async def _handler(store, p)`` — payload is the SECOND param
+    args = [a.arg for a in fn_node.args.args]
+    return args[1] if len(args) >= 2 else None
+
+
+@register_project(
+    "rpc-conformance",
+    "protocol",
+    "RPC client op tables, handler tables, and payload keys must agree",
+)
+def rpc_conformance(project):
+    yield from _check_worker_protocol(project)
+    yield from _check_statestore_protocol(project)
+
+
+# ---------------------------------------------------------------------------
+# metric-name conformance
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME = re.compile(r"^ftc_[a-z0-9_]+$")
+_METRIC_IN_TEXT = re.compile(
+    r"(?:#\s*TYPE\s+|^|[\s])(ftc_[a-z0-9_]+)(?=[\s{]|$)"
+)
+_CATALOG_HEADING = re.compile(r"^#+\s.*metric catalog", re.IGNORECASE)
+
+
+def _emitted_metrics(project) -> dict[str, tuple[str, int]]:
+    """``ftc_*`` Prometheus family names emitted anywhere in the package,
+    with the first emission site.  Extraction is structural, so non-metric
+    ``ftc_``-prefixed strings (cookie names, attribute tags) don't count:
+
+    * string constants shaped like exposition text (``# TYPE <name> ...``,
+      ``<name>{...`` / ``<name> <value>`` at the start of the constant —
+      f-string fragments included);
+    * the first element of a string tuple (the gauge/counter tables the
+      ``/metrics`` handlers iterate);
+    * the first argument of a ``Histogram(...)`` construction.
+    """
+    out: dict[str, tuple[str, int]] = {}
+
+    def add(name: str, path: str, line: int) -> None:
+        out.setdefault(name, (path, line))
+
+    for module in project.modules.values():
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                text = node.value
+                if "ftc_" not in text:
+                    continue
+                for m in _METRIC_IN_TEXT.finditer(text):
+                    # whole-string identifiers (getattr names, cookie
+                    # names) don't look like exposition text: require a
+                    # TYPE prefix or a trailing label-brace/value
+                    start, end = m.start(1), m.end(1)
+                    shaped = (
+                        "# TYPE" in text[:start]
+                        or end < len(text) and text[end] in " {"
+                    )
+                    if shaped:
+                        add(m.group(1), module.path,
+                            getattr(node, "lineno", 1))
+            elif isinstance(node, ast.Tuple) and node.elts:
+                first = node.elts[0]
+                if isinstance(first, ast.Constant) and \
+                        isinstance(first.value, str) and \
+                        _METRIC_NAME.match(first.value) and \
+                        len(node.elts) > 1:
+                    add(first.value, module.path, first.lineno)
+            elif isinstance(node, ast.Call) and \
+                    terminal_name(node.func) == "Histogram" and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and \
+                        isinstance(first.value, str) and \
+                        _METRIC_NAME.match(first.value):
+                    add(first.value, module.path, first.lineno)
+    return out
+
+
+def _catalog_metrics(docs_path) -> dict[str, int]:
+    """Names listed in the "Metric catalog" section of observability.md."""
+    out: dict[str, int] = {}
+    in_section = False
+    section_level = 0
+    for i, line in enumerate(
+        docs_path.read_text(encoding="utf-8").splitlines(), start=1
+    ):
+        if line.startswith("#"):
+            level = len(line) - len(line.lstrip("#"))
+            if _CATALOG_HEADING.match(line):
+                in_section = True
+                section_level = level
+                continue
+            if in_section and level <= section_level:
+                in_section = False
+        if in_section:
+            for name in re.findall(r"ftc_[a-z0-9_]+", line):
+                out.setdefault(name, i)
+    return out
+
+
+@register_project(
+    "metric-doc-drift",
+    "protocol",
+    "every emitted ftc_* metric is catalogued in docs/observability.md, and vice versa",
+)
+def metric_doc_drift(project):
+    docs = project.docs_file("observability.md")
+    if docs is None:
+        return  # fixture trees without docs opt out by construction
+    emitted = _emitted_metrics(project)
+    catalogued = _catalog_metrics(docs)
+    if not catalogued:
+        return  # no catalog section yet: nothing to conform to
+    for name in sorted(emitted.keys() - catalogued.keys()):
+        path, line = emitted[name]
+        yield (
+            path, line, 0,
+            f"metric `{name}` is emitted here but missing from "
+            f"{docs}'s Metric catalog — document it",
+        )
+    for name in sorted(catalogued.keys() - emitted.keys()):
+        yield (
+            str(docs), catalogued[name], 0,
+            f"metric `{name}` is catalogued but no code emits it — stale "
+            "docs or a renamed family",
+        )

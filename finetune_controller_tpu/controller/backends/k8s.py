@@ -742,14 +742,24 @@ class AiohttpKubeClient(KubeClient):
         self._token_read_at = 0.0
         self._session = None
 
-    def _headers(self) -> dict[str, str]:
+    async def _headers(self) -> dict[str, str]:
         now = time.monotonic()
         if self._static_token is None and (
             self._token is None or now - self._token_read_at > self.TOKEN_TTL_S
         ):
             token_file = self.SA_DIR / "token"
-            if token_file.exists():
-                self._token = token_file.read_text().strip()
+
+            def read_token() -> str | None:
+                # projected SA token, rotated on disk by the kubelet: a
+                # small file, but kubelet IO stalls have been observed in
+                # the seconds range — never pay them on the event loop
+                if token_file.exists():
+                    return token_file.read_text().strip()
+                return None
+
+            token = await asyncio.to_thread(read_token)
+            if token is not None:
+                self._token = token
                 self._token_read_at = now
         return {"Authorization": f"Bearer {self._token}"} if self._token else {}
 
@@ -801,7 +811,7 @@ class AiohttpKubeClient(KubeClient):
             try:
                 async with s.request(
                     method, url, params=params, json=json_body,
-                    headers=self._headers(),
+                    headers=await self._headers(),
                 ) as resp:
                     retriable = resp.status in self.RETRY_STATUSES or (
                         resp.status == 401 and self._static_token is None
@@ -884,7 +894,7 @@ class AiohttpKubeClient(KubeClient):
         url = f"{self.base_url}/api/v1/namespaces/{namespace}/pods/{pod}/log"
 
         async def aiter() -> AsyncIterator[str]:
-            async with s.get(url, params=params, timeout=None, headers=self._headers()) as resp:
+            async with s.get(url, params=params, timeout=None, headers=await self._headers()) as resp:
                 if resp.status >= 300:
                     raise BackendError(f"pod logs failed ({resp.status})")
                 async for raw in resp.content:

@@ -254,6 +254,7 @@ class LocalProcessBackend(TrainingBackend):
             self.scheduler.release(job.job_id)
             self._handles.pop(job.job_id, None)
             raise BackendError(f"submit failed: {exc}") from exc
+        # ftc: ignore[blocking-io-in-async-transitive] -- elastic re-render writes one small local spec on the rare granted<requested admission; the sync scheduler_tick hook shares this path so it cannot await
         self._admit_pending()
 
     async def _stage_resume_state(self, handle: _JobHandle) -> None:
@@ -674,6 +675,7 @@ class LocalProcessBackend(TrainingBackend):
             handle.set_state(BackendJobState.FAILED, f"backend error: {exc}")
         finally:
             self.scheduler.release(handle.job_id)
+            # ftc: ignore[blocking-io-in-async-transitive] -- same rare small-spec re-render write as the submit path; shared with the sync scheduler_tick hook
             self._admit_pending()
             # replenish the warm pool AFTER the job: a replacement spawning
             # at claim time would contend (imports vs the job's first-step
@@ -904,6 +906,7 @@ class LocalProcessBackend(TrainingBackend):
                 with contextlib.suppress(Exception):
                     await proc.wait()
         release(job_id)
+        # ftc: ignore[blocking-io-in-async-transitive] -- same rare small-spec re-render write as the submit path; shared with the sync scheduler_tick hook
         self._admit_pending()
         return True
 

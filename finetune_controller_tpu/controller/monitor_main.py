@@ -47,6 +47,7 @@ async def _start_metrics_listener(runtime, port: int):
 
 
 async def amain() -> None:
+    # ftc: ignore[blocking-io-in-async-transitive] -- startup path: the device-catalog read runs once, before the loop serves anything
     runtime = build_runtime()
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -113,6 +113,7 @@ class Collection:
                         old = self._docs.get(rec[self._key])
                         if old is not None:
                             self._index_remove(old)
+                        # ftc: ignore[lock-discipline] -- every caller holds the collection's asyncio lock ACROSS its to_thread hop, so the loader thread and loop-side writers are serialized by it
                         self._docs[rec[self._key]] = rec
                         self._index_add(rec)
 

@@ -132,10 +132,12 @@ class SpanRecorder:
                     f.write(json.dumps(span) + "\n")
                     f.flush()
         except OSError:
-            self.write_failures += 1
-            level = logging.WARNING if self.write_failures == 1 else logging.DEBUG
+            with self._lock:  # finish() races itself across threads
+                self.write_failures += 1
+                failures = self.write_failures
+            level = logging.WARNING if failures == 1 else logging.DEBUG
             logger.log(level, "span write to %s failed (%d so far)",
-                       self.path, self.write_failures, exc_info=True)
+                       self.path, failures, exc_info=True)
 
     class _SpanCtx:
         def __init__(self, recorder: "SpanRecorder", span: dict):

@@ -315,6 +315,14 @@ class BatchEngine:
         #: per-tenant token counters ("" = base model)
         self.tokens_by_tenant: dict[str, int] = {}
         self._prefix_warned = False
+        # runtime transfer guard on the decode hot window
+        # (FTC_TRANSFER_GUARD=raise|warn; armed by BENCH_MODE=serve):
+        # every per-step host->device argument is device_put EXPLICITLY
+        # before the guarded dispatch, so a steady-state decode step that
+        # moves anything else across the boundary aborts loudly
+        from ..analysis.transfer_guard import TransferGuard
+
+        self._transfer_guard = TransferGuard.from_env(name="serve-decode")
 
     # ---- mode helpers -----------------------------------------------------
 
@@ -1012,13 +1020,24 @@ class BatchEngine:
                 positions[i, 0] = slot.next_pos
                 temps[i] = max(slot.req.temperature, 0.0)
                 top_ks[i] = slot.req.top_k
-        next_tokens, rng_keys, self._cache = self._decode(
+        # the tiny per-step host->device feeds (last tokens, positions,
+        # sampling params — slots×a-few int32/float32) are converted BEFORE
+        # the guarded window: they are the decode step's entire intended
+        # transfer budget, and anything else crossing the boundary inside
+        # the dispatch trips the transfer guard
+        args = (
             self.variables, self._tenants_arg(), self._cache,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(temps), jnp.asarray(top_ks),
             jnp.asarray(self._rng_keys),
             self._page_table_arg(), self._adapter_ids_arg(),
         )
+        if self._transfer_guard is not None:
+            next_tokens, rng_keys, self._cache = self._transfer_guard.run(
+                "decode", self._decode, *args
+            )
+        else:
+            next_tokens, rng_keys, self._cache = self._decode(*args)
         self.steps_total += 1
         next_tokens = np.asarray(next_tokens)
         # np.array (not asarray): admit() writes per-lane rows into this

@@ -120,6 +120,14 @@ class TrainConfig:
     recompile_budget: int = 0
     #: what to do past the budget: "warn" (log once) or "raise"
     recompile_action: str = "warn"
+    #: transfer guard (``analysis/transfer_guard.py``): wrap the jitted
+    #: step's dispatch window so any device<->host transfer inside it —
+    #: an implicit host->device copy of a stray numpy leaf, a leftover
+    #: ``jax.device_get`` — fails loudly instead of silently serializing
+    #: every step.  "raise" | "warn" | "off"; the empty default inherits
+    #: ``FTC_TRANSFER_GUARD`` from the env (off when unset).  bench.py
+    #: arms "raise" inside its timed windows.
+    transfer_guard: str = ""
     #: liveness heartbeat cadence (``resilience/heartbeat.py``): rank 0
     #: writes ``heartbeat.json`` (step + wall clock) into the artifacts dir
     #: at most every N seconds; the artifact sync ships it and the monitor's
@@ -409,6 +417,20 @@ class Trainer:
                 on_excess=self.cfg.recompile_action,
                 name="trainer-recompile-guard",
             )
+        self._transfer_guard = None
+        mode = (self.cfg.transfer_guard or "").strip().lower()
+        if mode in ("raise", "warn"):
+            from ..analysis.transfer_guard import TransferGuard
+
+            self._transfer_guard = TransferGuard(
+                mode, name="trainer-transfer-guard"
+            )
+        elif mode == "":
+            from ..analysis.transfer_guard import TransferGuard
+
+            self._transfer_guard = TransferGuard.from_env(
+                name="trainer-transfer-guard"
+            )
 
     def _batch_leaf_sharding(self, x: Any) -> NamedSharding:
         """Token-like (B, S) leaves shard batch+seq; higher-rank leaves (e.g.
@@ -439,6 +461,11 @@ class Trainer:
             )
             if self._recompile_guard is not None:
                 fn = self._recompile_guard.wrap(fn, label=f"step:{','.join(key)}")
+            if self._transfer_guard is not None:
+                # the guarded window is the DISPATCH only: _shard_batch has
+                # already device_put the batch (explicitly — allowed), so a
+                # steady-state step moves nothing across the boundary
+                fn = self._transfer_guard.wrap(fn, label=f"step:{','.join(key)}")
             self._step_jits[key] = fn
         return fn
 

@@ -31,10 +31,14 @@ by id over one connection):
 ``hello``, ``probe`` (health/decode-progress + full stats snapshot),
 ``generate`` (absolute-deadline + idempotent request id: duplicates attach
 in flight and replay from a bounded LRU after), ``drain`` (graceful: bounce
-queued, finish in-flight, then exit 0), ``shutdown``, ``tenant_busy``,
-``adapter_register`` / ``adapter_unregister`` / ``drop_namespace`` /
-``stack_sync`` (the registry-sync RPCs — flax-msgpack adapter deltas,
-megabytes, never base weights).
+queued, finish in-flight, then exit 0), ``tenant_busy``,
+``adapter_register`` / ``adapter_unregister`` / ``stack_sync`` (the
+registry-sync RPCs — flax-msgpack adapter deltas, megabytes, never base
+weights; a re-register with ``refresh`` drops the tenant's prefix
+namespace worker-side, so no separate drop op exists).  The op table is
+verified against the client's call sites by ftc-lint's ``rpc-conformance``
+rule — it deleted two dead ops (``shutdown``, ``drop_namespace``) on
+landing, and a handler/client rename turns the lint red (mutation-tested).
 
 Engine work (prefill/step/adapter installs) always runs in worker threads so
 the RPC loop stays responsive — probes answer mid-compile.
@@ -345,10 +349,6 @@ class WorkerServer:
         # window included)
         return {"clean": clean, "stats": self.batcher.stats()}
 
-    async def _op_shutdown(self, payload: dict) -> dict:
-        asyncio.get_running_loop().call_later(0.05, self.request_exit, 0)
-        return {"ok": True}
-
     async def _op_tenant_busy(self, payload: dict) -> dict:
         busy = await self.batcher.tenant_busy(
             str(payload.get("adapter_id") or "")
@@ -384,10 +384,6 @@ class WorkerServer:
             self.engine.remove_adapter, entry.adapter_id, entry.slot
         )
         return {"slot": entry.slot}
-
-    async def _op_drop_namespace(self, payload: dict) -> dict:
-        self.engine.drop_prefix_namespace(str(payload["adapter_id"]))
-        return {"ok": True}
 
     async def _op_stack_sync(self, payload: dict) -> dict:
         """Full registry sync (spawn/rollover): install every entry the
@@ -444,7 +440,9 @@ async def _amain(spec: WorkerSpec) -> int:
     server = build_worker(spec)
     port = await server.start()
     server.start_heartbeat()
-    _write_transport_file(spec, port)
+    # off the loop: the parent polls for this file, and a slow sandbox disk
+    # must not stall the very RPC loop the handshake is about to probe
+    await asyncio.to_thread(_write_transport_file, spec, port)
     logger.info("serve worker %s (job=%s) listening on %s:%d pid=%d",
                 spec.replica_id, spec.job_id, spec.host, port, os.getpid())
     return await server.serve_until_exit()

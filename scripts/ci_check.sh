@@ -7,7 +7,11 @@
 #
 # Lint: `ftc-lint finetune_controller_tpu/` must exit 0 — every finding is
 # fixed or carries a justified `# ftc: ignore[rule-id] -- reason`
-# (docs/static_analysis.md).
+# (docs/static_analysis.md).  The v2 run includes the project-wide pass
+# (call graph, lock discipline, RPC/metric conformance) under a 10s
+# wall-clock budget so the interprocedural engine can never rot into a
+# slow gate (budget also asserted, more precisely, in
+# tests/test_project_analysis.py).
 # Serve-fast: the continuous-batching inference suite (docs/serving.md) —
 # batching invariance is THE serving correctness anchor, and a broken
 # engine should fail in seconds, before the full tier-1 wall-clock.
@@ -20,12 +24,19 @@ set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== ftc-lint ==" >&2
+echo "== ftc-lint (per-file + project-wide, 10s budget) ==" >&2
+lint_start=$(date +%s)
 python -m finetune_controller_tpu.analysis finetune_controller_tpu/
 lint_rc=$?
+lint_elapsed=$(( $(date +%s) - lint_start ))
 if [ "$lint_rc" -ne 0 ]; then
     echo "ci_check: ftc-lint failed (exit $lint_rc)" >&2
     exit "$lint_rc"
+fi
+if [ "$lint_elapsed" -gt 10 ]; then
+    echo "ci_check: ftc-lint took ${lint_elapsed}s — over the 10s budget;" \
+         "the interprocedural pass must stay a fast gate" >&2
+    exit 1
 fi
 
 if [ "${1:-}" = "--lint-only" ]; then

@@ -1,0 +1,837 @@
+"""ftc-lint v2: project index, call graph, interprocedural rules.
+
+Four layers, mirroring ``tests/test_lint_rules.py``'s fixture discipline:
+
+* call-graph unit tests (import cycles, method resolution through
+  ``self.<attr>`` type inference, thread-entry classification, nested-def
+  boundaries);
+* per-rule TP / clean / suppression fixtures for the three new rule
+  families (transitive flow, lock discipline, protocol conformance);
+* MUTATION tests against the real package: delete a worker RPC handler or
+  rename a client op via ``source_overrides`` and the lint turns red —
+  while HEAD stays green (``tests/test_lint_clean.py``);
+* engine plumbing: SARIF output, the ``--rules``/``--exclude-rules``
+  selector aliases, and the CI wall-clock budget for the whole v2 pass.
+"""
+
+import json
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from finetune_controller_tpu.analysis.engine import (
+    all_project_rules,
+    all_rules,
+    lint_paths,
+    main,
+)
+from finetune_controller_tpu.analysis.project import build_project
+
+PKG = Path(__file__).resolve().parent.parent / "finetune_controller_tpu"
+
+
+def _write(tmp_path: Path, files: dict[str, str]) -> Path:
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def _project_lint(tmp_path, files, rules=None):
+    """Lint a fixture tree with ONLY project rules (optionally a subset)."""
+    root = _write(tmp_path, files)
+    prules = all_project_rules()
+    if rules is not None:
+        prules = {k: prules[k] for k in rules}
+    return lint_paths([str(root)], rules={}, project_rules=prules)
+
+
+# ---------------------------------------------------------------------------
+# call graph
+# ---------------------------------------------------------------------------
+
+
+def test_import_cycle_builds_and_resolves(tmp_path):
+    root = _write(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """
+            from .b import helper_b
+
+            def helper_a():
+                return helper_b()
+        """,
+        "pkg/b.py": """
+            def helper_b():
+                from .a import helper_a
+                return helper_a
+        """,
+    })
+    project = build_project([str(root)])
+    a = project.function("pkg.a.helper_a")
+    assert a is not None
+    assert [c.callee for c in a.calls] == ["pkg.b.helper_b"]
+
+
+def test_method_resolution_via_attr_type_hint(tmp_path):
+    root = _write(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/eng.py": """
+            class Engine:
+                def crunch(self):
+                    return 1
+        """,
+        "pkg/drv.py": """
+            from .eng import Engine
+
+            class Driver:
+                def __init__(self, engine: Engine):
+                    self.engine = engine
+
+                def drive(self):
+                    return self.engine.crunch()
+
+                def chain(self):
+                    return self.drive()
+        """,
+    })
+    project = build_project([str(root)])
+    drive = project.function("pkg.drv.Driver.drive")
+    assert [c.callee for c in drive.calls] == ["pkg.eng.Engine.crunch"]
+    chain = project.function("pkg.drv.Driver.chain")
+    assert [c.callee for c in chain.calls] == ["pkg.drv.Driver.drive"]
+
+
+def test_thread_entry_classification(tmp_path):
+    root = _write(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/t.py": """
+            import asyncio
+            import threading
+
+            class Worker:
+                def body(self):
+                    self.helper()
+
+                def helper(self):
+                    pass
+
+                async def kick(self):
+                    await asyncio.to_thread(self.body)
+
+            def plain():
+                pass
+
+            def spawn():
+                threading.Thread(target=plain).start()
+
+            async def via_executor(loop, fn):
+                await loop.run_in_executor(None, plain)
+        """,
+    })
+    project = build_project([str(root)])
+    assert "pkg.t.Worker.body" in project.thread_roots
+    assert "pkg.t.plain" in project.thread_roots
+    # reachability crosses sync self-calls from the entry
+    assert "pkg.t.Worker.helper" in project.thread_reachable()
+    # the deferred edge is NOT a sync edge of the async caller
+    kick = project.function("pkg.t.Worker.kick")
+    assert all(c.context == "deferred" for c in kick.calls)
+
+
+def test_nested_def_is_a_boundary(tmp_path):
+    root = _write(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/n.py": """
+            def leaf():
+                pass
+
+            def outer():
+                def inner():
+                    leaf()
+                return inner
+        """,
+    })
+    project = build_project([str(root)])
+    outer = project.function("pkg.n.outer")
+    assert [c.callee for c in outer.calls] == []
+
+
+def test_relative_import_resolution(tmp_path):
+    root = _write(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/sub/__init__.py": "",
+        "pkg/util.py": "def shared():\n    pass\n",
+        "pkg/sub/mod.py": """
+            from ..util import shared
+
+            def caller():
+                shared()
+        """,
+    })
+    project = build_project([str(root)])
+    caller = project.function("pkg.sub.mod.caller")
+    assert [c.callee for c in caller.calls] == ["pkg.util.shared"]
+
+
+# ---------------------------------------------------------------------------
+# blocking-io-in-async-transitive
+# ---------------------------------------------------------------------------
+
+#: the acceptance fixture: open() is TWO sync hops from the async def
+_TWO_HOP = {
+    "pkg/__init__.py": "",
+    "pkg/svc.py": """
+        async def handler(path):
+            return stage(path)
+
+        def stage(path):
+            return _read(path)
+
+        def _read(path):
+            with open(path) as f:
+                return f.read()
+    """,
+}
+
+
+def test_transitive_blocking_two_hops_flagged_with_chain(tmp_path):
+    result = _project_lint(tmp_path, _TWO_HOP,
+                           rules=["blocking-io-in-async-transitive"])
+    assert len(result.active) == 1
+    f = result.active[0]
+    assert f.rule == "blocking-io-in-async-transitive"
+    assert "`handler`" in f.message
+    assert "`stage` -> `_read`" in f.message      # the rendered call chain
+    assert "svc.py:" in f.message                 # ...and the leaf location
+
+
+def test_per_file_rule_demonstrably_misses_the_two_hop_case(tmp_path):
+    """PR 2's direct-call rule sees three innocent functions here — the
+    interprocedural pass is what closes the helper evasion."""
+    root = _write(tmp_path, _TWO_HOP)
+    result = lint_paths([str(root)], rules=all_rules(), project_rules={})
+    assert [f for f in result.active
+            if f.rule == "blocking-io-in-async"] == []
+
+
+def test_transitive_blocking_quiet_when_deferred_to_thread(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/svc.py": """
+            import asyncio
+
+            async def handler(path):
+                return await asyncio.to_thread(stage, path)
+
+            def stage(path):
+                with open(path) as f:
+                    return f.read()
+        """,
+    }, rules=["blocking-io-in-async-transitive"])
+    assert result.active == []
+
+
+def test_transitive_blocking_does_not_descend_into_async_callees(tmp_path):
+    """The async callee is its own root: one hazard, one finding."""
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/svc.py": """
+            async def outer(path):
+                await inner(path)
+
+            async def inner(path):
+                return stage(path)
+
+            def stage(path):
+                with open(path) as f:
+                    return f.read()
+        """,
+    }, rules=["blocking-io-in-async-transitive"])
+    assert len(result.active) == 1
+    assert "`inner`" in result.active[0].message  # flagged at inner, not outer
+
+
+def test_transitive_blocking_suppression_honored(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/svc.py": """
+            async def handler(path):
+                # ftc: ignore[blocking-io-in-async-transitive] -- startup-only path
+                return stage(path)
+
+            def stage(path):
+                with open(path) as f:
+                    return f.read()
+        """,
+    }, rules=["blocking-io-in-async-transitive"])
+    assert result.active == []
+    assert len(result.findings) == 1 and result.findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit-transitive
+# ---------------------------------------------------------------------------
+
+
+def test_transitive_host_sync_through_helper(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/step.py": """
+            import jax
+
+            @jax.jit
+            def train_step(state, batch):
+                return _metrics(state)
+
+            def _metrics(state):
+                return state.loss.item()
+        """,
+    }, rules=["host-sync-in-jit-transitive"])
+    assert len(result.active) == 1
+    f = result.active[0]
+    assert "`train_step`" in f.message and "`_metrics`" in f.message
+    assert ".item()" in f.message
+
+
+def test_transitive_host_sync_quiet_on_host_side_code(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/step.py": """
+            def host_loop(metrics):
+                return _log(metrics)
+
+            def _log(metrics):
+                print(metrics)
+        """,
+    }, rules=["host-sync-in-jit-transitive"])
+    assert result.active == []
+
+
+def test_transitive_host_sync_skips_jitted_callees(tmp_path):
+    """A jitted callee of a jitted root gets its OWN analysis."""
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/step.py": """
+            import jax
+
+            @jax.jit
+            def outer_step(state):
+                return inner_step(state)
+
+            @jax.jit
+            def inner_step(state):
+                return _bad(state)
+
+            def _bad(state):
+                return jax.device_get(state)
+        """,
+    }, rules=["host-sync-in-jit-transitive"])
+    assert len(result.active) == 1
+    assert "`inner_step`" in result.active[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_lock_discipline_guarded_field_outside_lock(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/c.py": """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+
+                def add(self, n):
+                    with self._lock:
+                        self.total += n
+
+                def peek(self):
+                    return self.total
+        """,
+    }, rules=["lock-discipline"])
+    assert len(result.active) == 1
+    assert "`Stats.total`" in result.active[0].message
+    assert "outside" in result.active[0].message
+
+
+def test_lock_discipline_unguarded_counter_in_locked_class(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/c.py": """
+            import threading
+
+            class Writer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.failures = 0
+
+                def write(self, item):
+                    with self._lock:
+                        emit(item)
+
+                def on_error(self):
+                    self.failures += 1
+        """,
+    }, rules=["lock-discipline"])
+    assert len(result.active) == 1
+    assert "non-atomic mutation" in result.active[0].message
+
+
+def test_lock_discipline_clean_when_disciplined(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/c.py": """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+
+                def add(self, n):
+                    with self._lock:
+                        self.total += n
+
+                def peek(self):
+                    with self._lock:
+                        return self.total
+        """,
+    }, rules=["lock-discipline"])
+    assert result.active == []
+
+
+def test_lock_discipline_asyncio_lock_is_not_a_thread_lock(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/c.py": """
+            import asyncio
+
+            class Store:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+                    self.n = 0
+
+                async def bump(self):
+                    async with self._lock:
+                        self.n += 1
+
+                def peek(self):
+                    return self.n
+        """,
+    }, rules=["lock-discipline"])
+    assert result.active == []
+
+
+def test_lock_discipline_lockfree_loop_vs_thread_race(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/c.py": """
+            import asyncio
+
+            class Pump:
+                def __init__(self):
+                    self.moved = 0
+
+                def _work(self):
+                    self.moved += 1
+
+                async def drive(self):
+                    await asyncio.to_thread(self._work)
+                    self.tick()
+
+                def tick(self):
+                    self.moved = 0
+        """,
+    }, rules=["lock-discipline"])
+    assert len(result.active) == 1
+    f = result.active[0]
+    assert "`Pump.moved`" in f.message
+    assert "worker thread" in f.message and "Pump.tick" in f.message
+
+
+def test_lock_discipline_lockfree_quiet_single_side(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/c.py": """
+            import asyncio
+
+            class Pump:
+                def __init__(self):
+                    self.moved = 0
+
+                def _work(self):
+                    self.moved += 1
+
+                async def drive(self):
+                    await asyncio.to_thread(self._work)
+                    return self.moved  # loop-side READ only: below the bar
+        """,
+    }, rules=["lock-discipline"])
+    assert result.active == []
+
+
+def test_lock_discipline_suppression_honored(tmp_path):
+    result = _project_lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/c.py": """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+
+                def add(self, n):
+                    with self._lock:
+                        self.total += n
+
+                def peek(self):
+                    # ftc: ignore[lock-discipline] -- monitoring read; staleness is fine
+                    return self.total
+        """,
+    }, rules=["lock-discipline"])
+    assert result.active == []
+    assert any(f.suppressed for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# rpc-conformance (fixtures)
+# ---------------------------------------------------------------------------
+
+_PROTOCOL_FIXTURE = {
+    "pkg/__init__.py": "",
+    "pkg/worker.py": """
+        class Server:
+            async def _dispatch(self, op, payload):
+                handler = getattr(self, f"_op_{op}", None)
+                return await handler(payload)
+
+            async def _op_ping(self, payload):
+                return {"n": payload["n"]}
+
+            async def _op_unused(self, payload):
+                return {}
+    """,
+    "pkg/client.py": """
+        class Client:
+            async def ping(self):
+                return await self._conn.call("ping", {"n": 1})
+    """,
+}
+
+
+def test_rpc_conformance_clean_pair(tmp_path):
+    files = dict(_PROTOCOL_FIXTURE)
+    files["pkg/worker.py"] = files["pkg/worker.py"].replace(
+        "\n            async def _op_unused(self, payload):\n                return {}\n", "\n"
+    )
+    result = _project_lint(tmp_path, files, rules=["rpc-conformance"])
+    assert result.active == []
+
+
+def test_rpc_conformance_dead_op_flagged(tmp_path):
+    result = _project_lint(tmp_path, _PROTOCOL_FIXTURE,
+                           rules=["rpc-conformance"])
+    assert len(result.active) == 1
+    assert "_op_unused" in result.active[0].message
+    assert "dead op" in result.active[0].message
+
+
+def test_rpc_conformance_client_without_handler(tmp_path):
+    files = dict(_PROTOCOL_FIXTURE)
+    files["pkg/client.py"] = files["pkg/client.py"].replace(
+        '.call("ping"', '.call("pingz"'
+    )
+    result = _project_lint(tmp_path, files, rules=["rpc-conformance"])
+    msgs = [f.message for f in result.active]
+    assert any("'pingz'" in m and "no worker handler" in m for m in msgs)
+
+
+def test_rpc_conformance_payload_key_mismatches(tmp_path):
+    files = dict(_PROTOCOL_FIXTURE)
+    # client sends {"m": 1}: handler's required "n" missing, "m" unread
+    files["pkg/client.py"] = files["pkg/client.py"].replace(
+        '{"n": 1}', '{"m": 1}'
+    )
+    result = _project_lint(tmp_path, files, rules=["rpc-conformance"])
+    msgs = " | ".join(f.message for f in result.active)
+    assert "requires payload key 'n'" in msgs
+    assert "'m' is sent but" in msgs
+
+
+def test_rpc_conformance_opaque_payload_skips_key_checks(tmp_path):
+    files = dict(_PROTOCOL_FIXTURE)
+    files["pkg/worker.py"] = files["pkg/worker.py"].replace(
+        'return {"n": payload["n"]}', "return decode(payload)"
+    )
+    files["pkg/client.py"] = files["pkg/client.py"].replace(
+        '{"n": 1}', '{"anything": 1}'
+    )
+    result = _project_lint(tmp_path, files, rules=["rpc-conformance"])
+    assert [f for f in result.active if "payload key" in f.message] == []
+
+
+# ---------------------------------------------------------------------------
+# rpc-conformance (mutation tests against the REAL package)
+# ---------------------------------------------------------------------------
+
+WORKER = PKG / "transport" / "worker.py"
+CLIENT = PKG / "transport" / "client.py"
+STATE_SVC = PKG / "controller" / "statestore_service.py"
+
+
+def _rpc_lint(overrides):
+    # both protocols' halves live entirely under these roots (worker +
+    # client + process handshake; @_rpc handlers + RemoteStateStore in one
+    # module) — the subset keeps each mutation lint fast while preserving
+    # every anchor the rule needs.  tests/test_lint_clean.py still runs
+    # the rule over the WHOLE package.
+    return lint_paths(
+        [str(PKG / "transport"), str(STATE_SVC)], rules={},
+        project_rules={"rpc-conformance": all_project_rules()["rpc-conformance"]},
+        source_overrides=overrides,
+    )
+
+
+def test_mutation_head_is_green():
+    assert _rpc_lint(None).active == []
+
+
+def test_mutation_deleting_worker_handler_turns_lint_red():
+    src = WORKER.read_text()
+    assert "async def _op_probe(" in src
+    mutated = src.replace("async def _op_probe(", "async def _op_probe_gone(")
+    result = _rpc_lint({str(WORKER): mutated})
+    msgs = [f.message for f in result.active]
+    assert any("'probe'" in m and "no worker handler" in m for m in msgs), msgs
+    assert result.exit_code == 1
+
+
+def test_mutation_renaming_client_op_turns_lint_red():
+    src = CLIENT.read_text()
+    assert '.call("generate"' in src.replace("\n", "").replace(" ", "") or \
+        '"generate"' in src
+    mutated = src.replace('"generate", payload', '"generatez", payload')
+    assert mutated != src
+    result = _rpc_lint({str(CLIENT): mutated})
+    msgs = [f.message for f in result.active]
+    # the renamed op has no handler AND the real handler goes dead
+    assert any("'generatez'" in m for m in msgs), msgs
+    assert any("_op_generate" in m and "dead op" in m for m in msgs), msgs
+
+
+def test_mutation_deleting_state_rpc_handler_turns_lint_red():
+    src = STATE_SVC.read_text()
+    mutated = src.replace('@_rpc("get_job")', '@_rpc("get_job_gone")')
+    assert mutated != src
+    result = _rpc_lint({str(STATE_SVC): mutated})
+    msgs = [f.message for f in result.active]
+    assert any("'get_job'" in m and "no @_rpc handler" in m for m in msgs), msgs
+
+
+def test_mutation_dropping_required_payload_key_turns_lint_red():
+    src = STATE_SVC.read_text()
+    # handler starts requiring a key the client never sends
+    mutated = src.replace(
+        'return _dump(await store.get_job(p["job_id"]))',
+        'return _dump(await store.get_job(p["job_identifier"]))',
+    )
+    assert mutated != src
+    result = _rpc_lint({str(STATE_SVC): mutated})
+    msgs = [f.message for f in result.active]
+    assert any("'job_identifier'" in m and "never sends it" in m
+               for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------------
+# metric-doc-drift
+# ---------------------------------------------------------------------------
+
+_METRIC_FILES = {
+    "pkg/__init__.py": "",
+    "pkg/metrics.py": """
+        GAUGES = (
+            ("ftc_demo_total", "counter", "total"),
+        )
+
+        def render():
+            return ["# TYPE ftc_demo_up gauge", "ftc_demo_up 1"]
+    """,
+    "docs/observability.md": """
+        # Demo
+
+        ## Metric catalog
+
+        | family | kind |
+        |---|---|
+        | `ftc_demo_total` | counter |
+        | `ftc_demo_up` | gauge |
+
+        ## Next section
+    """,
+}
+
+
+def test_metric_drift_clean_when_in_sync(tmp_path):
+    _write(tmp_path, _METRIC_FILES)
+    result = lint_paths(
+        [str(tmp_path / "pkg")], rules={},
+        project_rules={"metric-doc-drift": all_project_rules()["metric-doc-drift"]},
+    )
+    assert result.active == []
+
+
+def test_metric_drift_flags_both_directions(tmp_path):
+    files = dict(_METRIC_FILES)
+    files["docs/observability.md"] = files["docs/observability.md"].replace(
+        "| `ftc_demo_total` | counter |", "| `ftc_demo_stale` | counter |"
+    )
+    _write(tmp_path, files)
+    result = lint_paths(
+        [str(tmp_path / "pkg")], rules={},
+        project_rules={"metric-doc-drift": all_project_rules()["metric-doc-drift"]},
+    )
+    msgs = " | ".join(f.message for f in result.active)
+    assert "ftc_demo_total" in msgs and "missing from" in msgs
+    assert "ftc_demo_stale" in msgs and "no code emits it" in msgs
+    # the stale-name finding anchors in the docs file itself
+    assert any(f.path.endswith("observability.md") for f in result.active)
+
+
+def test_metric_extraction_ignores_non_metric_ftc_strings(tmp_path):
+    _write(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/auth.py": """
+            def token(request):
+                return request.cookies.get("ftc_token")
+        """,
+        "docs/observability.md": "## Metric catalog\n\n`ftc_real_metric`\n",
+        # ftc_real_metric must be "emitted" somewhere to avoid the stale
+        # finding being the only signal under test
+        "pkg/m.py": 'LINES = ["# TYPE ftc_real_metric gauge"]\n',
+    })
+    result = lint_paths(
+        [str(tmp_path / "pkg")], rules={},
+        project_rules={"metric-doc-drift": all_project_rules()["metric-doc-drift"]},
+    )
+    assert result.active == []  # the cookie name is not an emitted metric
+
+
+def test_real_catalog_is_nontrivial_and_in_sync():
+    from finetune_controller_tpu.analysis.rules_protocol import (
+        _catalog_metrics,
+        _emitted_metrics,
+    )
+
+    project = build_project([str(PKG)])
+    emitted = _emitted_metrics(project)
+    catalogued = _catalog_metrics(PKG.parent / "docs" / "observability.md")
+    assert len(emitted) >= 50  # the extraction found the real families
+    assert emitted.keys() == catalogued.keys()
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing: SARIF, selector aliases, wall-clock budget
+# ---------------------------------------------------------------------------
+
+
+def _bad_file(tmp_path) -> Path:
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    return bad
+
+
+def test_sarif_output_shape(tmp_path, capsys):
+    bad = _bad_file(tmp_path)
+    rc = main([str(bad), "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ftc-lint"
+    result = run["results"][0]
+    assert result["ruleId"] == "silent-except"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 4
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert "silent-except" in rule_ids
+
+
+def test_sarif_marks_suppressed_findings(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:  # ftc: ignore[silent-except] -- fixture\n"
+        "        pass\n"
+    )
+    rc = main([str(bad), "--format", "sarif", "--show-suppressed"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    result = doc["runs"][0]["results"][0]
+    assert result["suppressions"] == [{"kind": "inSource"}]
+
+
+def test_rules_and_exclude_rules_aliases(tmp_path, capsys):
+    bad = _bad_file(tmp_path)
+    assert main([str(bad), "--rules", "host-sync-in-jit"]) == 0
+    capsys.readouterr()
+    assert main([str(bad), "--exclude-rules", "silent-except"]) == 0
+    capsys.readouterr()
+    assert main([str(bad), "--rules", "silent-except"]) == 1
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        main([str(bad), "--rules", "no-such-rule"])
+
+
+def test_text_and_json_formats_unchanged_by_v2(tmp_path, capsys):
+    """Byte-compatibility pin: the v1 text/JSON shapes survive the v2
+    engine (same render, same JSON keys)."""
+    bad = _bad_file(tmp_path)
+    rc = main([str(bad), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert set(out.keys()) == {"findings", "errors", "counts"}
+    f = out["findings"][0]
+    assert set(f.keys()) == {"rule", "path", "line", "col", "message",
+                             "suppressed"}
+    rc = main([str(bad)])
+    text = capsys.readouterr().out.strip()
+    assert text.endswith("swallows the failure silently — log it "
+                         "(logger.exception), re-raise, or narrow the "
+                         "exception type")
+    assert text.startswith(f"{bad}:4:4: silent-except:")
+
+
+def test_list_rules_includes_project_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("blocking-io-in-async-transitive", "host-sync-in-jit-transitive",
+                "lock-discipline", "rpc-conformance", "metric-doc-drift"):
+        assert rid in out
+
+
+def test_full_v2_pass_fits_the_ci_wall_clock_budget():
+    """scripts/ci_check.sh gives the lint stage 10 s for the whole package;
+    the interprocedural pass must not rot into a slow gate."""
+    t0 = time.perf_counter()
+    result = lint_paths([str(PKG)])
+    elapsed = time.perf_counter() - t0
+    assert result.errors == []
+    assert elapsed < 10.0, f"ftc-lint v2 took {elapsed:.1f}s on the package"
