@@ -1515,9 +1515,15 @@ def _measure_serve_paged(model, variables, prompts, *, max_new) -> dict:
        capacity argument for paging: short requests stop paying full-length
        reservations;
     2. **throughput parity**: at EQUAL concurrency the paged engine's mixed
-       workload must hold >= 0.9x the unpaged tokens/s (best-of-3 windows —
-       the gather indirection must stay in the noise), with bit-identical
-       greedy outputs.
+       workload must hold >= 0.9x the unpaged tokens/s (interleaved
+       best-of-4 windows — the gather indirection must stay in the noise),
+       with bit-identical greedy outputs.
+
+    The parity RATIO is timing on a shared box, so it follows the ISSUE 12
+    convention: enforced only with >= 2 cores per timed leg (4 cores — the
+    two engines contend for the same runtime threads), recorded always
+    (``gates_enforced`` in the metrics).  Bit-identity and the compile
+    budget are load-independent and enforced everywhere.
     """
     import numpy as np
 
@@ -1604,7 +1610,8 @@ def _measure_serve_paged(model, variables, prompts, *, max_new) -> dict:
             fail("paged decode changed greedy output on the mixed workload",
                  request_id=rid)
     ratio = tps_p / tps_u
-    if ratio < 0.9:
+    gates_enforced = (os.cpu_count() or 1) >= 4
+    if gates_enforced and ratio < 0.9:
         fail(
             "paged engine below the 0.9x throughput-parity gate",
             paged_tokens_per_sec=round(tps_p, 1),
@@ -1625,8 +1632,197 @@ def _measure_serve_paged(model, variables, prompts, *, max_new) -> dict:
         "paged_tokens_per_sec": round(tps_p, 1),
         "unpaged_tokens_per_sec": round(tps_u, 1),
         "throughput_ratio": round(ratio, 3),
+        "gates_enforced": gates_enforced,
         "compilations": eng_p8.compilations,
         "recompile_budget": eng_p8.guard.budget,
+        "tiering": _measure_serve_tiering(model, variables, max_new=max_new),
+    }
+
+
+def _measure_serve_tiering(model, variables, *, max_new) -> dict:
+    """The ISSUE 16 host-KV-tier gates: tiering on vs off, everything else
+    equal — same model, same prompts, same DEVICE page budget, same device
+    prefix-cache budget.  The device prefix budget is set to HALF one
+    entry's footprint, so the working set (3 shared prefixes) cannot live on
+    the device at all: the off leg's cache refuses every insert and serves
+    pure misses, the on leg births entries straight to host slots and pages
+    them back in on touch.
+
+    1. **capacity**: round 2 re-touches each of the 3 prefixes — the on leg
+       must serve >= 2x the device-resident capacity (0 entries here, gate
+       floor 2) as restore hits where the off leg records none.  Pure
+       allocator arithmetic: enforced everywhere.
+    2. **lanes**: a grouped wave (3 prefixes x 4 lanes) admitted until
+       ``PoolExhausted`` at a pool sized to ~8 miss-lanes.  On-leg lanes
+       share restored prefix pages (first lane of a group pays the full
+       span, followers only the tail), off-leg lanes each reserve the full
+       span, so admitted_on >= 1.5x admitted_off.  Allocator-deterministic:
+       enforced everywhere.
+    3. **throughput**: mixed touch rounds, interleaved best-of-4 — the on
+       leg (restore + suffix prefill) must hold >= 0.8x the off leg's
+       tokens/s.  Timing on a shared box: ISSUE 12 convention, enforced
+       only with >= 4 cores (2 per timed leg), recorded always.
+
+    Every request that runs in both legs must be bit-identical (demote /
+    restore moves KV bytes, never changes them), and the decode windows run
+    under the armed transfer guard — ``trips`` must stay 0 (tier d2h/h2d
+    traffic lives in admission paths, never the decode dispatch).
+    """
+    import numpy as np
+
+    from finetune_controller_tpu.serve.engine import (
+        BatchEngine,
+        EngineConfig,
+        GenRequest,
+    )
+    from finetune_controller_tpu.serve.kv_pages import PoolExhausted
+
+    page_tokens = int(os.environ.get("BENCH_SERVE_PAGE_TOKENS", "16"))
+    buckets = (32, 128)
+    prefix_len = max(buckets) - 1
+    entry_pages = -(-max(buckets) // page_tokens)
+    budget_pages = max(1, entry_pages // 2)  # device budget < one entry
+    n_prefix, group = 3, 4
+
+    probe = BatchEngine(model, variables, EngineConfig(
+        slots=1, prompt_buckets=buckets, max_new_tokens=max_new + 8,
+        page_tokens=page_tokens))
+    page_bytes = probe._pool.page_bytes
+    del probe
+
+    rng = np.random.default_rng(16)
+    prefixes = [list(rng.integers(1, 200, size=prefix_len))
+                for _ in range(n_prefix)]
+
+    def reqs(tag, tails, new_tokens):
+        """One request per (prefix, tail): the shared 127-token prefix plus
+        a distinct final token, so every prompt is a fresh cache KEY whose
+        longest cached match is exactly the shared prefix."""
+        return [
+            GenRequest(request_id=f"{tag}-p{j}t{tl}",
+                       tokens=prefixes[j] + [int(tl)],
+                       max_new_tokens=new_tokens)
+            for j in range(n_prefix) for tl in tails
+        ]
+
+    def make_engine(tiered: bool, slots: int, pool_pages: int):
+        return BatchEngine(model, variables, EngineConfig(
+            slots=slots, prompt_buckets=buckets,
+            max_new_tokens=max_new + 8, page_tokens=page_tokens,
+            pool_pages=pool_pages,
+            prefix_cache_bytes=budget_pages * page_bytes,
+            host_pool_bytes=(256 * page_bytes) if tiered else 0,
+        ))
+
+    # --- gates 1 + 3: capacity beyond the device budget, tok/s parity -----
+    eng_on = make_engine(True, 4, 0)
+    eng_off = make_engine(False, 4, 0)
+    outs: dict[str, dict] = {"on": {}, "off": {}}
+    hits_round2 = {}
+    for which, eng in (("on", eng_on), ("off", eng_off)):
+        outs[which].update(eng.run(reqs("r1", [210], max_new)))  # seed
+        h0 = eng.prefix_hits_total
+        outs[which].update(eng.run(reqs("r2", [211], max_new)))  # re-touch
+        hits_round2[which] = eng.prefix_hits_total - h0
+    if hits_round2["on"] < 2 * max(hits_round2["off"], 1):
+        fail(
+            "host tier below the 2x effective-prefix-capacity gate",
+            round2_hits_tiered=hits_round2["on"],
+            round2_hits_untiered=hits_round2["off"],
+            working_set_entries=n_prefix,
+            device_budget_pages=budget_pages, entry_pages=entry_pages,
+        )
+
+    tps_on = tps_off = 0.0
+    for attempt in range(4):  # interleaved best-of-4, as in the paged gate
+        for which, eng in (("on", eng_on), ("off", eng_off)):
+            batch = reqs(f"t{attempt}", [220 + attempt, 230 + attempt],
+                         max_new)
+            t0 = time.perf_counter()
+            out = eng.run(batch)
+            window = time.perf_counter() - t0
+            tps = sum(len(r.generated) for r in out.values()) / window
+            if which == "on":
+                tps_on = max(tps_on, tps)
+            else:
+                tps_off = max(tps_off, tps)
+            outs[which].update(out)
+    ratio = tps_on / tps_off
+    gates_enforced = (os.cpu_count() or 1) >= 4
+    if gates_enforced and ratio < 0.8:
+        fail(
+            "tiered decode below the 0.8x mixed tokens/s gate",
+            tiered_tokens_per_sec=round(tps_on, 1),
+            untiered_tokens_per_sec=round(tps_off, 1),
+            ratio=round(ratio, 3),
+        )
+
+    # --- gate 2: >= 1.5x concurrent lanes at the same pool ----------------
+    # pool sized to ~8 full-span miss lanes; the +8 span headroom keeps it
+    # off lane-count boundaries for nearby page_tokens values
+    span = max(buckets) + 8 - 1
+    lane_pages = -(-span // page_tokens)
+    lanes = {}
+    wave_outs: dict[str, dict] = {}
+    for which, tiered in (("on", True), ("off", False)):
+        eng = make_engine(tiered, 2 * n_prefix * group, 8 * lane_pages)
+        eng.run(reqs("seed", [240], 8))  # entries exist (host) / refused
+        pending = reqs("wave", [250, 251, 252, 253], 8)
+        admitted = []
+        for req in pending:
+            try:
+                eng.admit(req)
+            except PoolExhausted:
+                break
+            admitted.append(req.request_id)
+        results: dict = {}
+        while eng.active_requests:
+            for r in eng.step():
+                results[r.request_id] = r
+        lanes[which] = len(admitted)
+        wave_outs[which] = results
+        if which == "on":
+            tier_stats = eng.kv_page_stats()
+            guard = eng._transfer_guard
+    if lanes["on"] < 1.5 * lanes["off"]:
+        fail(
+            "host tier below the 1.5x concurrent-lanes gate",
+            lanes_tiered=lanes["on"], lanes_untiered=lanes["off"],
+            pool_pages=8 * lane_pages, lane_pages=lane_pages,
+        )
+
+    # --- bit-identity: every request served by BOTH legs must match -------
+    for leg_on, leg_off, where in (
+        (outs["on"], outs["off"], "mixed rounds"),
+        (wave_outs["on"], wave_outs["off"], "lane wave"),
+    ):
+        for rid in set(leg_on) & set(leg_off):
+            if leg_on[rid].generated != leg_off[rid].generated:
+                fail("KV tiering changed greedy output "
+                     f"({where})", request_id=rid)
+
+    trips = guard.trips if guard is not None else None
+    if trips:
+        fail("transfer guard tripped inside the tiered decode window",
+             trips=trips)
+    return {
+        "page_tokens": page_tokens,
+        "device_prefix_budget_pages": budget_pages,
+        "entry_pages": entry_pages,
+        "working_set_entries": n_prefix,
+        "round2_prefix_hits_tiered": hits_round2["on"],
+        "round2_prefix_hits_untiered": hits_round2["off"],
+        "lanes_admitted_tiered": lanes["on"],
+        "lanes_admitted_untiered": lanes["off"],
+        "lanes_gain": round(lanes["on"] / max(lanes["off"], 1), 2),
+        "tiered_tokens_per_sec": round(tps_on, 1),
+        "untiered_tokens_per_sec": round(tps_off, 1),
+        "throughput_ratio": round(ratio, 3),
+        "gates_enforced": gates_enforced,
+        "demotions_total": tier_stats.get("demotions_total", 0),
+        "restores_total": tier_stats.get("restores_total", 0),
+        "host_pages_used": tier_stats.get("tier_host_pages_used", 0),
+        "transfer_guard_trips": trips,
     }
 
 

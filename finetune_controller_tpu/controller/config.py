@@ -185,6 +185,14 @@ class Settings(BaseModel):
     #: admission reserves worst-case pages, so a full pool backpressures
     #: (429 + Retry-After) instead of OOMing mid-decode
     serve_kv_pool_pages: int = 0
+    #: host-RAM KV tier budget (MiB) behind the device page pool (0 = off;
+    #: docs/serving.md §KV tiering).  Needs paged KV and the prefix cache:
+    #: past the DEVICE prefix budget (serve_prefix_cache_mb), LRU prefix
+    #: entries demote page-by-page to pinned host memory and page back in
+    #: on their next hit — effective prefix capacity grows past the device
+    #: budget with zero change to splice semantics, and idle-session KV
+    #: stops competing with hot decode lanes for device pages
+    serve_kv_host_pool_mb: int = 0
 
     # --- Multi-tenant adapters (docs/serving.md §Multi-tenant adapters) ---
     #: tenant adapters multiplexable per served base model (0 = off): LoRA

@@ -303,7 +303,7 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
         return 0
     header = (f"{'JOB':<24} {'MODE':>7} {'REPL':>5} {'SLOTS':>7} {'QUEUE':>5} "
               f"{'TOKENS':>8} {'HITS':>5} {'MISS':>5} {'SAVED':>8} "
-              f"{'CACHE_MB':>8} {'PAGES':>9} {'ADPT':>4}")
+              f"{'CACHE_MB':>8} {'PAGES':>9} {'TIER':>9} {'ADPT':>4}")
     print(header)
     for job_id, s in sorted(sessions.items()):
         slots = f"{s['slots_busy']}/{s['slots_total']}"
@@ -313,6 +313,12 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
         pages_total = s.get("kv_pages_total", 0)
         pages = (f"{s.get('kv_pages_used', 0)}/{pages_total}"
                  if pages_total else "-")
+        # host KV tier occupancy: device-resident vs host-demoted pages
+        # (docs/serving.md §KV tiering; '-' = tiering off)
+        tier_total = s.get("kv_tier_host_pages_total", 0)
+        tier = (f"{s.get('kv_pages_used', 0)}d/"
+                f"{s.get('kv_tier_host_pages_used', 0)}h"
+                if tier_total else "-")
         mode = s.get("transport", "inproc")
         print(
             f"{job_id:<24} {mode:>7} {repl:>5} {slots:>7} "
@@ -321,7 +327,7 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
             f"{s.get('prefix_hits_total', 0):>5} "
             f"{s.get('prefix_misses_total', 0):>5} "
             f"{s.get('prefill_tokens_saved_total', 0):>8} {cache_mb:>8.1f} "
-            f"{pages:>9} {s.get('adapters_loaded', 0):>4}"
+            f"{pages:>9} {tier:>9} {s.get('adapters_loaded', 0):>4}"
         )
         for rid, r in sorted((s.get("replicas") or {}).items()):
             rpages = (f" pages {r.get('kv_pages_used', 0)}/"

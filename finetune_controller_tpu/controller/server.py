@@ -1161,6 +1161,14 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
              "kv_cow_copies_total"),
             ("ftc_serve_kv_pool_exhaustions_total", "counter",
              "kv_pool_exhaustions_total"),
+            # host KV tier (docs/serving.md §KV tiering) — zeros when off
+            ("ftc_serve_kv_tier_host_pages_total", "gauge",
+             "kv_tier_host_pages_total"),
+            ("ftc_serve_kv_tier_host_pages_used", "gauge",
+             "kv_tier_host_pages_used"),
+            ("ftc_serve_kv_tier_host_bytes", "gauge", "kv_tier_host_bytes"),
+            ("ftc_serve_kv_demotions_total", "counter", "kv_demotions_total"),
+            ("ftc_serve_kv_restores_total", "counter", "kv_restores_total"),
             # multi-tenant adapters (docs/serving.md §Multi-tenant adapters)
             ("ftc_serve_adapters_loaded", "gauge", "adapters_loaded"),
         )

@@ -137,6 +137,64 @@ def paged_gather(pool: jax.Array, page_table: jax.Array) -> jax.Array:
     return pool[page_table].reshape(b, mp * t, hkv, d)
 
 
+def _check_paged_impl(name: str, raw: str) -> str:
+    val = raw.strip().lower()
+    if val not in ("auto", "kernel", "gather"):
+        raise ValueError(f"{name}={raw!r}: expected auto, kernel or gather")
+    return val
+
+
+def _check_positive_int(name: str, raw) -> int:
+    try:
+        val = int(raw)
+    except (TypeError, ValueError):
+        raise ValueError(f"{name}={raw!r}: not an integer") from None
+    if val <= 0:
+        raise ValueError(f"{name}={val}: must be positive")
+    return val
+
+
+def paged_attention_impl(
+    q: jax.Array, k_pool: jax.Array, v_pool: jax.Array, page_table: jax.Array
+) -> str:
+    """Resolve the paged-attention path for this call: ``kernel`` or
+    ``gather``.
+
+    ``FTC_PAGED_ATTN`` ∈ {``auto`` (default), ``kernel``, ``gather``} —
+    ``auto`` picks the Pallas kernel on TPU when the shapes are eligible
+    (matching storage dtypes — the kernel's bit-identity contract needs
+    storage-dtype matmul inputs — and the per-lane gathered cache fits the
+    ``FTC_PAGED_VMEM_MB`` scratch budget, default 64), the gather oracle
+    otherwise.  Explicit ``kernel`` is the operator override and the CI
+    bit-identity hook: it forces the kernel everywhere, including
+    interpret mode on CPU.
+    """
+    import os
+
+    impl = _check_paged_impl(
+        "FTC_PAGED_ATTN", os.environ.get("FTC_PAGED_ATTN") or "auto"
+    )
+    if impl != "auto":
+        return impl
+    if jax.default_backend() != "tpu":
+        return "gather"
+    if q.dtype != k_pool.dtype or q.dtype != v_pool.dtype:
+        return "gather"
+    from .pallas.paged_attention import paged_attention_vmem_bytes
+
+    budget_mb = _check_positive_int(
+        "FTC_PAGED_VMEM_MB", os.environ.get("FTC_PAGED_VMEM_MB") or 64
+    )
+    need = paged_attention_vmem_bytes(
+        q.shape,
+        page_table.shape[1],
+        k_pool.shape[1],
+        k_pool.shape[2],
+        k_pool.dtype.itemsize,
+    )
+    return "kernel" if need <= budget_mb << 20 else "gather"
+
+
 def paged_cache_attention(
     q: jax.Array,
     k_pool: jax.Array,
@@ -146,15 +204,29 @@ def paged_cache_attention(
 ) -> jax.Array:
     """:func:`chunked_cache_attention` reading through a page table.
 
-    Gather-based paged attention: the per-lane logical caches are gathered
-    from the shared pools and the exact :func:`chunked_cache_attention`
-    numerics run over them, so a paged decode/suffix-prefill is bit-identical
-    to the unpaged one whenever the gathered length equals the contiguous
-    cache length (the engine sizes ``MP*T == cache_len`` when the page size
-    divides it; otherwise the tail positions are masked exact-zeros like any
-    other beyond-index slot).  S = 1 is the decode step, S > 1 a
-    (bucket-padded) prefill or suffix prefill.
+    Two implementations behind one seam, dispatched by
+    :func:`paged_attention_impl` (``FTC_PAGED_ATTN``):
+
+    * ``gather`` — the reference oracle: per-lane logical caches are
+      gathered from the shared pools and the exact
+      :func:`chunked_cache_attention` numerics run over them, so a paged
+      decode/suffix-prefill is bit-identical to the unpaged one whenever
+      the gathered length equals the contiguous cache length (the engine
+      sizes ``MP*T == cache_len`` when the page size divides it; otherwise
+      the tail positions are masked exact-zeros like any other
+      beyond-index slot).
+    * ``kernel`` — ``ops.pallas.paged_attention``: walks the page table in
+      the BlockSpec index map so each KV page is read from HBM once and
+      the gathered copy only ever exists in VMEM scratch.  Bit-identical
+      to the gather path by construction (CI proves it in interpret mode).
+
+    S = 1 is the decode step, S > 1 a (bucket-padded) prefill or suffix
+    prefill.
     """
+    if paged_attention_impl(q, k_pool, v_pool, page_table) == "kernel":
+        from .pallas.paged_attention import paged_attention
+
+        return paged_attention(q, k_pool, v_pool, page_table, idx)
     return chunked_cache_attention(
         q,
         paged_gather(k_pool, page_table),

@@ -130,6 +130,62 @@ def bench_flash_variants(
     return results
 
 
+def bench_paged_variants(
+    batch: int = 8,
+    heads: int = 32,
+    kv_heads: int = 4,
+    head_dim: int = 64,
+    page_tokens: int = 16,
+    pages_per_lane: tuple[int, ...] = (16, 64, 256),
+    dtype=jnp.bfloat16,
+    iters: int = 20,
+) -> dict[str, float]:
+    """Decode-step seconds for the paged-attention impls, gather vs kernel,
+    swept over pages-per-lane (i.e. context length at fixed page size).
+
+    The gather tax this measures: the gather path materialises a
+    ``(B, MP*T, Hkv, D)`` logical cache from HBM every step, so its cost
+    scales with MP even when most pages are beyond the lane's live length;
+    the Pallas kernel (``ops/pallas/paged_attention.py``) reads each page
+    once into VMEM scratch.  Keys are ``"{impl}-p{pages}"``; run on real
+    hardware to pick ``FTC_PAGED_ATTN`` (``docs/performance.md``).
+    """
+    from .attention import chunked_cache_attention, paged_gather
+    from .pallas.paged_attention import paged_attention
+
+    results: dict[str, float] = {}
+    for mp in pages_per_lane:
+        pool_pages = batch * mp + 1
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(
+            kq, (batch, 1, heads, head_dim), dtype)
+        k_pool = jax.random.normal(
+            kk, (pool_pages, page_tokens, kv_heads, head_dim), dtype)
+        v_pool = jax.random.normal(
+            kv, (pool_pages, page_tokens, kv_heads, head_dim), dtype)
+        # each lane owns a disjoint page run, like a fragmented real pool
+        table = (1 + jnp.arange(batch * mp, dtype=jnp.int32)
+                 ).reshape(batch, mp)
+        idx = jnp.full((batch,), mp * page_tokens - 1, jnp.int32)
+
+        def gather_step(q, k, v, table=table, idx=idx):
+            return chunked_cache_attention(
+                q, paged_gather(k, table), paged_gather(v, table), idx)
+
+        def kernel_step(q, k, v, table=table, idx=idx):
+            return paged_attention(q, k, v, table, idx)
+
+        def chain(out, q_prev):
+            return q_prev + out.astype(q_prev.dtype) * 1e-3
+
+        for name, step in (("gather", gather_step), ("kernel", kernel_step)):
+            # ftc: ignore[recompile-jit-in-loop] -- the sweep measures one compile per (impl, pages) variant on purpose
+            fn = jax.jit(step)
+            results[f"{name}-p{mp}"] = _time_chained(
+                fn, q, k_pool, v_pool, chain, iters)
+    return results
+
+
 #: measured crossover (v5e, 2026-07-31 run of this module at the bench shape
 #: b8 h32/4 d64, with the r3 kernel defaults — block 1024, bf16 exp):
 #: seq 512 XLA wins the grad path (8.7 ms vs 11.4); seq 1024 Pallas wins
@@ -168,7 +224,28 @@ def main() -> None:
     p.add_argument("--flash-variants", action="store_true",
                    help="sweep the flash kernel's exp-dtype x block-size "
                         "grid instead of the impl comparison")
+    p.add_argument("--paged-variants", action="store_true",
+                   help="decode-step sweep of the paged-attention impls "
+                        "(gather vs Pallas kernel) over pages-per-lane")
+    p.add_argument("--page-tokens", type=int, default=16)
+    p.add_argument("--pages-per-lane", type=int, nargs="*",
+                   default=[16, 64, 256])
     args = p.parse_args()
+
+    if args.paged_variants:
+        r = bench_paged_variants(
+            batch=args.batch, heads=args.heads, kv_heads=args.kv_heads,
+            head_dim=args.head_dim, page_tokens=args.page_tokens,
+            pages_per_lane=tuple(args.pages_per_lane), iters=args.iters,
+        )
+        r_ms = {k: round(v * 1e3, 3) for k, v in r.items()}
+        print(json.dumps({
+            "shape": f"b{args.batch} h{args.heads}/{args.kv_heads} "
+                     f"d{args.head_dim} t{args.page_tokens}",
+            "unit": "ms/decode-step",
+            **r_ms,
+        }))
+        return
 
     if args.flash_variants:
         for seq in args.seq:

@@ -1,0 +1,171 @@
+"""Block-sparse paged-attention decode kernel (Pallas TPU).
+
+Why hand-write this: the gather-based ``paged_cache_attention``
+(``ops/attention.py``) materialises every lane's full logical cache
+``(B, MP*T, Hkv, D)`` from the page pool in HBM on **every** decode step —
+a pure memory-bandwidth tax that scales with the pool's page count, not
+with the tokens actually attended.  This kernel walks each lane's page
+list directly through the BlockSpec index map: grid = (lane, page-slot),
+and the scalar-prefetched page table routes page-slot ``ip`` of lane
+``ib`` to physical pool page ``table[ib, ip]`` — each KV page is DMA'd
+from HBM into VMEM exactly once and the gathered copy never exists
+outside VMEM scratch.
+
+Numerics are the point, not a compromise: CI proves the kernel
+bit-identical to the gather oracle (interpret mode off-TPU), so the
+per-page loop is a pure copy phase and the finalize step replays
+``chunked_cache_attention``'s exact op sequence — same storage-dtype
+matmul inputs with no ``preferred_element_type`` (the einsum's bf16
+intermediate), same f32 cast, same f32-min mask fill (exp underflows to
+an exact 0.0 for out-of-range slots, which is what makes scratch-page
+garbage invisible), same ``jax.nn.softmax``, same probs-to-V-dtype cast.
+An online-softmax accumulator would re-order the floating-point
+reductions and break that contract; the VMEM-stream shape keeps the perf
+property (one HBM read per page, zero HBM gather) while staying inside
+the oracle's rounding.
+
+Table slots beyond a lane's length point at the scratch page (id 0) —
+they stream in like any other page and mask to exact zeros, identical to
+the gather path's semantics.
+
+Runs in interpreter mode off-TPU so CPU CI exercises the same kernel
+logic (the ``flash_attention.py`` convention).  Dispatch between this
+kernel and the gather path is ``FTC_PAGED_ATTN`` (``ops/attention.py``);
+regressions show up next to the flash numbers in ``ops/kernel_bench.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _dimension_semantics
+
+
+def paged_attention_vmem_bytes(
+    q_shape: tuple, pages_per_lane: int, page_tokens: int, hkv: int, itemsize: int
+) -> int:
+    """Worst-case VMEM residency of one grid step: the two gathered-cache
+    scratch buffers plus the Q/K/V/O blocks.  The dispatch layer
+    (``ops/attention.py``) compares this against ``FTC_PAGED_VMEM_MB`` so
+    a long-context pool quietly falls back to the gather path instead of
+    failing to fit."""
+    _, s, h, d = q_shape
+    m = pages_per_lane * page_tokens
+    scratch = 2 * m * hkv * d * itemsize
+    kv_blocks = 2 * 2 * page_tokens * hkv * d * itemsize  # double-buffered
+    q_out = 2 * s * h * d * itemsize
+    return scratch + kv_blocks + q_out
+
+
+def _paged_kernel(
+    # scalar-prefetch refs (PrefetchScalarGridSpec, num_scalar_prefetch=2)
+    table_ref,  # (B, MP) int32 physical page ids
+    idx_ref,  # (B,) int32 per-lane first-query position
+    # tensor refs
+    q_ref,  # (1, S, H, D)
+    k_ref,  # (1, T, Hkv, D) — page table[ib, ip]
+    v_ref,  # (1, T, Hkv, D)
+    o_ref,  # (1, S, H, D)
+    # VMEM scratch — the gathered logical cache, never materialised in HBM
+    k_acc,  # (MP*T, Hkv, D)
+    v_acc,  # (MP*T, Hkv, D)
+):
+    t = k_ref.shape[1]
+    ib = pl.program_id(0)  # read outside pl.when: interpret lowers the
+    ip = pl.program_id(1)  # when-body via lax.cond, no pallas context there
+    mp = pl.num_programs(1)
+
+    # Copy phase: stream page ``ip`` into its logical slot.  Pure copies —
+    # bitwise-neutral by construction.
+    k_acc[pl.ds(ip * t, t)] = k_ref[0]
+    v_acc[pl.ds(ip * t, t)] = v_ref[0]
+
+    @pl.when(ip == mp - 1)
+    def _finalize():
+        _, s, h, d = q_ref.shape
+        m, hkv, _ = k_acc.shape
+        g = h // hkv
+        lane_pos = idx_ref[ib]
+
+        # The oracle's LITERAL op sequence at batch 1 — same einsum specs,
+        # same 5D shapes, same mask/softmax/cast chain.  Re-expressing the
+        # math (per-head 2D dots, head-batched dots) measurably changes
+        # XLA CPU's fused reduction order by 1 ulp on some shapes; issuing
+        # the identical ops is what makes interpret-mode bit-identity
+        # hold robustly (``chunked_cache_attention`` is itself
+        # batch-size-independent, which the kernel tests re-prove).
+        qh = (q_ref[0][None] * d ** -0.5).reshape(1, s, hkv, g, d)
+        scores = jnp.einsum("bskgd,btkd->bkgst", qh, k_acc[...][None])
+        scores = scores.astype(jnp.float32)
+        qpos = lane_pos.reshape(1, 1, 1, 1, 1) + jnp.arange(s).reshape(1, 1, 1, s, 1)
+        valid = jnp.arange(m).reshape(1, 1, 1, 1, -1) <= qpos
+        scores = jnp.where(valid, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v_acc.dtype)
+        out = jnp.einsum("bkgst,btkd->bskgd", probs, v_acc[...][None])
+        o_ref[0] = out.reshape(s, h, d).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_attention(q, k_pool, v_pool, page_table, idx, *, interpret: bool):
+    b, s, h, d = q.shape
+    _, t, hkv, _ = k_pool.shape
+    mp = page_table.shape[1]
+
+    grid = (b, mp)
+    kv_spec = pl.BlockSpec(
+        (1, t, hkv, d), lambda ib, ip, table, idx: (table[ib, ip], 0, 0, 0)
+    )
+    q_spec = pl.BlockSpec((1, s, h, d), lambda ib, ip, table, idx: (ib, 0, 0, 0))
+    return pl.pallas_call(
+        _paged_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((mp * t, hkv, d), k_pool.dtype),
+                pltpu.VMEM((mp * t, hkv, d), v_pool.dtype),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, s, h, d), v_pool.dtype),
+        # page slots accumulate into VMEM scratch sequentially per lane
+        compiler_params=_dimension_semantics("parallel", "arbitrary"),
+        interpret=interpret,
+    )(page_table, idx, q, k_pool, v_pool)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    idx: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Paged-cache attention reading the pool through the page table.
+
+    Shapes match :func:`ops.attention.paged_cache_attention`: ``q``
+    (B, S, H, D); pools (P, T, Hkv, D); ``page_table`` (B, MP) int32;
+    ``idx`` scalar or (B,) — the absolute position of the chunk's first
+    query token.  Returns (B, S, H, D) in the pool dtype, bit-identical
+    to the gather path.
+    """
+    if q.dtype != k_pool.dtype or q.dtype != v_pool.dtype:
+        raise ValueError(
+            f"paged_attention: q/k/v dtypes must match for bit-identical "
+            f"storage-dtype matmuls (got {q.dtype}, {k_pool.dtype}, "
+            f"{v_pool.dtype}); use the gather path for mixed dtypes"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b = q.shape[0]
+    idx = jnp.broadcast_to(jnp.asarray(idx, jnp.int32).reshape(-1), (b,))
+    page_table = page_table.astype(jnp.int32)
+    return _paged_attention(q, k_pool, v_pool, page_table, idx, interpret=interpret)

@@ -582,6 +582,13 @@ class Batcher:
             "kv_cow_copies_total": pages.get("cow_copies_total", 0),
             "kv_pool_exhaustions_total": pages.get(
                 "pool_exhaustions_total", 0),
+            # host KV tier (docs/serving.md §KV tiering) — zeros when off
+            "kv_tier_host_pages_total": pages.get(
+                "tier_host_pages_total", 0),
+            "kv_tier_host_pages_used": pages.get("tier_host_pages_used", 0),
+            "kv_tier_host_bytes": pages.get("tier_host_bytes", 0),
+            "kv_demotions_total": pages.get("demotions_total", 0),
+            "kv_restores_total": pages.get("restores_total", 0),
             # multi-tenant adapters (docs/serving.md §Multi-tenant adapters)
             "adapters_loaded": (
                 len(self.engine.adapters)

@@ -77,7 +77,7 @@ from .adapters import (
     _leaf_name,
     install_into,
 )
-from .kv_pages import KVPagePool, PageRun, PoolExhausted
+from .kv_pages import HostPagePool, HostRun, KVPagePool, PageRun, PoolExhausted
 from .prefix_cache import PrefixCache, resolve_reuse_length
 
 logger = logging.getLogger(__name__)
@@ -122,6 +122,12 @@ class EngineConfig:
     tenant_slots: int = 0
     #: stacked adapter rank ceiling (tenants pad up to it, bit-neutrally)
     tenant_rank: int = 0
+    #: host-RAM KV tier byte budget (docs/serving.md §KV tiering;
+    #: ``serve_kv_host_pool_mb`` in Settings): 0 = off.  Paged + prefix
+    #: cache only — past the DEVICE prefix budget, LRU entries demote to
+    #: pinned host pages and restore on touch, so idle-session and
+    #: long-context KV stops competing with hot decode for device pages
+    host_pool_bytes: int = 0
 
     @property
     def cache_len(self) -> int:
@@ -289,6 +295,18 @@ class BatchEngine:
             PrefixCache(self.config.prefix_cache_bytes, pool=self._pool)
             if self.config.prefix_cache_bytes > 0 else None
         )
+        # host-RAM KV tier (docs/serving.md §KV tiering): meaningful only
+        # with BOTH paging (the page is the transfer unit) and the prefix
+        # cache (entries are the demotable population)
+        self._host_pool: HostPagePool | None = None
+        if (self.config.host_pool_bytes > 0 and self._pool is not None
+                and self._prefix_cache is not None):
+            self._host_pool = HostPagePool(
+                self.config.host_pool_bytes, self._pool.page_bytes
+            )
+            self._prefix_cache.enable_tier(
+                self._host_pool, self._demote_run, self._restore_run
+            )
         # host masters for the per-call arguments: lane page tables (paged)
         # and per-lane adapter slots (tenants) — tiny int32 arrays shipped
         # into every jitted call, so admission/eviction never touches device
@@ -301,8 +319,8 @@ class BatchEngine:
         # (slots, 2) uint32 leaf — rows for greedy lanes are inert
         self._rng_keys = np.zeros((self.config.slots, 2), np.uint32)
         (self._fill, self._fill_from, self._fill_paged, self._decode,
-         self._insert, self._set_lane_index, self._copy_page) = \
-            self._build_fns()
+         self._insert, self._set_lane_index, self._copy_page,
+         self._read_page, self._write_page) = self._build_fns()
         if self.adapters is not None:
             self.sync_adapters()
         # counters the /metrics gauges read
@@ -578,10 +596,36 @@ class BatchEngine:
 
             return jax.tree_util.tree_map_with_path(fix, cache)
 
-        # insert/set_lane_index/copy_page have exactly one signature each
-        # (the cache trees are fixed-shape), so they stay outside the guard:
-        # the budget counts the shapes that can vary with traffic — prefill
-        # buckets and the decode step
+        @jax.jit
+        def read_page(cache, src):
+            """Slice pool page ``src`` out of every K/V leaf (KV tiering's
+            demote path) — fixed shapes, so all page ids share ONE compile;
+            leaf order is the tree traversal order ``write_page`` replays."""
+            return [
+                jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=leaf.ndim - 4)
+                for path, leaf in jax.tree_util.tree_leaves_with_path(cache)
+                if _leaf_name(path) in ("k", "v")
+            ]
+
+        @jax.jit
+        def write_page(cache, dst, pages):
+            """Write per-leaf page slices (a ``read_page`` result, possibly
+            round-tripped through the host tier) into pool page ``dst``."""
+            it = iter(pages)
+
+            def fix(path, leaf):
+                if _leaf_name(path) not in ("k", "v"):
+                    return leaf
+                return jax.lax.dynamic_update_slice_in_dim(
+                    leaf, next(it), dst, axis=leaf.ndim - 4
+                )
+
+            return jax.tree_util.tree_map_with_path(fix, cache)
+
+        # insert/set_lane_index/copy_page/read_page/write_page have exactly
+        # one signature each (the cache trees are fixed-shape), so they stay
+        # outside the guard: the budget counts the shapes that can vary with
+        # traffic — prefill buckets and the decode step
         return (
             self.guard.wrap(fill, "fill"),
             self.guard.wrap(fill_from, "fill_from"),
@@ -590,6 +634,8 @@ class BatchEngine:
             insert,
             set_lane_index,
             copy_page,
+            read_page,
+            write_page,
         )
 
     # ---- slot management --------------------------------------------------
@@ -615,8 +661,14 @@ class BatchEngine:
         return len(self._prefix_cache) if self._prefix_cache else 0
 
     def kv_page_stats(self) -> dict[str, int]:
-        """Pool gauges for /metrics (empty when unpaged)."""
-        return self._pool.stats() if self._pool is not None else {}
+        """Pool gauges for /metrics (empty when unpaged); with the host
+        tier armed, its gauges and transfer counters ride along."""
+        if self._pool is None:
+            return {}
+        stats = self._pool.stats()
+        if self._host_pool is not None:
+            stats.update(self._host_pool.stats())
+        return stats
 
     def kv_slack_pages(self) -> int | None:
         """Pages still promisable to new admissions (None when unpaged) —
@@ -783,8 +835,60 @@ class BatchEngine:
     # ---- paged prefill ----------------------------------------------------
 
     def _evict_hook(self):
-        return (self._prefix_cache.evict_oldest
-                if self._prefix_cache is not None else None)
+        if self._prefix_cache is None:
+            return None
+        if self._host_pool is not None:
+            # tier armed: page pressure demotes LRU entries to host RAM
+            # instead of destroying them (falls back to eviction when the
+            # host tier is full)
+            return self._prefix_cache.demote_or_evict
+        return self._prefix_cache.evict_oldest
+
+    # ---- host KV tier transfers (docs/serving.md §KV tiering) -------------
+    #
+    # Both directions run in ADMISSION paths (prefix lookup/insert, page
+    # growth) — never inside the transfer-guarded decode dispatch, which is
+    # what keeps the guard's "decode moves only its per-step feeds" contract
+    # intact with the tier on.
+
+    def _demote_run(self, run: PageRun) -> HostRun | None:
+        """Copy every page of ``run`` into host slots (device state is NOT
+        touched — the prefix cache releases the device refs after the swap).
+        None when the host tier cannot hold the run."""
+        hp = self._host_pool
+        if hp is None or not hp.can_hold(len(run.pages)):
+            return None
+        slots = hp.alloc(len(run.pages))
+        for slot_id, page in zip(slots, run.pages):
+            slices = self._read_page(self._cache, jnp.asarray(page, jnp.int32))
+            hp.write(slot_id, [np.asarray(x) for x in jax.device_get(slices)])
+        return HostRun(slots=tuple(slots), n_tokens=run.n_tokens)
+
+    def _restore_run(self, host_run: HostRun) -> PageRun | None:
+        """Upload a demoted run back into freshly allocated device pages.
+        Admission-style allocation — reserve first (None on exhaustion: the
+        caller treats the hit as a miss), then materialize page by page,
+        shedding OTHER cache entries under pressure.  The returned pages
+        hold synthetic lane refs the prefix cache converts to cache refs."""
+        pool = self._pool
+        n = len(host_run.slots)
+        try:
+            pool.reserve(n)
+        except PoolExhausted:
+            return None
+        pages: list[int] = []
+        try:
+            for slot_id in host_run.slots:
+                phys = pool.alloc_reserved(self._evict_hook())
+                pages.append(phys)
+                self._cache = self._write_page(
+                    self._cache, jnp.asarray(phys, jnp.int32),
+                    [jnp.asarray(x) for x in self._host_pool.read(slot_id)],
+                )
+        except BaseException:
+            pool.lane_release(pages, n - len(pages))
+            raise
+        return PageRun(pages=tuple(pages), n_tokens=host_run.n_tokens)
 
     def _b1_cache(self, start: int):
         """Per-admission B=1 view over the live cache: the shared pools ride

@@ -128,14 +128,16 @@ class ReplicaFleet:
         "requests_rejected_total", "deadline_drops_total",
         "step_errors_total", "prefix_hits_total", "prefix_misses_total",
         "prefill_tokens_saved_total", "kv_cow_copies_total",
-        "kv_pool_exhaustions_total",
+        "kv_pool_exhaustions_total", "kv_demotions_total",
+        "kv_restores_total",
     )
     #: point-in-time gauges: summed over LIVE replicas only
     _GAUGE_KEYS = (
         "queue_depth", "slots_busy", "slots_total", "compilations",
         "prefix_cache_bytes", "prefix_cache_entries",
         "kv_pages_total", "kv_pages_free", "kv_pages_used",
-        "kv_pages_shared",
+        "kv_pages_shared", "kv_tier_host_pages_total",
+        "kv_tier_host_pages_used", "kv_tier_host_bytes",
     )
     #: per-tenant counter DICTS ({adapter_id: n}): folded like the scalar
     #: counters so retired replicas' tenant tokens never regress

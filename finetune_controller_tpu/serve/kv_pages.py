@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import dataclasses
 
+import numpy as np
+
 
 class PoolExhausted(RuntimeError):
     """Not enough free/evictable pages to admit this request NOW — transient
@@ -58,6 +60,113 @@ class PageRun:
 
     pages: tuple[int, ...]
     n_tokens: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HostRun:
+    """A demoted prefix-cache entry: the same logical page run as a
+    :class:`PageRun`, but the KV bytes live in :class:`HostPagePool` slots
+    instead of device pool pages.  Self-contained by construction — demotion
+    snapshots EVERY page of the run (shared ones included), so restoring
+    never depends on pages other entries or lanes still hold."""
+
+    slots: tuple[int, ...]
+    n_tokens: int
+
+
+class HostPagePool:
+    """Host-RAM page tier behind the device :class:`KVPagePool`
+    (docs/serving.md §KV tiering).
+
+    A flat pool of ``capacity = budget_bytes // page_bytes`` host page
+    slots.  Storage is one pinned numpy buffer per K/V cache leaf, shaped
+    ``(capacity,) + page_slice_shape`` and allocated lazily on the first
+    demotion (the engine defines the leaf set; this class only needs the
+    bytes to land somewhere stable and reusable).  Single-threaded by the
+    same contract as the device pool — the batcher's drive loop serializes
+    every caller.
+
+    The unit of transfer is one whole page id across every layer's K and V
+    leaves — exactly the device pool's accounting unit, so device and host
+    byte budgets (``serve_prefix_cache_mb`` vs ``serve_kv_host_pool_mb``)
+    are directly comparable.
+    """
+
+    def __init__(self, budget_bytes: int, page_bytes: int):
+        if page_bytes <= 0:
+            raise ValueError("HostPagePool needs a positive page_bytes")
+        self.page_bytes = int(page_bytes)
+        self.capacity = max(0, int(budget_bytes) // self.page_bytes)
+        # ascending hand-out order, like the device pool: deterministic slot
+        # reuse keeps demote/restore tests reproducible
+        self._free = list(range(self.capacity - 1, -1, -1))
+        #: per-leaf pinned buffers, keyed by leaf ordinal in the engine's
+        #: fixed K/V traversal order; created on first write
+        self._buffers: list[np.ndarray] | None = None
+        # counters for /metrics + tests (units: PAGES moved, not calls)
+        self.demotions_total = 0
+        self.restores_total = 0
+
+    # ---- accounting -------------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.capacity - len(self._free)
+
+    def can_hold(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    # ---- slot lifecycle ---------------------------------------------------
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` host slots (caller checks :meth:`can_hold` first — a
+        full host tier is a soft condition, the entry just stays on
+        device)."""
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"host kv tier exhausted: need {n} slot(s), "
+                f"free {len(self._free)} of {self.capacity}"
+            )
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, slots) -> None:
+        for slot in slots:
+            assert 0 <= slot < self.capacity, f"bad host slot {slot}"
+            self._free.append(slot)
+        assert len(self._free) <= self.capacity, "host slot accounting broke"
+
+    # ---- page bytes -------------------------------------------------------
+
+    def write(self, slot: int, pages: list[np.ndarray]) -> None:
+        """Store one device page's per-leaf slices into host ``slot``."""
+        if self._buffers is None:
+            self._buffers = [
+                np.empty((self.capacity,) + np.shape(p), p.dtype)
+                for p in pages
+            ]
+        for buf, page in zip(self._buffers, pages):
+            buf[slot] = page
+
+    def read(self, slot: int) -> list[np.ndarray]:
+        """The per-leaf page slices stored in ``slot`` (same order as the
+        :meth:`write` that filled it)."""
+        assert self._buffers is not None, "read before any write"
+        return [buf[slot] for buf in self._buffers]
+
+    # ---- observability ----------------------------------------------------
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "tier_host_pages_total": self.capacity,
+            "tier_host_pages_used": self.used_count,
+            "tier_host_bytes": self.used_count * self.page_bytes,
+            "demotions_total": self.demotions_total,
+            "restores_total": self.restores_total,
+        }
 
 
 class KVPagePool:

@@ -33,7 +33,9 @@ from __future__ import annotations
 
 import dataclasses
 from collections import OrderedDict
-from typing import Any
+from typing import Any, Callable
+
+from .kv_pages import HostRun
 
 
 def resolve_reuse_length(
@@ -73,7 +75,8 @@ def resolve_reuse_length(
 @dataclasses.dataclass
 class _Entry:
     key: tuple[int, ...]
-    cache: Any               # B=1 device KV pytree, or a kv_pages.PageRun
+    cache: Any               # B=1 device KV pytree, a kv_pages.PageRun, or
+    #                          a kv_pages.HostRun (demoted to the host tier)
     nbytes: int
     node: "_Node"
     #: adapter namespace (docs/serving.md §Multi-tenant adapters): KV depends
@@ -81,6 +84,10 @@ class _Entry:
     #: adapter id, token ids) — a hit under one tenant's adapter must never
     #: splice into another tenant's lane
     ns: str = ""
+    #: residency: "device" (cache is a KV pytree or PageRun), "host" (cache
+    #: is a HostRun), or "in-flight" (a restore is mid-transfer — the entry
+    #: is pinned against demotion/eviction until the swap lands)
+    tier: str = "device"
 
 
 class _Node:
@@ -131,6 +138,31 @@ class PrefixCache:
         self._lru: OrderedDict[tuple, _Entry] = OrderedDict()
         self.total_bytes = 0
         self.evictions_total = 0
+        # host tier (docs/serving.md §KV tiering) — wired by the paged
+        # engine via enable_tier(); unpaged caches never tier
+        self._host_pool: Any = None
+        self._demote_fn: Callable | None = None
+        self._restore_fn: Callable | None = None
+
+    def enable_tier(self, host_pool: Any, demote_fn: Callable,
+                    restore_fn: Callable) -> None:
+        """Arm the host-RAM tier: past the device byte budget, LRU entries
+        DEMOTE to host slots instead of evicting, and a lookup hit on a
+        demoted entry restores it on touch.
+
+        ``demote_fn(PageRun) -> HostRun | None`` copies every page of a run
+        into host slots (None when the host tier is full — the entry then
+        falls through to plain eviction); ``restore_fn(HostRun) -> PageRun |
+        None`` allocates fresh device pages (admission-style: reserve +
+        alloc, holding synthetic lane refs this cache immediately converts
+        to cache refs) and uploads the bytes (None when the device pool
+        cannot host the run right now — the hit is treated as a miss and
+        the entry stays demoted).  Both run in admission paths, never
+        inside the transfer-guarded decode dispatch.
+        """
+        self._host_pool = host_pool
+        self._demote_fn = demote_fn
+        self._restore_fn = restore_fn
 
     def __len__(self) -> int:
         return len(self._lru)
@@ -167,7 +199,44 @@ class PrefixCache:
         if entry is None:  # pragma: no cover - n_entries invariant
             return 0, None
         self._lru.move_to_end((entry.ns, entry.key))
+        if isinstance(entry.cache, HostRun):
+            # restore-on-touch: page the demoted run back into fresh device
+            # pages before the caller splices it.  A failed restore (device
+            # pool full right now) is a miss — the entry stays on host for
+            # a later, less contended touch.
+            if not self._restore(entry):
+                return 0, None
         return depth, entry.cache
+
+    def _restore(self, entry: _Entry) -> bool:
+        if self._restore_fn is None:  # pragma: no cover - host entries only
+            return False              # exist after enable_tier()
+        host_run = entry.cache
+        entry.tier = "in-flight"  # pin: restore's own allocations may demote
+        try:                      # or evict OTHER entries, never this one
+            new_run = self._restore_fn(host_run)
+        finally:
+            entry.tier = "host"
+        if new_run is None:
+            return False
+        # the engine handed us pages holding synthetic admission (lane)
+        # refs; convert them to cache refs, then drop the synthetic ones
+        charged = self._pool.cache_ref(new_run.pages)
+        self._pool.lane_release(new_run.pages)
+        self.total_bytes += charged * self._pool.page_bytes
+        entry.nbytes = charged * self._pool.page_bytes
+        entry.cache = new_run
+        entry.tier = "device"
+        self._host_pool.free(host_run.slots)
+        self._host_pool.restores_total += len(host_run.slots)
+        # restoring may overshoot the device budget: shed LRU entries (to
+        # host when possible) so the budget invariant holds after every
+        # public call.  One sanctioned exception: an entry BIGGER than the
+        # whole device budget (born demoted at insert) overshoots while it
+        # is the only device-resident entry — it re-demotes as the LRU
+        # victim of the next shed instead
+        self._shrink(exclude=(entry.ns, entry.key))
+        return True
 
     def _pick(self, node: _Node) -> _Entry | None:
         """Any live entry in ``node``'s subtree (they all share the resolved
@@ -201,7 +270,23 @@ class PrefixCache:
             # paged: refuse by the entry's worst-case physical footprint;
             # the actual charge (below) counts already-shared pages once
             if len(cache.pages) * self._pool.page_bytes > self.budget_bytes:
-                return False
+                # tier armed: an entry too big for the DEVICE budget is
+                # born demoted — snapshotted straight to host slots, zero
+                # device charge (its pages stay lane-held until the writing
+                # lane drains, then free).  This is what stops long-context
+                # KV competing with hot decode for device pages: the entry
+                # is still hittable, it just pages in on touch.
+                if self._demote_fn is None:
+                    return False
+                host_run = self._demote_fn(cache)
+                if host_run is None:
+                    return False
+                self._host_pool.demotions_total += len(host_run.slots)
+                node = self._attach(key, namespace)
+                entry = _Entry(key=key, cache=host_run, nbytes=0, node=node,
+                               ns=namespace, tier="host")
+                self._link(node, entry)
+                return True
         else:
             if nbytes is None:
                 nbytes = _tree_nbytes(cache)
@@ -212,19 +297,63 @@ class PrefixCache:
             nbytes = self._pool.cache_ref(cache.pages) * self._pool.page_bytes
         entry = _Entry(key=key, cache=cache, nbytes=nbytes, node=node,
                        ns=namespace)
+        self._link(node, entry)
+        self.total_bytes += nbytes
+        self._shrink(exclude=(namespace, key))
+        return True
+
+    def _link(self, node: _Node, entry: _Entry) -> None:
         node.entry = entry
         walk = node
         while walk is not None:
             walk.n_entries += 1
             walk = walk.parent
-        self._lru[(namespace, key)] = entry
-        self.total_bytes += nbytes
+        self._lru[(entry.ns, entry.key)] = entry
+
+    def _shrink(self, exclude: tuple | None = None) -> None:
+        """Enforce the DEVICE byte budget: demote LRU device entries to the
+        host tier while one is available, evict otherwise.  ``exclude``
+        protects the entry that triggered the shrink (just inserted or just
+        restored — by definition MRU and within budget by itself)."""
         while self.total_bytes > self.budget_bytes:
-            oldest = next(iter(self._lru))
-            if oldest == (namespace, key):  # pragma: no cover - refused above
+            if not self._shed_one(exclude):
                 break
-            self._evict(self._lru[oldest])
+
+    def _shed_one(self, exclude: tuple | None = None) -> bool:
+        """Move one LRU device entry off the device: demote when the host
+        tier accepts it, evict otherwise.  Returns False when nothing
+        device-resident remains to shed."""
+        victim = next(
+            (e for e in self._lru.values()
+             if e.tier == "device" and (e.ns, e.key) != exclude),
+            None,
+        )
+        if victim is None:
+            return False
+        if self._demote_fn is not None:
+            host_run = self._demote_fn(victim.cache)
+            if host_run is not None:
+                freed = self._pool.cache_release(victim.cache.pages)
+                self.total_bytes -= freed * self._pool.page_bytes
+                victim.nbytes = 0
+                victim.cache = host_run
+                victim.tier = "host"
+                self._host_pool.demotions_total += len(host_run.slots)
+                return True
+        self._evict(victim)
         return True
+
+    def demote_or_evict(self) -> bool:
+        """The paged engine's page-pressure hook (``alloc_reserved``'s
+        ``evict_one``) with the tier armed: shed the LRU device entry —
+        demote when possible, so "evicting" under admission pressure stops
+        destroying reusable KV — falling back to plain eviction (host full,
+        or only host-resident entries left, whose eviction frees host
+        slots but no device page; the ``slack`` invariant guarantees a
+        device page frees before the LRU drains)."""
+        if self._shed_one():
+            return True
+        return self.evict_oldest()
 
     def _attach(self, key: tuple[int, ...], namespace: str = "") -> _Node:
         """Walk/extend the trie to the node for ``key``, splitting edges."""
@@ -258,10 +387,14 @@ class PrefixCache:
 
     def evict_oldest(self) -> bool:
         """Evict the least recently used entry (any namespace) — the paged
-        engine's hook for freeing pool pages under admission pressure."""
-        if not self._lru:
+        engine's hook for freeing pool pages under admission pressure.
+        In-flight entries (a restore mid-transfer) are pinned."""
+        victim = next(
+            (e for e in self._lru.values() if e.tier != "in-flight"), None
+        )
+        if victim is None:
             return False
-        self._evict(next(iter(self._lru.values())))
+        self._evict(victim)
         return True
 
     def drop_namespace(self, namespace: str) -> int:
@@ -276,7 +409,11 @@ class PrefixCache:
 
     def _evict(self, entry: _Entry) -> None:
         self._lru.pop((entry.ns, entry.key), None)
-        if self._pool is not None:
+        if isinstance(entry.cache, HostRun):
+            # host-resident: the device was credited at demotion; dropping
+            # the entry only returns its host slots
+            self._host_pool.free(entry.cache.slots)
+        elif self._pool is not None:
             # physical credit: only pages dropping their LAST cache
             # reference (shared pages stay charged to the surviving entries)
             self.total_bytes -= (
@@ -308,6 +445,9 @@ class PrefixCache:
     def stats(self) -> dict[str, int]:
         return {
             "entries": len(self._lru),
+            "entries_host": sum(
+                1 for e in self._lru.values() if e.tier == "host"
+            ),
             "bytes": self.total_bytes,
             "budget_bytes": self.budget_bytes,
             "evictions_total": self.evictions_total,

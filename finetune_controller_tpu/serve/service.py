@@ -117,6 +117,10 @@ class ServeManager:
             ),
             page_tokens=(s.serve_kv_page_tokens if s.serve_paged_kv else 0),
             pool_pages=(s.serve_kv_pool_pages if s.serve_paged_kv else 0),
+            host_pool_bytes=(
+                int(s.serve_kv_host_pool_mb) * (1 << 20)
+                if s.serve_paged_kv else 0
+            ),
         )
 
     def _batcher_kwargs(self) -> dict[str, Any]:

@@ -135,6 +135,21 @@ if [ "$serve_chaos_rc" -ne 0 ]; then
     exit "$serve_chaos_rc"
 fi
 
+echo "== kernels-fast (paged-attention kernel bit-identity + dispatch) ==" >&2
+# The Pallas paged-attention kernel (docs/serving.md §Paged KV): interpret-
+# mode bit-identity against the gather+chunked oracle across shapes/dtypes,
+# the FTC_PAGED_ATTN dispatch gate, VMEM sizing, and the engine anchors
+# under the forced kernel — a broken kernel fails here in seconds, before
+# the serve suite exercises it indirectly.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_paged_attention.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+kernels_rc=$?
+if [ "$kernels_rc" -ne 0 ]; then
+    echo "ci_check: kernels-fast failed (exit $kernels_rc)" >&2
+    exit "$kernels_rc"
+fi
+
 echo "== serve-fast (batching invariance + prefix cache + paged KV + adapters + metrics) ==" >&2
 # no 'not slow' filter here: the serve suite IS this stage's whole job, so
 # its slow-marked extras (sampled-decode parity, prefix-cache eviction

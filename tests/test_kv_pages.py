@@ -29,10 +29,13 @@ from finetune_controller_tpu.serve.engine import (
     GenRequest,
 )
 from finetune_controller_tpu.serve.kv_pages import (
+    HostPagePool,
+    HostRun,
     KVPagePool,
     PageRun,
     PoolExhausted,
 )
+from finetune_controller_tpu.serve.prefix_cache import PrefixCache
 
 
 @pytest.fixture(scope="module")
@@ -392,3 +395,269 @@ def test_pool_exhaustion_backpressures_through_batcher(tiny_model):
         await b.close()
 
     run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# Host KV tier (docs/serving.md §KV tiering)
+# ---------------------------------------------------------------------------
+
+
+def test_host_pool_slot_lifecycle_and_bytes_roundtrip():
+    host = HostPagePool(budget_bytes=100, page_bytes=25)
+    assert host.capacity == 4 and host.free_count == 4
+    slots = host.alloc(3)
+    assert host.used_count == 3 and host.can_hold(1) and not host.can_hold(2)
+    with pytest.raises(PoolExhausted):
+        host.alloc(2)
+    page = [np.arange(6, dtype=np.float32).reshape(2, 3),
+            np.full((2, 3), 7.0, np.float32)]
+    host.write(slots[0], page)
+    got = host.read(slots[0])
+    assert all(np.array_equal(a, b) for a, b in zip(got, page))
+    host.free(slots)
+    assert host.free_count == 4
+    s = host.stats()
+    assert s["tier_host_pages_total"] == 4
+    assert s["tier_host_pages_used"] == 0 and s["tier_host_bytes"] == 0
+
+
+def _tiered_trio(num_pages=7, budget_pages=6, host_pages=6, page_bytes=10):
+    """KVPagePool + HostPagePool + PrefixCache wired with transfer fns that
+    move accounting only (no device arrays) — the allocator-level seam the
+    engine's _demote_run/_restore_run drive."""
+    pool = KVPagePool(num_pages=num_pages, page_tokens=4,
+                      page_bytes=page_bytes)
+    host = HostPagePool(budget_bytes=host_pages * page_bytes,
+                        page_bytes=page_bytes)
+    cache = PrefixCache(budget_pages * page_bytes, pool=pool)
+
+    def demote(run):
+        if not host.can_hold(len(run.pages)):
+            return None
+        return HostRun(slots=tuple(host.alloc(len(run.pages))),
+                       n_tokens=run.n_tokens)
+
+    def restore(host_run):
+        n = len(host_run.slots)
+        try:
+            pool.reserve(n)
+        except PoolExhausted:
+            return None
+        pages = []
+        try:
+            for _ in range(n):
+                pages.append(pool.alloc_reserved(cache.demote_or_evict))
+        except BaseException:
+            pool.lane_release(pages, n - len(pages))
+            raise
+        return PageRun(pages=tuple(pages), n_tokens=host_run.n_tokens)
+
+    cache.enable_tier(host, demote, restore)
+    return pool, host, cache
+
+
+def _admit_entry(pool, cache, key, n_pages):
+    """Admission-style insert: reserve, materialize, insert, lane done."""
+    pool.reserve(n_pages)
+    run = PageRun(
+        pages=tuple(pool.alloc_reserved() for _ in range(n_pages)),
+        n_tokens=n_pages * pool.page_tokens,
+    )
+    assert cache.insert(key, run)
+    pool.lane_release(run.pages)
+    return run
+
+
+def test_tier_slack_invariant_across_demote_restore_inflight():
+    """slack = free + cache-only - reserved must hold through every tier
+    transition: demotion converts cache-only pages to free (slack
+    UNCHANGED — demoted KV was already evictable capacity), restore
+    converts them back, and a failed restore leaks no reservation."""
+    pool, host, cache = _tiered_trio()
+    _admit_entry(pool, cache, (1, 2, 3), 3)
+    _admit_entry(pool, cache, (9, 8, 7), 3)
+    assert (pool.free_count, pool._cache_only, pool.reserved_outstanding) \
+        == (0, 6, 0)
+    assert pool.slack() == 6
+
+    # demote the LRU entry: its 3 pages move cache-only -> free
+    assert cache.demote_or_evict()
+    assert cache.stats()["entries_host"] == 1
+    assert (pool.free_count, pool._cache_only, pool.reserved_outstanding) \
+        == (3, 3, 0)
+    assert pool.slack() == 6          # unchanged: evictable either way
+    assert host.demotions_total == 3 and host.used_count == 3
+    assert cache.total_bytes == 3 * pool.page_bytes  # host entry credited
+
+    # a lane occupies the freed pages: restore must evict/demote to fit
+    pool.reserve(3)
+    lane = [pool.alloc_reserved() for _ in range(3)]
+    assert pool.slack() == 3
+
+    # restore-on-touch: entry A pages back in; the device budget then
+    # forces entry B out (demoted, not evicted), via the nested
+    # demote_or_evict hook — with A pinned "in-flight" throughout
+    match, got = cache.lookup((1, 2, 3))
+    assert match == 3 and isinstance(got, PageRun)
+    assert host.restores_total == 3
+    assert cache._lru[("", (1, 2, 3))].tier == "device"
+    assert cache._lru[("", (9, 8, 7))].tier == "host"
+    assert (pool.free_count, pool._cache_only, pool.reserved_outstanding) \
+        == (0, 3, 0)
+    assert pool.slack() == 3
+
+    # failed restore is a miss and leaks nothing: consume the whole slack,
+    # then touch the host entry
+    pool.reserve(pool.slack())
+    before = pool.reserved_outstanding
+    match, got = cache.lookup((9, 8, 7))
+    assert (match, got) == (0, None)
+    assert cache._lru[("", (9, 8, 7))].tier == "host"
+    assert pool.reserved_outstanding == before
+    pool.unreserve(before - 3)
+    pool.lane_release(lane, 3)
+
+
+def test_tier_inflight_entry_pinned_against_eviction():
+    pool, host, cache = _tiered_trio()
+    _admit_entry(pool, cache, (1, 2, 3), 2)
+    entry = cache._lru[("", (1, 2, 3))]
+    entry.tier = "in-flight"
+    assert not cache.evict_oldest()       # the only entry is pinned
+    assert not cache._shed_one()          # and not demotable either
+    entry.tier = "device"
+    assert cache.evict_oldest()
+
+
+def test_tier_demote_falls_back_to_eviction_when_host_full():
+    pool, host, cache = _tiered_trio(host_pages=2)
+    _admit_entry(pool, cache, (1, 2, 3), 3)   # 3 pages > host capacity 2
+    assert cache.demote_or_evict()
+    assert len(cache) == 0                    # evicted, not demoted
+    assert host.demotions_total == 0 and cache.evictions_total == 1
+    assert pool.free_count == 6
+
+
+def test_tier_evicting_host_entry_frees_slots_not_device_pages():
+    pool, host, cache = _tiered_trio()
+    _admit_entry(pool, cache, (1, 2, 3), 3)
+    assert cache.demote_or_evict()            # -> host
+    free_before = pool.free_count
+    assert cache.evict_oldest()               # drop the host entry
+    assert host.used_count == 0
+    assert pool.free_count == free_before     # no device pages involved
+    assert cache.total_bytes == 0
+
+
+def _tiered_engine(model, variables, device_budget_pages, **kw):
+    """Paged engine with the host tier armed and a device prefix budget of
+    exactly ``device_budget_pages`` pages."""
+    probe = _paged_engine(model, variables, prefix_cache_bytes=1 << 20)
+    page_bytes = probe.kv_page_stats()["page_bytes"]
+    defaults = dict(
+        slots=2, pool_pages=24,
+        prefix_cache_bytes=device_budget_pages * page_bytes,
+        host_pool_bytes=1 << 16,
+    )
+    defaults.update(kw)
+    return _paged_engine(model, variables, **defaults)
+
+
+def test_tier_capacity_beyond_device_budget(tiny_model):
+    """The headline: a device prefix budget of ONE entry serves a working
+    set of three distinct prefixes from the cache — entries past the
+    budget demote to host instead of evicting, and the second round of
+    touches hits via restore-on-touch, every output bit-identical."""
+    model, variables = tiny_model
+    eng = _tiered_engine(model, variables, device_budget_pages=2)
+    prefixes = [list(range(1, 13)), list(range(40, 52)),
+                list(range(70, 82))]
+    for rnd, tail in enumerate((30, 33)):
+        for j, shared in enumerate(prefixes):
+            prompt = shared + [tail]
+            rid = f"t{rnd}_{j}"
+            res = eng.run([GenRequest(request_id=rid, tokens=prompt,
+                                      max_new_tokens=4)])
+            want = _baseline(model, variables, prompt, 4)
+            assert res[rid].generated == want, f"{rid} diverged"
+    hp = eng._host_pool
+    assert hp.demotions_total > 0 and hp.restores_total > 0
+    # every second-round touch was a prefix hit — the device budget alone
+    # (1 entry) could have served at most one of the three
+    assert eng.prefix_hits_total >= 3
+    assert eng._prefix_cache.stats()["entries_host"] >= 1
+    st = eng.kv_page_stats()
+    for key in ("tier_host_pages_total", "tier_host_pages_used",
+                "tier_host_bytes", "demotions_total", "restores_total"):
+        assert key in st, key
+    assert st["tier_host_pages_used"] == hp.used_count
+
+
+def test_tier_mid_flight_demotion_is_invisible(tiny_model):
+    """Demoting a prefix entry while a lane decodes from its spliced pages
+    must not perturb the lane (lane refs pin shared pages; the snapshot
+    only reads), and the demoted entry still restores and serves later
+    hits bit-identically."""
+    model, variables = tiny_model
+    eng = _tiered_engine(model, variables, device_budget_pages=16)
+    shared = list(range(1, 13))
+    eng.run([GenRequest(request_id="seed", tokens=shared + [1],
+                        max_new_tokens=2)])
+    hit = GenRequest(request_id="hit", tokens=shared + [2],
+                     max_new_tokens=10)
+    eng.admit(hit)
+    assert eng.prefix_hits_total >= 1
+    # demote EVERY entry to host while the lane is mid-flight
+    while eng._prefix_cache.stats()["entries_host"] < len(eng._prefix_cache):
+        assert eng._prefix_cache.demote_or_evict()
+    assert eng._host_pool.demotions_total > 0
+    done = {}
+    while eng.active_requests:
+        for r in eng.step():
+            done[r.request_id] = r
+    assert done["hit"].generated == _baseline(
+        model, variables, shared + [2], 10)
+    # the host-resident entry restores on the next touch and still hits
+    res = eng.run([GenRequest(request_id="hit2", tokens=shared + [3],
+                              max_new_tokens=6)])
+    assert eng._host_pool.restores_total > 0
+    assert res["hit2"].generated == _baseline(
+        model, variables, shared + [3], 6)
+
+
+def test_tier_oversized_entry_born_demoted():
+    """An entry bigger than the whole DEVICE budget is not refused when the
+    tier is armed: it inserts straight to host (zero device charge) and
+    restores on touch — long-context KV stops competing for device pages."""
+    pool, host, cache = _tiered_trio(budget_pages=2)   # budget < 3 pages
+    pool.reserve(3)
+    run = PageRun(pages=tuple(pool.alloc_reserved() for _ in range(3)),
+                  n_tokens=12)
+    assert cache.insert((1, 2, 3), run)                # would be refused
+    entry = cache._lru[("", (1, 2, 3))]                # without the tier
+    assert entry.tier == "host" and cache.total_bytes == 0
+    assert host.demotions_total == 3
+    pool.lane_release(run.pages)                       # writer lane drains
+    assert pool.free_count == 6                        # no device residue
+    # touch: restores (transient overshoot of the device budget), and the
+    # next shed re-demotes it as the LRU victim
+    match, got = cache.lookup((1, 2, 3))
+    assert match == 3 and isinstance(got, PageRun)
+    assert cache.total_bytes == 3 * pool.page_bytes    # over budget, pinned
+    pool.reserve(2)
+    run2 = PageRun(pages=tuple(pool.alloc_reserved() for _ in range(2)),
+                   n_tokens=8)
+    assert cache.insert((7, 7), run2)
+    pool.lane_release(run2.pages)
+    assert cache._lru[("", (1, 2, 3))].tier == "host"  # re-demoted
+    assert cache.total_bytes == 2 * pool.page_bytes
+
+
+def test_tier_oversized_entry_refused_when_host_full():
+    pool, host, cache = _tiered_trio(budget_pages=2, host_pages=2)
+    pool.reserve(3)
+    run = PageRun(pages=tuple(pool.alloc_reserved() for _ in range(3)),
+                  n_tokens=12)
+    assert not cache.insert((1, 2, 3), run)            # host can't hold it
+    assert len(cache) == 0
+    pool.lane_release(run.pages)
