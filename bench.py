@@ -1029,6 +1029,12 @@ def _measure_serve() -> dict:
     transfer_guard_armed = env_flag("BENCH_TRANSFER_GUARD", default=True)
     if transfer_guard_armed:
         os.environ.setdefault("FTC_TRANSFER_GUARD", "raise")
+    # shard audit (analysis/shard_audit.py): any serve-side model load this
+    # bench (or its process-mode workers, which inherit the env) performs
+    # asserts the rule-table shardings on the way in. An explicit
+    # FTC_SHARD_AUDIT in the env wins; BENCH_SHARD_AUDIT=0 disables.
+    if env_flag("BENCH_SHARD_AUDIT", default=True):
+        os.environ.setdefault("FTC_SHARD_AUDIT", "raise")
 
     preset = os.environ.get("BENCH_PRESET", "tiny-test")
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "8"))
@@ -2253,6 +2259,14 @@ def main() -> None:
             "raise" if env_flag("BENCH_TRANSFER_GUARD", default=True)
             else "off"
         ),
+        # shard audit (analysis/shard_audit.py): state leaves that lose
+        # their rule-table sharding pay a silent GSPMD reshard every step —
+        # a slow number that is a BUG, not a result. Armed, a mis-sharded
+        # run ABORTS. BENCH_SHARD_AUDIT=0 disables.
+        shard_audit=(
+            "raise" if env_flag("BENCH_SHARD_AUDIT", default=True)
+            else "off"
+        ),
     )
     trainer = Trainer(model_cfg, train_cfg, mesh=mesh)
     state = trainer.init_state()
@@ -2309,6 +2323,13 @@ def main() -> None:
     timed_losses += [float(m["loss"]) for m in window_metrics]
     if hasattr(batches, "close"):
         batches.close()
+
+    # shard audit over the FINAL live state (the checkpoint-boundary trap,
+    # run explicitly here since the bench never checkpoints): every device
+    # leaf must still carry its rule-table NamedSharding after the timed
+    # window, or the measured number was taxed by silent resharding
+    if trainer._shard_auditor is not None:
+        trainer._audit_state_sharding(state, "bench-final-state")
 
     # --- sanity: the steps must have done real optimization work -----------
     if not all(np.isfinite(warmup_losses + timed_losses)):
@@ -2400,6 +2421,17 @@ def main() -> None:
         "device_kind": devices[0].device_kind,
         "warmup_loss_mean": round(float(np.mean(warmup_losses)), 4),
         "timed_loss_mean": round(float(np.mean(timed_losses)), 4),
+        # the audit above ran to completion under action="raise", so an
+        # armed run reaching this line proves zero violations
+        "shard_audit_armed": trainer._shard_auditor is not None,
+        "shard_audit_checks": (
+            trainer._shard_auditor.checks
+            if trainer._shard_auditor is not None else 0
+        ),
+        "shard_audit_violations": (
+            trainer._shard_auditor.violations
+            if trainer._shard_auditor is not None else 0
+        ),
     }
     if mm and env_flag("BENCH_PREFETCH_AB", default=True):
         # prefetch off/on A/B over REAL decoded images (BASELINE #5's "mixed

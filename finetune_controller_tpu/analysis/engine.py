@@ -93,12 +93,18 @@ class Rule:
 @dataclasses.dataclass(frozen=True)
 class ProjectRule:
     """A rule over the whole-package index (``analysis/project.py``):
-    ``check(project)`` yields ``(path, line, col, message)``."""
+    ``check(project)`` yields ``(path, line, col, message)``.
+
+    ``heavy`` rules import jax / compile programs and are EXCLUDED from the
+    default registry so the pure-AST pass keeps its 10s CI budget; they run
+    only when named explicitly (``--rules shard-rule-coverage``), which is
+    what the ``shard-audit-fast`` ci_check stage does."""
 
     id: str
-    plane: str  # "flow" | "concurrency" | "protocol"
+    plane: str  # "flow" | "concurrency" | "protocol" | "sharding"
     summary: str
     check: Callable[[object], Iterable[tuple[str, int, int, str]]]
+    heavy: bool = False
 
 
 _REGISTRY: dict[str, Rule] = {}
@@ -117,15 +123,19 @@ def register(rule_id: str, plane: str, summary: str):
     return deco
 
 
-def register_project(rule_id: str, plane: str, summary: str):
+def register_project(rule_id: str, plane: str, summary: str, *,
+                     heavy: bool = False):
     """Decorator: register a project-wide ``check(project)`` under
     ``rule_id``.  Ids share one namespace with per-file rules (selectors
-    don't care which kind they name)."""
+    don't care which kind they name).  ``heavy=True`` keeps the rule out of
+    the default registry (see :class:`ProjectRule`)."""
 
     def deco(fn):
         if rule_id in _PROJECT_REGISTRY or rule_id in _REGISTRY:
             raise ValueError(f"duplicate rule id {rule_id!r}")
-        _PROJECT_REGISTRY[rule_id] = ProjectRule(rule_id, plane, summary, fn)
+        _PROJECT_REGISTRY[rule_id] = ProjectRule(
+            rule_id, plane, summary, fn, heavy=heavy
+        )
         return fn
 
     return deco
@@ -140,11 +150,24 @@ def all_rules() -> dict[str, Rule]:
     return dict(_REGISTRY)
 
 
-def all_project_rules() -> dict[str, ProjectRule]:
-    """The project-wide registry (importing its rule modules on first use)."""
-    from . import rules_concurrency, rules_flow, rules_protocol  # noqa: F401
+def all_project_rules(include_heavy: bool = False) -> dict[str, ProjectRule]:
+    """The project-wide registry (importing its rule modules on first use).
 
-    return dict(_PROJECT_REGISTRY)
+    Heavy rules (jax-importing: the sharding coverage/divisibility checks
+    and the AOT collective audit) are excluded by default so the plain
+    ``ftc-lint <pkg>`` pass stays inside its 10s CI budget; pass
+    ``include_heavy=True`` (or name them via ``--rules``) to get them."""
+    from . import (  # noqa: F401
+        rules_concurrency,
+        rules_flow,
+        rules_protocol,
+        rules_sharding,
+    )
+
+    rules = dict(_PROJECT_REGISTRY)
+    if not include_heavy:
+        rules = {k: v for k, v in rules.items() if not v.heavy}
+    return rules
 
 
 # ---- suppression handling --------------------------------------------------
@@ -318,9 +341,11 @@ def _select_rules(
     select: str | None, ignore: str | None
 ) -> tuple[dict[str, Rule], dict[str, ProjectRule]]:
     """Apply ``--select``/``--ignore`` (aka ``--rules``/``--exclude-rules``)
-    across BOTH registries — selectors name rule ids, not rule kinds."""
+    across BOTH registries — selectors name rule ids, not rule kinds.
+    Naming a heavy rule in ``--select`` opts it in; without a selector the
+    default (non-heavy) registry runs."""
     rules = all_rules()
-    project_rules = all_project_rules()
+    project_rules = all_project_rules(include_heavy=True)
     known = rules.keys() | project_rules.keys()
     if select:
         wanted = {s.strip() for s in select.split(",") if s.strip()}
@@ -329,6 +354,10 @@ def _select_rules(
             raise SystemExit(f"ftc-lint: unknown rule(s): {sorted(unknown)}")
         rules = {k: v for k, v in rules.items() if k in wanted}
         project_rules = {k: v for k, v in project_rules.items() if k in wanted}
+    else:
+        project_rules = {
+            k: v for k, v in project_rules.items() if not v.heavy
+        }
     if ignore:
         dropped = {s.strip() for s in ignore.split(",") if s.strip()}
         unknown = dropped - known
@@ -345,7 +374,7 @@ def _sarif_doc(shown: list[Finding], errors: list[str]) -> dict:
     """SARIF 2.1.0 payload: one run, findings as results, suppressed ones
     carrying an ``inSource`` suppression so viewers render them greyed."""
     metas: dict[str, str] = {}
-    for reg in (all_rules(), all_project_rules()):
+    for reg in (all_rules(), all_project_rules(include_heavy=True)):
         for rid, rule in reg.items():
             metas[rid] = rule.summary
     used = sorted({f.rule for f in shown})
@@ -415,9 +444,13 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
 
     if args.list_rules:
-        rows = list(all_rules().values()) + list(all_project_rules().values())
+        rows = list(all_rules().values()) + list(
+            all_project_rules(include_heavy=True).values()
+        )
         for rule in sorted(rows, key=lambda r: (r.plane, r.id)):
-            print(f"{rule.id:30} [{rule.plane:11}] {rule.summary}")
+            tag = " [heavy: run via --rules]" if getattr(rule, "heavy", False) \
+                else ""
+            print(f"{rule.id:30} [{rule.plane:11}] {rule.summary}{tag}")
         return 0
 
     rules, project_rules = _select_rules(args.select, args.ignore)

@@ -297,9 +297,21 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
     (slot/queue occupancy, token throughput, prefix-cache hit economics)
     plus one indented row per replica — state, generation, load, restarts
     and failovers (docs/serving.md §Fleet)."""
-    sessions = (await client.get("/admin/serve")).get("sessions") or {}
+    body = await client.get("/admin/serve")
+    sessions = body.get("sessions") or {}
+    # process-wide shard-audit counters (analysis/shard_audit.py): printed
+    # even with no sessions — a nonzero violation count is the operator's
+    # cue that a load landed mis-sharded weights
+    audit = body.get("shard_audit") or {}
+    audit_line = (
+        f"(shard audit: {audit.get('checks_total', 0)} leaf checks, "
+        f"{audit.get('violations_total', 0)} violations)"
+        if audit else ""
+    )
     if not sessions:
         print("no serving sessions loaded")
+        if audit_line:
+            print(audit_line)
         return 0
     header = (f"{'JOB':<24} {'MODE':>7} {'REPL':>5} {'SLOTS':>7} {'QUEUE':>5} "
               f"{'TOKENS':>8} {'HITS':>5} {'MISS':>5} {'SAVED':>8} "
@@ -366,6 +378,8 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
                 extras.append(f"{label} {s[key]}")
         if extras:
             print(f"  ({', '.join(extras)})")
+    if audit_line:
+        print(audit_line)
     return 0
 
 

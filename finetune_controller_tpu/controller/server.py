@@ -1115,6 +1115,18 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
         lines.append(
             f"ftc_topology_downgrades_total {supervisor.topology_downgrades}"
         )
+    # runtime shard audit (analysis/shard_audit.py): process-wide counters
+    # from the rule-table sharding trap at checkpoint/restore/serve-load
+    # boundaries — violations > 0 means some state tree lost its sharding
+    from ..analysis.shard_audit import metrics_snapshot as shard_audit_snapshot
+
+    ssnap = shard_audit_snapshot()
+    for metric, key in (
+        ("ftc_shard_audit_checks_total", "checks_total"),
+        ("ftc_shard_audit_violations_total", "violations_total"),
+    ):
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {ssnap.get(key, 0)}")
     if rt.serve is not None:
         sessions = rt.serve.stats()
         serve_gauges = (

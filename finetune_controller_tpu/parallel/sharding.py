@@ -23,9 +23,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import AxisNames as Ax
 
 
+class ShardingRuleError(ValueError):
+    """A partition rule resolved to a spec the mesh cannot apply to a leaf:
+    a spec axis the mesh does not define, or a mesh-axis product that does
+    not divide the leaf dimension it shards.  Raised upfront by
+    :func:`sharding_for_tree` with the offending path and spec — before the
+    bad rule can surface as a deep XLA partitioner error at compile time."""
+
+
 class PartitionRules:
     def __init__(self, rules: list[tuple[str, P]]):
         self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def entries(self) -> list[tuple[str, P]]:
+        """The ordered ``(pattern, spec)`` table — introspection surface for
+        the sharding-conformance lint rules (``analysis/rules_sharding.py``)."""
+        return [(pat.pattern, spec) for pat, spec in self._rules]
+
+    def match_index(self, path: str) -> int | None:
+        """Index of the first rule whose pattern matches ``path`` (the rule
+        :meth:`spec_for` would select), or None."""
+        for i, (pat, _spec) in enumerate(self._rules):
+            if pat.search(path):
+                return i
+        return None
 
     def fingerprint(self) -> str:
         """Stable digest of the ordered rule table.
@@ -64,21 +85,23 @@ class PartitionRules:
 
     def tree_specs(self, tree: Any) -> Any:
         """Map a pytree of arrays (or ShapeDtypeStructs) to PartitionSpecs."""
-
-        def to_path(kp) -> str:
-            parts = []
-            for k in kp:
-                if hasattr(k, "key"):
-                    parts.append(str(k.key))
-                elif hasattr(k, "idx"):
-                    parts.append(str(k.idx))
-                else:
-                    parts.append(str(k))
-            return "/".join(parts)
-
         return jax.tree_util.tree_map_with_path(
-            lambda kp, v: self.spec_for(to_path(kp), v), tree
+            lambda kp, v: self.spec_for(key_path_str(kp), v), tree
         )
+
+
+def key_path_str(kp) -> str:
+    """``/``-joined param path for a jax key path — the string the rule
+    patterns match against."""
+    parts = []
+    for k in kp:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
 
 
 # Llama-family parameter rules.  Kernel shapes as produced by
@@ -111,10 +134,16 @@ LLAMA_RULES = PartitionRules(
         (r"experts_down", P(Ax.EXPERT, Ax.TENSOR, Ax.FSDP)),
         (r"router_kernel", P(Ax.FSDP, None)),
         # multimodal projector (models/multimodal.py): fc1 (d_vision, hidden)
-        # column-parallel, fc2 (hidden, d_model) row-parallel; ViT tower params
-        # fall through to the replicate catch-all (the encoder is small)
+        # column-parallel, fc2 (hidden, d_model) row-parallel
         (r"projector_fc1/kernel", P(Ax.FSDP, Ax.TENSOR)),
         (r"projector_fc2/kernel", P(Ax.TENSOR, Ax.FSDP)),
+        # ViT tower: replicated DELIBERATELY — the encoder is small next to
+        # the decoder and frozen in the LLaVA recipe.  The explicit rule
+        # (rather than catch-all fallthrough) keeps the shard-rule-coverage
+        # lint's weight-fallthrough check meaningful: a kernel reaching the
+        # bare catch-all below means someone ADDED a weight family without
+        # deciding its sharding
+        (r"vision_tower/", P()),
         # LoRA adapters: A (in, r) sharded like the frozen kernel's input dim;
         # B (r, out) over the output dim.  Rank r is tiny — keep it replicated.
         (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/lora_a", P(Ax.FSDP, None)),
@@ -127,8 +156,48 @@ LLAMA_RULES = PartitionRules(
 )
 
 
+def validate_spec(path: str, shape: tuple, spec: P, mesh: Mesh) -> None:
+    """Prove ``spec`` is applicable to a ``shape``-shaped leaf on ``mesh``:
+    every named axis exists, and the product of mesh-axis sizes sharding a
+    dimension divides that dimension.  Raises :class:`ShardingRuleError`
+    naming the path/spec/dim — the typed, immediate form of what would
+    otherwise surface as a deep XLA partitioner error at compile time."""
+    mesh_shape = dict(mesh.shape)
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        factor = 1
+        for ax in axes:
+            if ax not in mesh_shape:
+                raise ShardingRuleError(
+                    f"partition rule for {path!r} resolved to spec {spec} "
+                    f"naming mesh axis {ax!r}, but the mesh only defines "
+                    f"axes {tuple(mesh_shape)} — fix the rule table or the "
+                    "mesh builder (parallel/mesh.py)"
+                )
+            factor *= mesh_shape[ax]
+        if dim >= len(shape) or (factor > 1 and shape[dim] % factor):
+            dim_size = shape[dim] if dim < len(shape) else "<missing>"
+            raise ShardingRuleError(
+                f"partition rule for {path!r} resolved to spec {spec}, but "
+                f"dim {dim} of shape {tuple(shape)} (size {dim_size}) is not "
+                f"divisible by the {factor}-way mesh sharding over "
+                f"axes {tuple(axes)}"
+            )
+
+
 def sharding_for_tree(tree: Any, mesh: Mesh, rules: PartitionRules) -> Any:
     specs = rules.tree_specs(tree)
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    for (kp, leaf), spec in zip(
+        jax.tree_util.tree_leaves_with_path(tree), spec_leaves
+    ):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            validate_spec(key_path_str(kp), tuple(shape), spec, mesh)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 

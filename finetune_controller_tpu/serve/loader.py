@@ -217,6 +217,20 @@ def load_serving_model(
         state = trainer.load_pretrained(state, pretrained)
     variables = trainer._assemble(state.frozen, host["trainable"])
 
+    # shard-audit trap (analysis/shard_audit.py, FTC_SHARD_AUDIT): the
+    # assembled serving tree's device leaves must carry the rule table's
+    # shardings — a restore path that landed the base replicated would make
+    # every decode pay a silent GSPMD reshard (host-side numpy leaves carry
+    # no sharding and are skipped)
+    from ..analysis.shard_audit import ShardAuditor
+
+    auditor = ShardAuditor.from_env(name="serve-load")
+    if auditor is not None:
+        from ..parallel.sharding import sharding_for_tree
+
+        expected = sharding_for_tree(variables, trainer.mesh, trainer.rules)
+        auditor.audit(variables, expected, label=f"serve-load:step_{latest}")
+
     model = trainer.model
     merged = False
     if merge_lora and "lora" in variables \

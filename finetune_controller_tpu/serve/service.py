@@ -783,7 +783,15 @@ async def admin_serve_status(request: web.Request) -> web.Response:
 
     _admin(request)
     manager: ServeManager = request.app[SERVE_KEY]
-    return web.json_response({"sessions": manager.stats()})
+    # process-wide shard-audit counters (analysis/shard_audit.py): every
+    # serve-side weight load in this process audits the rule-table
+    # shardings; violations > 0 means a load landed mis-sharded state
+    from ..analysis.shard_audit import metrics_snapshot as shard_audit_snapshot
+
+    return web.json_response({
+        "sessions": manager.stats(),
+        "shard_audit": shard_audit_snapshot(),
+    })
 
 
 def add_serve_routes(app: web.Application, prefix: str) -> None:

@@ -128,6 +128,15 @@ class TrainConfig:
     #: ``FTC_TRANSFER_GUARD`` from the env (off when unset).  bench.py
     #: arms "raise" inside its timed windows.
     transfer_guard: str = ""
+    #: shard audit (``analysis/shard_audit.py``): at checkpoint/restore
+    #: boundaries, assert every live state leaf's ``.sharding`` still equals
+    #: the rule table's expected ``NamedSharding`` — catching the silent
+    #: full replication an elastic restore or resharding path can introduce
+    #: (every later step then pays a GSPMD reshard that profiles as "slow",
+    #: never as an error).  "raise" | "warn" | "off"; the empty default
+    #: inherits ``FTC_SHARD_AUDIT`` from the env (off when unset).
+    #: bench.py arms "raise" so a mis-sharded timed run aborts.
+    shard_audit: str = ""
     #: liveness heartbeat cadence (``resilience/heartbeat.py``): rank 0
     #: writes ``heartbeat.json`` (step + wall clock) into the artifacts dir
     #: at most every N seconds; the artifact sync ships it and the monitor's
@@ -430,6 +439,30 @@ class Trainer:
 
             self._transfer_guard = TransferGuard.from_env(
                 name="trainer-transfer-guard"
+            )
+        self._shard_auditor = None
+        audit_mode = (self.cfg.shard_audit or "").strip().lower()
+        if audit_mode in ("raise", "warn"):
+            from ..analysis.shard_audit import ShardAuditor
+
+            self._shard_auditor = ShardAuditor(
+                audit_mode, name="trainer-shard-audit"
+            )
+        elif audit_mode == "":
+            from ..analysis.shard_audit import ShardAuditor
+
+            self._shard_auditor = ShardAuditor.from_env(
+                name="trainer-shard-audit"
+            )
+
+    def _audit_state_sharding(self, state: Any, label: str) -> None:
+        """Shard-audit trap (analysis/shard_audit.py): at the
+        checkpoint/restore boundaries, every live state leaf must still
+        carry the rule table's NamedSharding — the bug class this catches
+        is silent replication after an elastic restore."""
+        if self._shard_auditor is not None:
+            self._shard_auditor.audit(
+                state, self._state_shardings, label=label
             )
 
     def _batch_leaf_sharding(self, x: Any) -> NamedSharding:
@@ -1162,10 +1195,17 @@ class Trainer:
                 if multi:
                     host = multihost_utils.broadcast_one_to_all(host)
                 state = state.replace(
-                    step=jnp.asarray(host["step"], jnp.int32),
+                    # step rides reshard too: a bare jnp.asarray commits it
+                    # to one default device, not the mesh-replicated spec
+                    # the rule table (and the shard audit) expect
+                    step=reshard(
+                        jnp.asarray(host["step"], jnp.int32),
+                        self._state_shardings.step,
+                    ),
                     trainable=reshard(host["trainable"], self._state_shardings.trainable),
                     opt_state=reshard(host["opt_state"], self._state_shardings.opt_state),
                 )
+                self._audit_state_sharding(state, "restore")
                 start_step = int(host["step"])
                 spans.finish(restore_span, step=start_step)
                 logger.info("resumed from checkpoint step %d", start_step)
@@ -1424,6 +1464,10 @@ class Trainer:
                         blocking=blocking_save,
                     )
                     t_ck = time.perf_counter()
+                    # A checkpoint of mis-sharded state would round-trip the
+                    # damage through every later restore — audit BEFORE the
+                    # host gather flattens the evidence away.
+                    self._audit_state_sharding(state, f"checkpoint:{step_idx + 1}")
                     # Collective gather on all hosts; rank 0 persists.
                     host_state = self.state_to_host(state)
                     if jax.process_index() == 0:

@@ -43,6 +43,34 @@ if [ "${1:-}" = "--lint-only" ]; then
     exit 0
 fi
 
+echo "== shard-audit-fast (sharding conformance: heavy rules + AOT collective audit) ==" >&2
+# The jax-importing sharding layer (docs/static_analysis.md §v3): the
+# HEAVY project rules — rule-table coverage against abstract catalog param
+# trees, axis-divisibility on every catalog topology, and the AOT
+# collective audit that compiles the train/serve steps on simulated meshes
+# and diffs the HLO collective set against docs/performance.md's
+# Collective catalog — plus their test files (mutation flips included).
+# These CANNOT ride the pure-AST lint stage above: importing jax alone
+# blows the 10s budget, which is why the rules are registry-excluded by
+# default and named explicitly here.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m finetune_controller_tpu.analysis \
+    --rules shard-rule-coverage,shard-divisibility,collective-conformance \
+    finetune_controller_tpu/
+shard_lint_rc=$?
+if [ "$shard_lint_rc" -ne 0 ]; then
+    echo "ci_check: shard-audit-fast lint failed (exit $shard_lint_rc)" >&2
+    exit "$shard_lint_rc"
+fi
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_shard_conformance.py tests/test_collective_audit.py \
+    tests/test_shard_audit.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+shard_rc=$?
+if [ "$shard_rc" -ne 0 ]; then
+    echo "ci_check: shard-audit-fast failed (exit $shard_rc)" >&2
+    exit "$shard_rc"
+fi
+
 echo "== obs-fast (tracing, timelines, histograms, phase profiling) ==" >&2
 # The observability layer (docs/observability.md): span/event recorders,
 # trace assembly + the gap-free validator, histogram exposition, the

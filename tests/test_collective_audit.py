@@ -1,0 +1,194 @@
+"""AOT collective audit (analysis/collective_audit.py) vs the Collective
+catalog in docs/performance.md.
+
+Layers:
+
+* ``parse_catalog`` unit fixtures — heading scoping, row parsing, the
+  ``none`` sentinel, the absent-heading opt-out;
+* ``diff_catalog`` is PURE (observed sets × catalog text), so every drift
+  direction is provable without compiling anything: an undocumented
+  collective, a documented-but-vanished one, a missing row, a catalog
+  topology the audit no longer simulates — including the
+  catalog-mutation satellite (drop `all-gather` from the fsdp2/train row
+  of the REAL doc text and the diff turns red);
+* slow: ``full_audit()`` compiles the train + serve steps on all three
+  simulated meshes (one subprocess each, fake CPU devices) and the
+  both-direction diff against the real catalog is EMPTY — the
+  acceptance-criteria e2e.
+"""
+
+import textwrap
+
+import pytest
+
+from finetune_controller_tpu.analysis.collective_audit import (
+    STEPS,
+    TOPOLOGIES,
+    catalog_path,
+    diff_catalog,
+    full_audit,
+    parse_catalog,
+)
+
+
+def _real_catalog():
+    text = catalog_path().read_text()
+    rows, heading = parse_catalog(text)
+    assert heading > 0
+    return text, rows
+
+
+def _observed_from(rows):
+    """Recorded observed sets mirroring the parsed catalog — the pure
+    mutation tests re-diff these against EDITED catalog text (the slow e2e
+    proves these equal the compiled reality)."""
+    return {
+        topo: {step: sorted(rows[(topo, step)]) for step in STEPS}
+        for topo in TOPOLOGIES
+    }
+
+
+# ---------------------------------------------------------------------------
+# parse_catalog
+# ---------------------------------------------------------------------------
+
+
+def test_parse_catalog_basic():
+    text = textwrap.dedent("""\
+        # Performance
+
+        ## Collective catalog
+
+        | topology | step | collectives |
+        |----------|------|-------------|
+        | dp2 | train | `all-reduce` |
+        | dp2 | serve | none |
+        | fsdp2 | train | `all-gather`, `all-reduce` |
+    """)
+    rows, heading = parse_catalog(text)
+    assert heading == 3
+    assert rows[("dp2", "train")] == {"all-reduce"}
+    assert rows[("dp2", "serve")] == set()
+    assert rows[("fsdp2", "train")] == {"all-gather", "all-reduce"}
+
+
+def test_parse_catalog_scoped_to_heading():
+    """Rows after the NEXT same-level heading belong to someone else."""
+    text = textwrap.dedent("""\
+        ## Collective catalog
+
+        | topology | step | collectives |
+        |---|---|---|
+        | dp2 | train | `all-reduce` |
+
+        ## Something else
+
+        | dp4 | train | `all-gather` |
+    """)
+    rows, _ = parse_catalog(text)
+    assert ("dp2", "train") in rows
+    assert ("dp4", "train") not in rows
+
+
+def test_parse_catalog_absent_heading_opts_out():
+    assert parse_catalog("# Performance\n\nno catalog here\n") == ({}, 0)
+
+
+def test_real_catalog_covers_every_audited_pair():
+    _text, rows = _real_catalog()
+    for topo in TOPOLOGIES:
+        for step in STEPS:
+            assert (topo, step) in rows, (topo, step)
+
+
+# ---------------------------------------------------------------------------
+# diff_catalog (pure — every direction, no compilation)
+# ---------------------------------------------------------------------------
+
+
+def test_recorded_sets_conform_to_real_catalog():
+    _text, rows = _real_catalog()
+    assert diff_catalog(_observed_from(rows), rows) == []
+
+
+def test_dropped_documented_collective_turns_red():
+    """The catalog-mutation satellite: delete `all-gather` from the REAL
+    doc's fsdp2/train row and the (recorded) compiled set now contains an
+    op the catalog does not document."""
+    text, rows = _real_catalog()
+    observed = _observed_from(rows)
+    row = "| fsdp2 | train | `all-gather`, `all-reduce`, `all-to-all` |"
+    assert row in text
+    mutated = text.replace(
+        row, "| fsdp2 | train | `all-reduce`, `all-to-all` |"
+    )
+    mutated_rows, _ = parse_catalog(mutated)
+    drift = diff_catalog(observed, mutated_rows)
+    assert any(
+        "'all-gather'" in m and "does not document" in m for m in drift
+    ), drift
+
+
+def test_undocumented_collective_turns_red():
+    """The headline bug class: a NEW collective appears in the compiled
+    step (the unexpected full-param all-gather)."""
+    _text, rows = _real_catalog()
+    observed = _observed_from(rows)
+    observed["dp2"]["train"] = sorted(
+        set(observed["dp2"]["train"]) | {"all-gather"}
+    )
+    drift = diff_catalog(observed, rows)
+    assert any(
+        "dp2/train" in m and "'all-gather'" in m
+        and "does not document" in m for m in drift
+    ), drift
+
+
+def test_vanished_documented_collective_turns_red():
+    """The other direction: the step no longer compiles a documented op."""
+    _text, rows = _real_catalog()
+    observed = _observed_from(rows)
+    observed["dp2tp2"]["serve"] = [
+        op for op in observed["dp2tp2"]["serve"] if op != "collective-permute"
+    ]
+    drift = diff_catalog(observed, rows)
+    assert any(
+        "no longer contains" in m and "'collective-permute'" in m
+        for m in drift
+    ), drift
+
+
+def test_missing_catalog_row_turns_red():
+    _text, rows = _real_catalog()
+    observed = _observed_from(rows)
+    observed["fsdp4"] = {"train": ["all-reduce"], "serve": []}
+    drift = diff_catalog(observed, rows)
+    assert any("fsdp4/train" in m and "no Collective catalog row" in m
+               for m in drift), drift
+
+
+def test_unaudited_catalog_topology_turns_red():
+    """A documented topology the audit stopped simulating is drift too."""
+    _text, rows = _real_catalog()
+    extra = dict(rows)
+    extra[("fsdp8", "train")] = {"all-gather"}
+    drift = diff_catalog(_observed_from(rows), extra)
+    assert any("'fsdp8'" in m and "does not simulate" in m for m in drift), \
+        drift
+
+
+# ---------------------------------------------------------------------------
+# the real thing (slow: three subprocess compiles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_audit_matches_catalog_exactly():
+    """Acceptance criteria: AOT audit on >=3 topologies; the compiled HLO
+    collective set matches docs/performance.md exactly, both ways."""
+    observed = full_audit()
+    assert len(observed) >= 3
+    for topo, steps in observed.items():
+        assert set(steps) == set(STEPS), topo
+    _text, rows = _real_catalog()
+    assert diff_catalog(observed, rows) == []

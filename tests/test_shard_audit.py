@@ -1,0 +1,325 @@
+"""Runtime shard audit (analysis/shard_audit.py, docs/static_analysis.md §v3).
+
+The trap's whole contract on one page:
+
+* a tree whose device leaves carry exactly their rule-table
+  ``NamedSharding`` audits clean (checks > 0, violations == 0);
+* a leaf that lost its sharding to full replication is caught
+  STRUCTURALLY — even on one device, where every layout is semantically
+  equivalent — and ``action="raise"`` aborts with the offending path while
+  ``action="warn"`` logs once per boundary and keeps going;
+* the ``FTC_FAULT_SHARD`` chaos hand re-``device_put``s a real leaf as
+  replicated, proving the abort end to end (this is the injected-fault
+  mutation satellite: HEAD is green because the fault is opt-in);
+* host-side numpy leaves carry no sharding and are skipped, so the
+  checkpoint host-gather path can share trees with the audit;
+* ``FTC_SHARD_AUDIT`` / ``TrainConfig.shard_audit`` wire the trap into the
+  trainer, and the process-wide counters feed
+  ``ftc_shard_audit_{checks,violations}_total``.
+
+Also here: the ``sharding_for_tree`` upfront-validation satellite —
+a rule resolving to an unknown mesh axis or an indivisible dimension
+raises a typed ``ShardingRuleError`` naming the path, not a deep XLA
+partitioner error at compile time.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from finetune_controller_tpu.analysis.shard_audit import (
+    ShardAuditError,
+    ShardAuditor,
+    metrics_snapshot,
+)
+from finetune_controller_tpu.models.llama import PRESETS, LlamaForCausalLM
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.parallel.mesh import MeshSpec
+from finetune_controller_tpu.parallel.sharding import (
+    LLAMA_RULES,
+    PartitionRules,
+    ShardingRuleError,
+    sharding_for_tree,
+    validate_spec,
+)
+from finetune_controller_tpu.train.trainer import TrainConfig, Trainer
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return MeshSpec(dp=1, fsdp=2).build(jax.devices()[:2])
+
+
+def _tree(mesh):
+    """A two-leaf tree device_put exactly onto its expected shardings."""
+    expected = {
+        "kernel": NamedSharding(mesh, P("fsdp", None)),
+        "scale": NamedSharding(mesh, P()),
+    }
+    tree = {
+        "kernel": jax.device_put(jnp.ones((8, 4)), expected["kernel"]),
+        "scale": jax.device_put(jnp.ones((4,)), expected["scale"]),
+    }
+    return tree, expected
+
+
+# ---- the audit itself ------------------------------------------------------
+
+
+def test_clean_tree_audits_clean(mesh):
+    tree, expected = _tree(mesh)
+    auditor = ShardAuditor("raise", inject_fault=False)
+    assert auditor.audit(tree, expected, label="t") == 0
+    assert auditor.checks == 2
+    assert auditor.violations == 0
+
+
+def test_replicated_leaf_is_caught_structurally(mesh):
+    """The production bug: a leaf silently landed fully replicated.  On the
+    CPU test mesh this is semantically indistinguishable from the sharded
+    layout — the audit must still flag it (structural comparison)."""
+    tree, expected = _tree(mesh)
+    tree["kernel"] = jax.device_put(
+        jnp.ones((8, 4)), NamedSharding(mesh, P())
+    )
+    auditor = ShardAuditor("raise", inject_fault=False)
+    with pytest.raises(ShardAuditError, match="kernel"):
+        auditor.audit(tree, expected, label="restore")
+
+
+def test_warn_mode_counts_without_raising(mesh, caplog):
+    tree, expected = _tree(mesh)
+    tree["kernel"] = jax.device_put(
+        jnp.ones((8, 4)), NamedSharding(mesh, P())
+    )
+    auditor = ShardAuditor("warn", inject_fault=False)
+    with caplog.at_level(logging.WARNING):
+        assert auditor.audit(tree, expected, label="b1") == 1
+        assert auditor.audit(tree, expected, label="b1") == 1  # warned once
+    assert auditor.violations == 2
+    assert sum("mis-sharded" in r.message for r in caplog.records) == 1
+
+
+def test_error_names_path_and_both_specs(mesh):
+    tree, expected = _tree(mesh)
+    tree["kernel"] = jax.device_put(
+        jnp.ones((8, 4)), NamedSharding(mesh, P())
+    )
+    with pytest.raises(ShardAuditError) as exc:
+        ShardAuditor("raise", inject_fault=False).audit(
+            tree, expected, label="restore"
+        )
+    msg = str(exc.value)
+    assert "'fsdp'" in msg and "restore" in msg
+
+
+def test_host_numpy_leaves_are_skipped(mesh):
+    """Host-side leaves (checkpoint trees after state_to_host) carry no
+    .sharding — the audit passes over them rather than false-positive."""
+    _, expected = _tree(mesh)
+    host = {"kernel": np.ones((8, 4)), "scale": np.ones((4,))}
+    auditor = ShardAuditor("raise", inject_fault=False)
+    assert auditor.audit(host, expected, label="host") == 0
+
+
+def test_injected_fault_aborts(mesh):
+    """The chaos hand (FTC_FAULT_SHARD / inject_fault=True): ONE sharded
+    leaf is re-device_put as replicated before checking — a real
+    mis-sharded array aborts the raise-mode audit.  HEAD stays green
+    because injection is opt-in."""
+    tree, expected = _tree(mesh)
+    with pytest.raises(ShardAuditError):
+        ShardAuditor("raise", inject_fault=True).audit(
+            tree, expected, label="bench"
+        )
+
+
+def test_injected_fault_counts_in_warn_mode(mesh):
+    tree, expected = _tree(mesh)
+    auditor = ShardAuditor("warn", inject_fault=True)
+    assert auditor.audit(tree, expected, label="bench") == 1
+    # the hand fires once per auditor — the second pass is clean
+    assert auditor.audit(tree, expected, label="bench2") == 0
+
+
+def test_fault_env_arms_injection(mesh, monkeypatch):
+    monkeypatch.setenv("FTC_FAULT_SHARD", "1")
+    tree, expected = _tree(mesh)
+    with pytest.raises(ShardAuditError):
+        ShardAuditor("raise").audit(tree, expected, label="bench")
+
+
+def test_metrics_counters_increment(mesh):
+    before = metrics_snapshot()
+    tree, expected = _tree(mesh)
+    tree["kernel"] = jax.device_put(
+        jnp.ones((8, 4)), NamedSharding(mesh, P())
+    )
+    ShardAuditor("warn", inject_fault=False).audit(tree, expected, label="m")
+    after = metrics_snapshot()
+    assert after["checks_total"] == before["checks_total"] + 2
+    assert after["violations_total"] == before["violations_total"] + 1
+
+
+def test_bad_action_rejected():
+    with pytest.raises(ValueError):
+        ShardAuditor("explode")
+
+
+# ---- env / config wiring ---------------------------------------------------
+
+
+@pytest.mark.parametrize("value", ["", "0", "off", "false"])
+def test_from_env_off_values(value, monkeypatch):
+    monkeypatch.setenv("FTC_SHARD_AUDIT", value)
+    assert ShardAuditor.from_env() is None
+
+
+@pytest.mark.parametrize(
+    "value,action",
+    [("raise", "raise"), ("1", "raise"), ("on", "raise"), ("true", "raise"),
+     ("warn", "warn"), ("WARN", "warn")],
+)
+def test_from_env_on_values(value, action, monkeypatch):
+    monkeypatch.setenv("FTC_SHARD_AUDIT", value)
+    auditor = ShardAuditor.from_env()
+    assert auditor is not None and auditor.action == action
+
+
+def test_from_env_default_when_unset(monkeypatch):
+    monkeypatch.delenv("FTC_SHARD_AUDIT", raising=False)
+    assert ShardAuditor.from_env() is None
+    assert ShardAuditor.from_env(default="warn").action == "warn"
+
+
+def test_trainer_config_arms_auditor(monkeypatch):
+    monkeypatch.delenv("FTC_SHARD_AUDIT", raising=False)
+    model = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=2))
+    mesh = MeshSpec(dp=1, fsdp=1).build(jax.devices()[:1])
+
+    def build(**kw):
+        cfg = TrainConfig(
+            mode="lora", batch_size=2, seq_len=16, total_steps=2, **kw
+        )
+        return Trainer(model, cfg, mesh=mesh)
+
+    assert build(shard_audit="raise")._shard_auditor.action == "raise"
+    assert build(shard_audit="warn")._shard_auditor.action == "warn"
+    assert build(shard_audit="off")._shard_auditor is None
+    # the empty default inherits the env
+    assert build()._shard_auditor is None
+    monkeypatch.setenv("FTC_SHARD_AUDIT", "warn")
+    assert build()._shard_auditor.action == "warn"
+
+
+def test_trainer_state_audits_clean_after_init(monkeypatch):
+    """The real wiring end to end: a freshly initialised trainer state
+    (jit with out_shardings from the rule table) audits clean against
+    trainer._state_shardings — the exact check fit() runs at the
+    checkpoint/restore boundaries."""
+    monkeypatch.delenv("FTC_FAULT_SHARD", raising=False)
+    model = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=2))
+    mesh = MeshSpec(dp=1, fsdp=2).build(jax.devices()[:2])
+    cfg = TrainConfig(
+        mode="lora", batch_size=2, seq_len=16, total_steps=2,
+        shard_audit="raise",
+    )
+    trainer = Trainer(model, cfg, mesh=mesh)
+    state = trainer.init_state()
+    assert trainer._shard_auditor is not None
+    trainer._audit_state_sharding(state, "test-init")
+    assert trainer._shard_auditor.checks > 0
+    assert trainer._shard_auditor.violations == 0
+
+
+def test_trainer_resume_audits_clean(monkeypatch, tmp_path):
+    """Regression for the restore boundary: EVERY restored leaf must ride
+    ``reshard`` back onto the mesh — including the step scalar, which a
+    bare ``jnp.asarray`` commits to one default device instead of the rule
+    table's mesh-replicated spec.  The armed audit caught exactly that on
+    the first live resume; two devices keep the structural check honest
+    (on one device a SingleDeviceSharding is equivalent to replicated)."""
+    monkeypatch.delenv("FTC_FAULT_SHARD", raising=False)
+    from finetune_controller_tpu.data import synthetic_batches
+
+    model = PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=2))
+
+    def leg(total_steps):
+        mesh = MeshSpec(dp=1, fsdp=2).build(jax.devices()[:2])
+        cfg = TrainConfig(
+            mode="lora", batch_size=2, seq_len=16, total_steps=total_steps,
+            log_every=2, checkpoint_every=2, shard_audit="raise",
+        )
+        trainer = Trainer(model, cfg, mesh=mesh)
+        batches = synthetic_batches(
+            2, 16, model.vocab_size, task="increment"
+        )
+        trainer.fit(batches, str(tmp_path))
+        return trainer
+
+    leg(2)
+    # the second leg resumes from step_2 through the audited restore path;
+    # a raise-mode auditor makes any mis-sharded restored leaf fatal here
+    trainer = leg(4)
+    assert trainer._shard_auditor.checks > 0
+    assert trainer._shard_auditor.violations == 0
+
+
+# ---- sharding_for_tree upfront validation (satellite bugfix) ---------------
+
+
+def test_validate_spec_unknown_axis(mesh):
+    with pytest.raises(ShardingRuleError, match="bogus"):
+        validate_spec("a/kernel", (8, 4), P("bogus", None), mesh)
+
+
+def test_validate_spec_indivisible_dim(mesh):
+    # fsdp=2 cannot divide 7
+    with pytest.raises(ShardingRuleError, match="divisible"):
+        validate_spec("a/kernel", (7, 4), P("fsdp", None), mesh)
+
+
+def test_validate_spec_clean(mesh):
+    validate_spec("a/kernel", (8, 4), P("fsdp", None), mesh)
+    validate_spec("a/scale", (4,), P(), mesh)
+
+
+def test_sharding_for_tree_raises_upfront(mesh):
+    """The bug this satellite fixed: a rule naming an axis the mesh does
+    not define used to surface as a deep XLA partitioner error at compile
+    time; now sharding_for_tree validates every leaf upfront and raises
+    the typed error naming the offending path."""
+    bad = PartitionRules([(r".*", P("bogus", None))])
+    tree = {"layer": {"kernel": jnp.ones((8, 4))}}
+    with pytest.raises(ShardingRuleError, match="layer/kernel"):
+        sharding_for_tree(tree, mesh, bad)
+
+
+def test_sharding_for_tree_rejects_indivisible(mesh):
+    bad = PartitionRules([(r".*", P("fsdp", None))])
+    tree = {"kernel": jnp.ones((7, 4))}
+    with pytest.raises(ShardingRuleError, match="divisible"):
+        sharding_for_tree(tree, mesh, bad)
+
+
+def test_llama_rules_validate_on_test_mesh(mesh):
+    """The shipped table stays applicable to the tiny preset on the CPU
+    test mesh — the runtime twin of the shard-divisibility lint rule."""
+    model = LlamaForCausalLM(PRESETS["tiny-test"].replace(
+        lora=LoRAConfig(rank=2)
+    ))
+    variables = jax.eval_shape(
+        model.init, {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    shardings = sharding_for_tree(variables, mesh, LLAMA_RULES)
+    assert all(
+        isinstance(s, NamedSharding) for s in jax.tree.leaves(
+            shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+        )
+    )
