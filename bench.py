@@ -838,7 +838,7 @@ def _measure_sched() -> dict:
 def _measure_dpo() -> dict:
     """BENCH_MODE=dpo: the preference-optimization gates (ISSUE 8).
 
-    Two gated legs on the tiny CPU-runnable config:
+    Gated legs on the tiny CPU-runnable config (the third is ISSUE 19's):
 
     1. **DPO** — train on the seeded synthetic preference set
        (``data/preference.py``): the reward margin must STRICTLY increase
@@ -849,9 +849,13 @@ def _measure_dpo() -> dict:
        the learner commit N+1, and the actor reload N+1 within one rollout
        round — all inside the serve engine's existing compile budget (the
        armed RecompileGuard raises otherwise).
+    3. **Disaggregated overlap** — one real remote rollout worker: its
+       decode throughput while the learner steps concurrently must hold
+       >= 0.9x its unloaded rate (records-only below 4 cores, per the
+       ``gates_enforced`` convention).
 
     Knobs: BENCH_STEPS (DPO optimizer steps), BENCH_BATCH, BENCH_SEQ,
-    BENCH_DPO_BETA, BENCH_DPO_EVAL_BATCHES.
+    BENCH_DPO_BETA, BENCH_DPO_EVAL_BATCHES, BENCH_DPO_OVERLAP_TOKENS.
     """
     import numpy as np
 
@@ -968,6 +972,101 @@ def _measure_dpo() -> dict:
         )
     loop_margins = [float(r["reward_margin"]) for r in rows]
 
+    # --- disaggregated overlap leg (docs/preference.md §Disaggregated) ----
+    # One REAL remote rollout worker; the gate: its decode throughput while
+    # the learner steps concurrently must hold >= 0.9x its unloaded rate.
+    # Enforced only with >= 4 cores (worker + learner need separate cores);
+    # below that the numbers are recorded, not gated (`gates_enforced`).
+    from finetune_controller_tpu.prefs.learner import (  # noqa: F811
+        RolloutConfig as _RC,
+    )
+    from finetune_controller_tpu.prefs.rollout_plane import (
+        build_remote_rlhf_loop,
+    )
+
+    overlap_enforced = (os.cpu_count() or 1) >= 4
+    min_tokens = int(os.environ.get("BENCH_DPO_OVERLAP_TOKENS", "300"))
+    overlap_cfg = TrainConfig(
+        task="rlhf", dpo_beta=beta, batch_size=4, seq_len=seq,
+        total_steps=10**9, warmup_steps=1, learning_rate=1e-3,
+        log_every=10**9, checkpoint_every=10**9, prefetch=0,
+        heartbeat_interval_s=0, rollout_workers=1,
+    )
+    ov_learner = DPOTrainer(model_cfg, overlap_cfg)
+    with tempfile.TemporaryDirectory(prefix="ftc_dpo_overlap_") as d:
+        stream, plane, _buf = build_remote_rlhf_loop(
+            ov_learner, d,
+            rollout=_RC(
+                pairs_per_round=6, min_fill=6, buffer_capacity=256,
+                max_new_tokens=8, slots=4, temperature=0.9,
+            ),
+            model_spec={"preset": preset, "lora": {"rank": 8}},
+        )
+        try:
+            ov_state = ov_learner.init_state()
+            b = next(stream)  # waits for the worker's first rounds
+            ov_state, m = ov_learner.step(ov_state, b)
+            float(m["reward_margin"])  # compile outside both windows
+
+            def _decode_window(step_fn, timeout_s: float):
+                # windowed decode rate from the worker's own cumulative
+                # counters (tokens / seconds spent inside generate_pairs)
+                s0 = plane.stats()
+                k0 = s0["rollout_actor_tokens_generated"]
+                deadline = time.monotonic() + timeout_s
+                steps_done = 0
+                while time.monotonic() < deadline:
+                    st = plane.stats()
+                    if st["rollout_actor_tokens_generated"] - k0 >= min_tokens:
+                        break
+                    if step_fn is not None:
+                        step_fn()
+                        steps_done += 1
+                    else:
+                        time.sleep(0.05)
+                s1 = plane.stats()
+                dtok = s1["rollout_actor_tokens_generated"] - k0
+                dsec = (s1["rollout_actor_generate_seconds"]
+                        - s0["rollout_actor_generate_seconds"])
+                return dtok / max(dsec, 1e-9), dtok, steps_done
+
+            rate_unloaded, tok_a, _ = _decode_window(None, 90.0)
+
+            def _one_step():
+                bb = next(stream)
+                ov = ov_learner.step(_one_step.state, bb)
+                _one_step.state = ov[0]
+                float(ov[1]["reward_margin"])  # sync
+
+            _one_step.state = ov_state
+            rate_loaded, tok_b, learner_steps = _decode_window(
+                _one_step, 180.0
+            )
+        finally:
+            plane.close()
+    overlap_ratio = rate_loaded / max(rate_unloaded, 1e-9)
+    if overlap_enforced:
+        if tok_a < min_tokens or tok_b < min_tokens:
+            fail(
+                "dpo bench: remote worker generated too few tokens to "
+                "measure the overlap windows",
+                unloaded_tokens=tok_a, loaded_tokens=tok_b,
+                min_tokens=min_tokens,
+            )
+        if learner_steps < 2:
+            fail(
+                "dpo bench: learner made too few concurrent steps to prove "
+                "overlap", learner_steps=learner_steps,
+            )
+        if overlap_ratio < 0.9:
+            fail(
+                "dpo bench: remote actor decode rate collapsed under "
+                "concurrent learner steps",
+                rate_unloaded=round(rate_unloaded, 1),
+                rate_loaded=round(rate_loaded, 1),
+                ratio=round(overlap_ratio, 3),
+            )
+
     return {
         "metric": f"dpo_heldout_accuracy[{preset},bs{batch},seq{seq},"
                   f"steps{steps},beta{beta:g}]",
@@ -987,6 +1086,16 @@ def _measure_dpo() -> dict:
             "engine_compile_budget": actor.compile_budget,
             "loop_margins": [round(m, 4) for m in loop_margins],
             "buffer_depth": buffer.depth,
+        },
+        "rollout_overlap": {
+            "rate_unloaded_tok_s": round(rate_unloaded, 1),
+            "rate_loaded_tok_s": round(rate_loaded, 1),
+            "ratio": round(overlap_ratio, 3),
+            "unloaded_tokens": tok_a,
+            "loaded_tokens": tok_b,
+            "learner_steps_concurrent": learner_steps,
+            "gates_enforced": overlap_enforced,
+            "cpu_count": os.cpu_count(),
         },
         "device_kind": jax.devices()[0].device_kind,
     }

@@ -385,7 +385,34 @@ async def cmd_serve(client: Client, ns: argparse.Namespace) -> int:
 
 async def cmd_metrics(client: Client, ns: argparse.Namespace) -> int:
     body = await client.get(f"/jobs/{ns.job_id}/metrics")
-    _print_json(body.get("records", body))
+    records = body.get("records", body)
+    _print_json(records)
+    # rlhf rollout-plane health one-liner from the newest row: actor tok/s +
+    # buffer depth/staleness, plus the remote-fleet triple when the job runs
+    # disaggregated actors (docs/preference.md §Disaggregated rollouts)
+    last = records[-1] if isinstance(records, list) and records else None
+    if isinstance(last, dict) and last.get("actor_tokens_per_sec") \
+            not in (None, ""):
+        def num(key: str) -> float | None:
+            try:
+                return float(last[key])
+            except (KeyError, TypeError, ValueError):
+                return None
+
+        parts = [
+            f"actor {num('actor_tokens_per_sec') or 0:.1f} tok/s "
+            f"@v{int(num('actor_version') or 0)}",
+            f"buffer depth {int(num('rollout_buffer_depth') or 0)} "
+            f"staleness {num('rollout_staleness') or 0:.1f} ckpt",
+        ]
+        workers = num("rollout_workers_alive")
+        if workers is not None:
+            parts.append(
+                f"workers {int(workers)} alive "
+                f"(respawns {int(num('rollout_respawns_total') or 0)}, "
+                f"dup pairs {int(num('rollout_dup_pairs_total') or 0)})"
+            )
+        print(f"rollout: {'  '.join(parts)}")
     return 0
 
 

@@ -118,6 +118,27 @@ class RLHFArguments(DPOArguments):
         4, ge=1, le=256,
         description="Decode lanes of the actor's serve engine",
     )
+    rollout_workers: int = Field(
+        0, ge=0, le=64,
+        description="Remote rollout actor processes (0 = the in-process "
+                    "actor/learner gang; > 0 selects the disaggregated "
+                    "data plane — docs/preference.md §Disaggregated "
+                    "rollouts)",
+    )
+    rollout_reward_host: str = Field(
+        "", description="Served reward model host the remote actors score "
+                        "against (empty = programmatic increment reward)",
+    )
+    rollout_reward_port: int = Field(
+        0, ge=0, le=65535,
+        description="Served reward model port (0 = programmatic reward)",
+    )
+
+
+class RewardModelArguments(DPOArguments):
+    """Hyperparameters of a ``task: reward`` job: the DPO data-path knobs
+    train a Bradley–Terry scalar head on the policy trunk
+    (``prefs/reward_trainer.py``); β is ignored by the objective."""
 
 
 class TinyLlamaLoRA(BaseFineTuneJob):
@@ -366,6 +387,26 @@ class TinyRLHFTest(BaseFineTuneJob):
     training_arguments: RLHFArguments
 
 
+class TinyRewardTest(BaseFineTuneJob):
+    """Reward-model smoke spec: Bradley–Terry head + LoRA trunk trained on
+    the synthetic preference pairs; promotable and servable as the rlhf
+    actors' scoring endpoint (``reward_score`` RPC)."""
+
+    model_name = "tiny-reward-test"
+    description = "2-layer test model; Bradley–Terry reward-model smoke spec"
+    task = TrainingTask.REWARD
+    model_preset = "tiny-test"
+    default_device = "cpu-test"
+    promotion_path = "models/tiny-test"
+    dataset = TrainingDataset(
+        required=False,
+        description="preference jsonl: {prompt, chosen, rejected} rows "
+                    "(omitted = seeded synthetic pairs)",
+    )
+
+    training_arguments: RewardModelArguments
+
+
 class TinyTestLoRA(BaseFineTuneJob):
     """Milliseconds-scale spec used by the e2e lifecycle tests."""
 
@@ -397,6 +438,7 @@ BUILTIN_JOB_SPECS: list[type[BaseFineTuneJob]] = [
     TinyMMTestLoRA,
     TinyDPOTest,
     TinyRLHFTest,
+    TinyRewardTest,
 ]
 
 

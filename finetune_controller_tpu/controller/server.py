@@ -1238,7 +1238,7 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
     # health check.  Bounded cardinality: active preference jobs only.
     dpo_jobs = [
         j for j in active_jobs
-        if (j.metadata or {}).get("task") in ("dpo", "rlhf")
+        if (j.metadata or {}).get("task") in ("dpo", "rlhf", "reward")
     ]
     if dpo_jobs:
         dpo_gauges = (
@@ -1247,6 +1247,13 @@ async def prometheus_metrics(request: web.Request) -> web.Response:
             ("ftc_dpo_rollout_buffer_depth", "rollout_buffer_depth"),
             ("ftc_dpo_rollout_staleness", "rollout_staleness"),
             ("ftc_dpo_actor_tokens_per_sec", "actor_tokens_per_sec"),
+            # disaggregated data plane (docs/preference.md §Disaggregated
+            # rollouts): remote-actor fleet health, absent on in-process
+            # rlhf rows and skipped by the column guard below
+            ("ftc_rollout_workers_alive", "rollout_workers_alive"),
+            ("ftc_rollout_respawns_total", "rollout_respawns_total"),
+            ("ftc_rollout_dup_pairs_total", "rollout_dup_pairs_total"),
+            ("ftc_rollout_actor_version", "actor_version"),
         )
         rows: dict[str, dict] = {}
         for job in dpo_jobs:

@@ -39,8 +39,13 @@ class TrainingTask(str, enum.Enum):
     #: Direct Preference Optimization over (chosen, rejected) pairs
     DPO = "dpo"
     #: RLHF-lite: actor/learner gang — the serve engine generates on-policy
-    #: rollouts that feed the DPO learner
+    #: rollouts that feed the DPO learner.  ``rollout_workers > 0``
+    #: disaggregates the actors into remote worker processes
+    #: (docs/preference.md §Disaggregated rollouts)
     RLHF = "rlhf"
+    #: Bradley–Terry reward model: a scalar head on the DPO data path,
+    #: servable on the fleet as the rlhf actors' scoring endpoint
+    REWARD = "reward"
 
 
 def known_tasks() -> list[str]:
@@ -205,9 +210,11 @@ class BaseFineTuneJob(BaseModel):
         training = {
             "mode": "lora" if self.framework != TrainingFramework.JAX_FULL else "full",
         }
-        preference = self.task in (TrainingTask.DPO, TrainingTask.RLHF)
+        preference = self.task in (
+            TrainingTask.DPO, TrainingTask.RLHF, TrainingTask.REWARD,
+        )
         if preference:
-            # select the DPO/rlhf trainer (prefs/, docs/preference.md)
+            # select the DPO/rlhf/reward trainer (prefs/, docs/preference.md)
             training["task"] = self.task.value
         # Lift known trainer knobs out of the user arguments.
         for key in (
@@ -225,12 +232,17 @@ class BaseFineTuneJob(BaseModel):
                 args.pop("beta")  # meaningless for SFT; don't fail the run
         rollout: dict[str, Any] = {}
         if self.task is TrainingTask.RLHF:
+            # remote actor count is a TRAINER knob (TrainConfig — it selects
+            # the disaggregated data plane), not a RolloutConfig field
+            if "rollout_workers" in args:
+                training["rollout_workers"] = args.pop("rollout_workers")
             # actor/learner loop knobs (prefs/learner.py::RolloutConfig)
             for key in (
                 "rollout_pairs_per_round", "rollout_buffer_capacity",
                 "rollout_min_fill", "rollout_staleness_checkpoints",
                 "rollout_temperature", "rollout_top_k",
                 "rollout_max_new_tokens", "rollout_slots",
+                "rollout_reward_host", "rollout_reward_port",
             ):
                 if key in args:
                     rollout[key[len("rollout_"):]] = args.pop(key)

@@ -139,6 +139,34 @@ class SpanRecorder:
             logger.log(level, "span write to %s failed (%d so far)",
                        self.path, failures, exc_info=True)
 
+    def record(self, name: str, *, start_ns: int, end_ns: int,
+               status: str = "ok", **attrs: Any) -> dict[str, Any]:
+        """Append an already-timed span (e.g. one a rollout worker stamped
+        with its own ``time.time_ns`` and shipped over the transport) — same
+        crash-safe JSONL write as :meth:`finish`, but the interval is the
+        caller's, not this recorder's clock."""
+        span = make_span(
+            name, self.trace_id,
+            start_ns=int(start_ns), end_ns=int(end_ns), status=status,
+            service=self.service, attempt=self.attempt or None, **attrs,
+        )
+        if not self.enabled:
+            return span
+        try:
+            with self._lock:
+                os.makedirs(self.dir, exist_ok=True)
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(span) + "\n")
+                    f.flush()
+        except OSError:
+            with self._lock:
+                self.write_failures += 1
+                failures = self.write_failures
+            level = logging.WARNING if failures == 1 else logging.DEBUG
+            logger.log(level, "span write to %s failed (%d so far)",
+                       self.path, failures, exc_info=True)
+        return span
+
     class _SpanCtx:
         def __init__(self, recorder: "SpanRecorder", span: dict):
             self.recorder, self.span = recorder, span

@@ -84,10 +84,13 @@ class RolloutActor:
         self,
         model: object,                      # the policy model (LoRA config)
         base_params: dict,                  # frozen base "params" tree
-        ckpt_dir: str,
+        ckpt_dir: str | None,
         *,
         reward_fn: Callable[[list[int], list[int]], float],
         prompts: Iterator[list[int]],
+        batch_reward_fn: Callable[
+            [list[tuple[list[int], list[int]]]], list[float]
+        ] | None = None,
         oracle_fn: Callable[[list[int], int], list[int]] | None = None,
         state_template: dict | None = None,
         prompt_bucket: int = 0,
@@ -100,8 +103,18 @@ class RolloutActor:
         self._model = model
         self._model_cfg = model.cfg
         self._base_params = base_params
-        self._ckpt = CheckpointManager(ckpt_dir, keep=10**9)  # reader: no gc
+        #: None = push mode (the remote rollout worker): the learner SHIPS
+        #: adapter deltas through :meth:`install_policy` instead of the actor
+        #: polling a shared checkpoint directory it cannot see
+        self._ckpt = (
+            CheckpointManager(ckpt_dir, keep=10**9)  # reader: no gc
+            if ckpt_dir else None
+        )
         self._reward_fn = reward_fn
+        #: one-RPC-per-round scoring (the remote reward model): all 2n
+        #: candidates of a round score in a single batched call; falls back
+        #: to per-pair ``reward_fn`` when unset
+        self._batch_reward_fn = batch_reward_fn
         self._prompts = prompts
         #: cold-start escape hatch: a freshly-initialised policy samples
         #: near-uniform noise, so both candidates often score 0.0 and tie —
@@ -173,6 +186,8 @@ class RolloutActor:
         Variables are an ARGUMENT of the engine's compiled fns, so this
         never recompiles — shapes are identical across checkpoints.
         """
+        if self._ckpt is None:
+            return False  # push mode: install_policy is the only reload path
         latest = self._ckpt.latest_step()
         if latest is None or latest == self.version:
             return False
@@ -181,6 +196,23 @@ class RolloutActor:
         self.version = latest
         self.reloads += 1
         logger.info("actor reloaded policy from checkpoint step %d", latest)
+        return True
+
+    def install_policy(self, version: int, lora_tree: dict | None) -> bool:
+        """Push-mode rollover: install a learner-shipped adapter delta.
+
+        Idempotent and monotonic — a re-delivered or stale push (version ≤
+        the installed one) is a no-op, so the learner may re-push after a
+        respawn without version checks of its own.  Same zero-recompile
+        in-place swap as :meth:`maybe_reload`.
+        """
+        version = int(version)
+        if version <= self.version:
+            return False
+        self._engine.variables = self._merge(dict(lora_tree or {}))
+        self.version = version
+        self.reloads += 1
+        logger.info("actor installed pushed policy version %d", version)
         return True
 
     @property
@@ -221,12 +253,31 @@ class RolloutActor:
         self.generate_seconds += time.perf_counter() - t0
         pairs: list[PreferencePair] = []
         scored: list[tuple[list[int], list[list[int]], list[float]]] = []
+        all_outs: list[list[list[int]]] = []
         for i, prompt in enumerate(prompts):
             outs = [
                 results[f"r{self.rounds}p{i}c{c}"].generated for c in (0, 1)
             ]
             self.tokens_generated += sum(len(o) for o in outs)
-            rewards = [self._reward_fn(prompt, o) for o in outs]
+            all_outs.append(outs)
+        if self._batch_reward_fn is not None:
+            # one batched scoring call for the whole round's 2n candidates
+            # (one RPC when the reward model serves remotely)
+            flat = self._batch_reward_fn([
+                (prompt, out)
+                for prompt, outs in zip(prompts, all_outs) for out in outs
+            ])
+            all_rewards = [
+                [float(flat[2 * i]), float(flat[2 * i + 1])]
+                for i in range(len(prompts))
+            ]
+        else:
+            all_rewards = [
+                [self._reward_fn(p, o) for o in outs]
+                for p, outs in zip(prompts, all_outs)
+            ]
+        for i, prompt in enumerate(prompts):
+            outs, rewards = all_outs[i], all_rewards[i]
             scored.append((prompt, outs, rewards))
             if rewards[0] == rewards[1]:
                 continue

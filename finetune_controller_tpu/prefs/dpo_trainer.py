@@ -74,10 +74,12 @@ class DPOTrainer(Trainer):
         #: host-side metrics provider for the rlhf learner (rollout buffer
         #: depth/staleness, actor tok/s) — merged into every logged row
         self.rollout_stats_fn = None
-        if train_cfg.task == "rlhf":
-            # the actor only sees COMMITTED checkpoints; synchronous commits
-            # bound its policy lag deterministically (one round), where an
-            # async save could land arbitrarily many rollout rounds late
+        if train_cfg.task == "rlhf" \
+                and not getattr(train_cfg, "rollout_workers", 0):
+            # IN-PROCESS loop only.  The actor only sees COMMITTED
+            # checkpoints; synchronous commits bound its policy lag
+            # deterministically (one round), where an async save could land
+            # arbitrarily many rollout rounds late
             self._blocking_checkpoints = True
             if train_cfg.prefetch:
                 # the rollout stream RUNS the actor inside next(): a
@@ -88,6 +90,12 @@ class DPOTrainer(Trainer):
                 # covered
                 logger.info("rlhf task: forcing prefetch=0 (actor runs inline)")
                 train_cfg.prefetch = 0
+        # remote rollout workers (rollout_workers > 0) keep BOTH: actors
+        # decode in their own processes, so prefetch threads never touch the
+        # learner's engine, and async checkpoint commits are safe — the
+        # plane pushes a policy only after latest_step() reports it durable.
+        # That async overlap is the whole point of disaggregation
+        # (docs/preference.md §Disaggregated rollouts).
 
     # ---- objective -------------------------------------------------------
 
@@ -145,6 +153,11 @@ class DPOTrainer(Trainer):
                 "rollout_buffer_depth", "rollout_staleness",
                 "actor_tokens_per_sec", "actor_version",
             )
+            if getattr(self.cfg, "rollout_workers", 0):
+                fields += (
+                    "rollout_workers_alive", "rollout_respawns_total",
+                    "rollout_dup_pairs_total",
+                )
         return fields
 
     def _row_extras(self) -> dict:

@@ -59,6 +59,13 @@ class RolloutConfig:
     #: proceeds on a partially-filled buffer (or fails loudly on an empty
     #: one) — the liveness backstop for converged/wedged policies
     max_empty_rounds: int = 25
+    #: learned reward model endpoint (remote rollout workers only): when
+    #: ``reward_port`` is set, actors score candidates through the served
+    #: reward model's batched ``reward_score`` RPC instead of the
+    #: programmatic increment reward (docs/preference.md §Disaggregated
+    #: rollouts)
+    reward_host: str = ""
+    reward_port: int = 0
 
     _ENV_FIELDS = {
         "pairs_per_round": "FTC_RLHF_PAIRS_PER_ROUND",
@@ -69,6 +76,8 @@ class RolloutConfig:
         "top_k": "FTC_RLHF_TOP_K",
         "max_new_tokens": "FTC_RLHF_MAX_NEW_TOKENS",
         "slots": "FTC_RLHF_SLOTS",
+        "reward_host": "FTC_RLHF_REWARD_HOST",
+        "reward_port": "FTC_RLHF_REWARD_PORT",
     }
 
     def apply_env_overrides(self) -> "RolloutConfig":

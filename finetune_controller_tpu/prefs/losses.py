@@ -47,6 +47,59 @@ def masked_sequence_logprobs(
     return (tok_lp * mask).sum(axis=-1)
 
 
+def reward_scores(
+    logits: jax.Array,
+    tokens: jax.Array,
+    loss_mask: jax.Array,
+    head: dict[str, jax.Array],
+) -> jax.Array:
+    """Scalar reward per sequence from a head over the policy trunk's logits.
+
+    The head is ``{"a": (), "w": (V,), "b": ()}``: the score is
+
+        a * mean masked target logprob  +  pooled_logits @ w  +  b
+
+    where ``pooled_logits`` is the masked mean over completion positions of
+    the (f32) logit rows.  With the init used by
+    :class:`~.reward_trainer.RewardModelTrainer` (``a=1, w=0, b=0``) the
+    step-0 score IS the mean completion likelihood — the DPO implicit-reward
+    feature — so Bradley–Terry training starts from a proven ranking signal
+    and learns the residual through ``w`` and the LoRA trunk.
+    """
+    targets = tokens[:, 1:]
+    lg = logits[:, :-1].astype(jnp.float32)
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    tok_lp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    count = jnp.maximum(mask.sum(axis=-1), 1.0)
+    mean_lp = (tok_lp * mask).sum(axis=-1) / count
+    pooled = (lg * mask[..., None]).sum(axis=1) / count[:, None]  # (B, V)
+    return head["a"] * mean_lp + pooled @ head["w"] + head["b"]
+
+
+def bradley_terry_loss(
+    chosen_scores: jax.Array, rejected_scores: jax.Array
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Pairwise Bradley–Terry objective over scalar rewards, both (B,) f32:
+
+        loss = -log sigmoid(s_chosen - s_rejected)
+
+    — the standard reward-model loss (InstructGPT / RLHF practice).  Metrics:
+    ``bt_accuracy`` is the fraction of pairs ranked correctly (the number the
+    reward job's held-out gate reads), ``reward_margin`` the mean score gap.
+    """
+    margin = chosen_scores - rejected_scores
+    loss = -jax.nn.log_sigmoid(margin).mean()
+    metrics = {
+        "loss": loss,
+        "reward_margin": margin.mean(),
+        "bt_accuracy": (margin > 0).astype(jnp.float32).mean(),
+        "score_chosen": chosen_scores.mean(),
+        "score_rejected": rejected_scores.mean(),
+    }
+    return loss, metrics
+
+
 def dpo_loss(
     policy_chosen_lp: jax.Array,
     policy_rejected_lp: jax.Array,

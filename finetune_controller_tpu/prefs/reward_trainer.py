@@ -1,0 +1,165 @@
+"""Learned reward model: a scalar head trained on the DPO data path.
+
+``TrainConfig.task == "reward"`` rides the whole SFT/DPO machinery — sharded
+init, jitted step with donation/grad-accum, checkpoints, elastic resume,
+eval cadence, metrics CSV — and swaps only the trainable tree and the loss:
+
+* **trainable** = ``{"lora": <adapter>, "head": {"a", "w", "b"}}`` — the
+  policy trunk's LoRA adapter plus a tiny scalar head over the logits
+  (``prefs/losses.py::reward_scores``).  At init ``a=1, w=0, b=0``, so the
+  step-0 score is exactly the mean completion likelihood — the DPO
+  implicit-reward feature — and Bradley–Terry training starts from a proven
+  ranking signal instead of noise;
+* **loss** = pairwise Bradley–Terry over the stacked (2B, S) forward
+  (``prefs/losses.py::bradley_terry_loss``), the same one-forward pair
+  stacking the DPO trainer uses.
+
+The batch contract is the DPO one (``data/preference.py``): chosen/rejected
+token+mask quadruples, so the same synthetic/JSONL pipelines feed both
+objectives unchanged.
+
+Serving: :meth:`export_artifacts` ships the trunk adapter exactly like a
+DPO job (PEFT layout — the registry can multiplex it) plus
+``reward_head.msgpack``; the serve worker rebuilds the trunk through the
+normal ``deploy_dir`` builder and answers the batched ``reward_score`` RPC
+with :class:`~.rollout_plane.RewardScorer` (docs/preference.md
+§Disaggregated rollouts).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax.numpy as jnp
+
+from ..train.trainer import Trainer
+from .losses import bradley_terry_loss, reward_scores
+
+logger = logging.getLogger(__name__)
+
+REWARD_HEAD_FILENAME = "reward_head.msgpack"
+
+
+class RewardModelTrainer(Trainer):
+    """Bradley–Terry reward model (``TrainConfig.task == "reward"``).
+
+    Same construction-time restrictions as DPO, for the same reasons: LoRA
+    mode only (the head + adapter is what keeps the export an adapter-sized
+    artifact), dense text models, no pipeline parallelism.
+    """
+
+    def __init__(self, model_cfg, train_cfg, mesh=None, **kw):
+        if train_cfg.mode != "lora":
+            raise ValueError(
+                "task='reward' requires mode='lora': the reward model is "
+                "the policy trunk's adapter plus a scalar head"
+            )
+        if getattr(model_cfg, "n_experts", 0):
+            raise ValueError("reward training does not support MoE configs")
+        if getattr(model_cfg, "vision", None) is not None:
+            raise ValueError("reward training supports text models only")
+        super().__init__(model_cfg, train_cfg, mesh=mesh, **kw)
+        if self._pp > 1:
+            raise ValueError(
+                "reward training does not support pipeline parallelism"
+            )
+
+    # ---- trainable tree ---------------------------------------------------
+
+    def _split(self, variables):
+        frozen, lora = super()._split(variables)
+        # runs inside the jitted sharded init: head leaves pick up the rule
+        # table's `.*` replicated fallback (scalars and a (V,) vector — no
+        # weight-like names, nothing worth sharding)
+        head = {
+            "a": jnp.ones((), jnp.float32),
+            "w": jnp.zeros((self.model_cfg.vocab_size,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32),
+        }
+        return frozen, {"lora": lora, "head": head}
+
+    def _assemble(self, frozen, trainable):
+        # model variables take only the trunk adapter; the head never enters
+        # model.apply — it consumes the logits downstream
+        return super()._assemble(frozen, trainable["lora"])
+
+    # ---- objective --------------------------------------------------------
+
+    def _reward_metrics(self, trainable, frozen, batch, dropout_rng=None):
+        b = batch["chosen_tokens"].shape[0]
+        tokens = jnp.concatenate(
+            [batch["chosen_tokens"], batch["rejected_tokens"]], axis=0
+        )
+        masks = jnp.concatenate(
+            [batch["chosen_mask"], batch["rejected_mask"]], axis=0
+        )
+        variables = self._assemble(frozen, trainable)
+        rngs = (
+            {"dropout": dropout_rng}
+            if (self._use_dropout and dropout_rng is not None) else None
+        )
+        logits = self.model.apply(
+            variables, tokens, deterministic=rngs is None, rngs=rngs,
+        )
+        scores = reward_scores(logits, tokens, masks, trainable["head"])
+        loss, metrics = bradley_terry_loss(scores[:b], scores[b:])
+        # fit()'s log line and the eval_* naming expect loss/accuracy keys;
+        # accuracy IS pairwise ranking accuracy for a reward model (the
+        # held-out number the promotion gate reads)
+        metrics["accuracy"] = metrics["bt_accuracy"]
+        return loss, metrics
+
+    def _loss_fn(self, trainable, frozen, batch, dropout_rng):
+        return self._reward_metrics(trainable, frozen, batch, dropout_rng)
+
+    def _eval_step(self, state, batch: dict):
+        """Forward-only Bradley–Terry metrics on held-out pairs."""
+        _, metrics = self._reward_metrics(state.trainable, state.frozen, batch)
+        return metrics
+
+    def _writer_extra_fields(self, eval_enabled: bool) -> tuple[str, ...]:
+        fields = super()._writer_extra_fields(eval_enabled)
+        if eval_enabled:
+            fields += ("eval_reward_margin", "eval_bt_accuracy")
+        return fields
+
+    # ---- export -----------------------------------------------------------
+
+    def export_artifacts(self, state, artifacts_dir: str,
+                         pretrained_dir: str | None = None) -> None:
+        """Adapter export (the trunk, PEFT layout — same path as every LoRA
+        job) plus the head as ``reward_head.msgpack`` at the artifact root.
+        The head also lives in every checkpoint's trainable tree, so serve
+        workers staging only spec+checkpoints can restore it without this
+        file (``rollout_plane.RewardScorer.from_artifacts``)."""
+        import jax
+        import numpy as np
+        from flax import serialization
+
+        # collective — every rank calls; rank 0 writes
+        host = self.state_to_host(state, fields=("trainable",))
+        if jax.process_index() != 0:
+            return
+        head = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)),
+            dict(host["trainable"]["head"]),
+        )
+        path = os.path.join(artifacts_dir, REWARD_HEAD_FILENAME)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(serialization.msgpack_serialize(head))
+        os.replace(tmp, path)
+        scan = self.model_cfg.scan_layers
+        if not scan:
+            logger.warning(
+                "HF adapter export supports the scanned layer layout only: "
+                "reward job exported the head but no adapter"
+            )
+            return
+        from ..models.hf_export import export_lora_adapter
+
+        export_lora_adapter(
+            self.model_cfg, host["trainable"]["lora"],
+            f"{artifacts_dir}/adapter",
+        )

@@ -39,6 +39,11 @@ logger = logging.getLogger(__name__)
 #: unless named in FTC_SCHED_QUEUES)
 SERVE_QUEUE = "serve"
 
+#: default queue for remote rlhf rollout actor workloads (``owner="rollout"``
+#: — the disaggregated data plane's serve-fleet tenants,
+#: docs/preference.md §Disaggregated rollouts)
+ROLLOUT_QUEUE = "rollout"
+
 
 class ServeScalePolicy:
     """Queue-depth pressure → target replica count, with hysteresis.
@@ -121,12 +126,17 @@ class ServeTenant:
         policy: ServeScalePolicy | None = None,
         drive_admission: bool = False,
         queue_depth_fn=None,
+        owner: str = "serve",
     ):
         self.scheduler = scheduler
         self.fleet = fleet
         self.flavor = flavor
         self.queue = queue
         self.priority = priority
+        #: scheduler workload tag — ``take_preemptions(owner=...)`` routes
+        #: reclaim decisions to the tenant that owns them; the rollout
+        #: tenant reuses this class's machinery under ``owner="rollout"``
+        self.owner = owner
         self.policy = policy or ServeScalePolicy()
         #: run ``try_admit`` inside :meth:`tick` (standalone scheduler);
         #: False when a backend's own tick drives admission
@@ -168,10 +178,10 @@ class ServeTenant:
             self._workloads[wid].replica_id = replica.replica_id
 
     def _submit_workload(self) -> str:
-        wid = f"serve-{self.fleet.job_id}-w{next(self._wl_seq)}"
+        wid = f"{self.owner}-{self.fleet.job_id}-w{next(self._wl_seq)}"
         self.scheduler.submit(
             wid, self.flavor, 1,
-            queue=self.queue, priority=self.priority, owner="serve",
+            queue=self.queue, priority=self.priority, owner=self.owner,
         )
         self._workloads[wid] = _ReplicaWorkload(workload_id=wid)
         return wid
@@ -189,7 +199,7 @@ class ServeTenant:
         #    release so the preemptor admits on the next scheduler pass
         take = getattr(self.scheduler, "take_preemptions", None)
         if take is not None:
-            for decision in take(owner="serve"):
+            for decision in take(owner=self.owner):
                 await self._drain_workload(
                     decision.job_id,
                     reason=f"preempted for {decision.preemptor_id or 'reclaim'}",
@@ -309,5 +319,78 @@ class ServeTenant:
             "flavor": self.flavor,
             "scale_ups_total": self.scale_ups_total,
             "scale_downs_total": self.scale_downs_total,
+            "preempted_total": self.preempted_total,
+        }
+
+
+class RolloutTenant:
+    """Remote rlhf rollout actors as ``owner="rollout"`` scheduler tenants.
+
+    The :class:`~finetune_controller_tpu.prefs.rollout_plane.RolloutPlane`
+    owns worker LIFECYCLE (spawn, respawn, policy push); this tenant owns
+    only their chips accounting: one workload per rollout worker in the
+    rollout queue, preemptible like serve capacity.  No autoscale policy —
+    the worker count is the job spec's ``rollout_workers`` — so the tick is
+    just preemption intake: a reclaimed workload's worker id is handed back
+    for the plane to stop (its learner keeps stepping on buffered pairs;
+    respawn happens when the scheduler re-admits).
+    """
+
+    def __init__(self, scheduler, job_id: str, *, flavor: str,
+                 queue: str = ROLLOUT_QUEUE, priority: object = "low"):
+        self.scheduler = scheduler
+        self.job_id = job_id
+        self.flavor = flavor
+        self.queue = queue
+        self.priority = priority
+        #: workload id → worker id, one per remote rollout actor
+        self._workloads: dict[str, str] = {}
+        self.preempted_total = 0
+
+    def submit(self, worker_id: str) -> str:
+        wid = f"rollout-{self.job_id}-{worker_id}"
+        self.scheduler.submit(
+            wid, self.flavor, 1,
+            queue=self.queue, priority=self.priority, owner="rollout",
+        )
+        self._workloads[wid] = worker_id
+        return wid
+
+    def is_admitted(self, worker_id: str) -> bool:
+        return self.scheduler.is_admitted(
+            f"rollout-{self.job_id}-{worker_id}"
+        )
+
+    def tick(self) -> dict[str, Any]:
+        """Preemption intake: worker ids whose chips the scheduler reclaimed
+        this tick, plus the currently-admitted set."""
+        preempted: list[str] = []
+        take = getattr(self.scheduler, "take_preemptions", None)
+        if take is not None:
+            for decision in take(owner="rollout"):
+                worker = self._workloads.get(decision.job_id)
+                if worker is not None:
+                    preempted.append(worker)
+                    self.preempted_total += 1
+                getattr(self.scheduler, "forget", self.scheduler.release)(
+                    decision.job_id
+                )
+                self._workloads.pop(decision.job_id, None)
+        admitted = [
+            worker for wid, worker in self._workloads.items()
+            if self.scheduler.is_admitted(wid)
+        ]
+        return {"preempted": preempted, "admitted": admitted}
+
+    def close(self) -> None:
+        for wid in list(self._workloads):
+            getattr(self.scheduler, "forget", self.scheduler.release)(wid)
+            self._workloads.pop(wid, None)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "workloads": dict(self._workloads),
+            "queue": self.queue,
+            "flavor": self.flavor,
             "preempted_total": self.preempted_total,
         }

@@ -163,8 +163,15 @@ class ReplicaFleet:
         warm_start: bool = True,
         adapters: "Any | None" = None,
         transport: "Any | None" = None,
+        reward_spec: "dict[str, Any] | None" = None,
     ):
         self.job_id = job_id
+        #: spec section forwarded to every worker spawn when the served job
+        #: is a ``task: reward`` model: workers then load the reward head
+        #: and answer the batched ``reward_score`` RPC
+        #: (``prefs/rollout_plane.py::RewardScorer``).  Process transport
+        #: only; in-process replicas have no RPC surface to expose it on.
+        self.reward_spec = dict(reward_spec) if reward_spec else None
         #: cross-process mode: a ``transport/process.py::ProcessTransport``
         #: (or anything with its ``spawn``/``mode`` surface) — replicas are
         #: worker processes and ``model``/``variables`` may be None (the
@@ -274,6 +281,7 @@ class ReplicaFleet:
                 batcher_kwargs=self._batcher_kwargs,
                 adapters=self.adapters,
                 warm_start=self.warm_start,
+                reward=self.reward_spec,
             )
             if self.adapters is not None and len(self.adapters):
                 try:

@@ -206,7 +206,17 @@ def load_serving_model(
     from ..train.trainer import Trainer
 
     train_cfg = build_train_config(spec)
-    trainer = Trainer(model_cfg, train_cfg)
+    if train_cfg.task == "reward":
+        # a reward job's checkpoints carry {"lora", "head"} as the trainable
+        # tree; the plain Trainer's restore template (lora only) would
+        # refuse them — the reward trainer's template matches and its
+        # _assemble drops the head (which serves via the reward_score RPC,
+        # not through model.apply)
+        from ..prefs.reward_trainer import RewardModelTrainer
+
+        trainer = RewardModelTrainer(model_cfg, train_cfg)
+    else:
+        trainer = Trainer(model_cfg, train_cfg)
     state = trainer.init_state()
     ckpt = CheckpointManager(str(local_dir / "checkpoints"))
     template = trainer.state_to_host(state)
@@ -241,6 +251,7 @@ def load_serving_model(
 
     meta = {
         "preset": spec.get("model", {}).get("preset"),
+        "task": train_cfg.task,
         "checkpoint_step": latest,
         "lora_merged": merged,
         "vocab_size": model_cfg.vocab_size,
@@ -402,6 +413,7 @@ def stage_meta(local_dir: Path | str, *, merge_lora: bool = True) -> dict:
     pretrained = spec.get("model", {}).get("weights_dir")
     return {
         "preset": spec.get("model", {}).get("preset"),
+        "task": spec.get("training", {}).get("task", "sft"),
         "checkpoint_step": latest,
         "lora_merged": merged,
         "lora_rank": model_cfg.lora.rank,

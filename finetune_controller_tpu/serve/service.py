@@ -183,12 +183,20 @@ class ServeManager:
     async def _build_session(self, job_id, model, variables, meta,
                              *, transport=None) -> _Session:
         s = self.settings
+        reward_spec = None
+        if transport is not None and meta.get("task") == "reward":
+            # reward jobs serve the scoring RPC: workers restore the head
+            # from the same staged prefix the deploy_dir builder reads
+            staged = (transport.payload.get("kwargs") or {}).get("dir")
+            if staged:
+                reward_spec = {"artifacts_dir": str(staged)}
         fleet = ReplicaFleet(
             job_id, model, variables, self._engine_config(),
             replicas=s.serve_replicas,
             batcher_kwargs=self._batcher_kwargs(),
             adapters=self._adapter_registry(),
             transport=transport,
+            reward_spec=reward_spec,
             stall_timeout_s=s.serve_replica_stall_s,
             drain_timeout_s=s.serve_drain_timeout_s,
             restart_policy=RetryPolicy(

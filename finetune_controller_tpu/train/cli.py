@@ -87,9 +87,10 @@ def build_batches(
 
     ds = spec.get("dataset", {})
     path = ds.get("eval_path") if split == "eval" else ds.get("path")
-    if train_cfg.task in ("dpo", "rlhf"):
+    if train_cfg.task in ("dpo", "rlhf", "reward"):
         # preference-pair streams (data/preference.py): chosen/rejected
         # token+mask leaves instead of the SFT tokens/loss_mask pair
+        # (the reward task trains its Bradley–Terry head on this same path)
         from ..data.preference import (
             preference_jsonl_batches,
             synthetic_preference_batches,
@@ -209,22 +210,40 @@ def run_job(spec: dict) -> None:
     elif train_cfg.task in ("dpo", "rlhf"):
         from ..prefs.dpo_trainer import DPOTrainer
 
-        # rlhf forces prefetch=0 inside DPOTrainer (the actor runs inline)
+        # in-process rlhf forces prefetch=0 inside DPOTrainer (the actor
+        # runs inline); rollout_workers > 0 keeps prefetch + async commits
         trainer = DPOTrainer(model_cfg, train_cfg, mesh=mesh)
+    elif train_cfg.task == "reward":
+        from ..prefs.reward_trainer import RewardModelTrainer
+
+        trainer = RewardModelTrainer(model_cfg, train_cfg, mesh=mesh)
     else:
         raise ValueError(
             f"unknown training task {train_cfg.task!r}; one of "
-            "['sft', 'dpo', 'rlhf']"
+            "['sft', 'dpo', 'rlhf', 'reward']"
         )
+    plane = None
     if train_cfg.task == "rlhf":
         from ..prefs.learner import RolloutConfig, build_rlhf_loop
 
         rollout_spec = dict(spec.get("rollout", {}))
-        batches, actor, _buffer = build_rlhf_loop(
-            trainer, artifacts_dir,
-            rollout=RolloutConfig(**rollout_spec),
-            pretrained_dir=spec.get("model", {}).get("weights_dir"),
-        )
+        if train_cfg.rollout_workers > 0:
+            # disaggregated data plane: remote actor worker processes
+            # stream pairs in over the rollout RPCs (prefs/rollout_plane.py)
+            from ..prefs.rollout_plane import build_remote_rlhf_loop
+
+            batches, plane, _buffer = build_remote_rlhf_loop(
+                trainer, artifacts_dir,
+                rollout=RolloutConfig(**rollout_spec),
+                pretrained_dir=spec.get("model", {}).get("weights_dir"),
+                model_spec=spec.get("model", {}),
+            )
+        else:
+            batches, actor, _buffer = build_rlhf_loop(
+                trainer, artifacts_dir,
+                rollout=RolloutConfig(**rollout_spec),
+                pretrained_dir=spec.get("model", {}).get("weights_dir"),
+            )
     else:
         batches = build_batches(
             spec, model_cfg, train_cfg,
@@ -245,17 +264,24 @@ def run_job(spec: dict) -> None:
                 "set dataset.eval_path (or use a synthetic dataset, which "
                 "holds out a disjoint stream automatically)"
             )
-    state = trainer.fit(
-        batches, artifacts_dir,
-        pretrained_dir=spec.get("model", {}).get("weights_dir"),
-        eval_batches=eval_batches,
-    )
-    # deployable artifacts: PEFT adapter (+ merged checkpoint if configured;
-    # the base dir enables the multi-host merge's host-side reload)
-    trainer.export_artifacts(
-        state, artifacts_dir,
-        pretrained_dir=spec.get("model", {}).get("weights_dir"),
-    )
+    try:
+        state = trainer.fit(
+            batches, artifacts_dir,
+            pretrained_dir=spec.get("model", {}).get("weights_dir"),
+            eval_batches=eval_batches,
+        )
+        # deployable artifacts: PEFT adapter (+ merged checkpoint if
+        # configured; the base dir enables the multi-host merge's host-side
+        # reload)
+        trainer.export_artifacts(
+            state, artifacts_dir,
+            pretrained_dir=spec.get("model", {}).get("weights_dir"),
+        )
+    finally:
+        if plane is not None:
+            # remote actor workers are child processes: reap them even when
+            # fit raises, or a failed learner leaks a decoding fleet
+            plane.close()
 
     if is_rank_zero():
         with open(os.path.join(artifacts_dir, "done.txt"), "w") as f:

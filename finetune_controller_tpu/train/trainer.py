@@ -61,6 +61,14 @@ class TrainConfig:
     task: str = "sft"
     #: DPO inverse-temperature (KL strength) — used by the dpo/rlhf tasks only
     dpo_beta: float = 0.1
+    #: rlhf only: number of REMOTE rollout actor processes (0 = the
+    #: in-process actor/learner gang).  > 0 selects the disaggregated data
+    #: plane (``prefs/rollout_plane.py``): actors run as serve-fleet tenants
+    #: in their own worker processes, stream scored pairs over the rollout
+    #: RPCs, and receive policy rollovers as pushed adapter deltas — so the
+    #: learner keeps async checkpoint commits and prefetch
+    #: (docs/preference.md §Disaggregated rollouts).
+    rollout_workers: int = 0
     learning_rate: float = 2e-4
     warmup_steps: int = 10
     total_steps: int = 100

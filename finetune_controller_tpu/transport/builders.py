@@ -63,9 +63,39 @@ def deploy_dir(dir: str, merge_lora: bool = True,
     return model, variables
 
 
+def rollout_base(dir: str) -> tuple[Any, dict]:
+    """Reconstruct the rlhf learner's FROZEN BASE for a remote rollout actor.
+
+    The learner's checkpoints only hold the trainable adapter; the base the
+    actor must decode with is written once by ``prefs/rollout_plane.py::
+    write_rollout_base`` into ``<artifacts>/rollout_base/`` (model spec JSON
+    + flax-msgpack params) — adapter deltas then arrive over the
+    ``rollout_policy_version`` RPC, so base weights never ride the wire and
+    the actor's step-0 policy is bit-identical to the learner's."""
+    import json
+    import os
+
+    from flax import serialization
+
+    from ..models.llama import LlamaForCausalLM
+    from ..train.cli import build_model_config
+
+    base = os.path.join(dir, "rollout_base")
+    with open(os.path.join(base, "model.json")) as f:
+        model_spec = json.load(f)
+    cfg = build_model_config({"model": model_spec})
+    if cfg.image_size:  # pragma: no cover - MM rlhf unsupported
+        raise ValueError("rollout_base only supports text-only policies")
+    model = LlamaForCausalLM(cfg)
+    with open(os.path.join(base, "params.msgpack"), "rb") as f:
+        params = serialization.msgpack_restore(f.read())
+    return model, {"params": params}
+
+
 _BUILTINS: dict[str, Callable[..., tuple[Any, dict]]] = {
     "tiny_test": tiny_test,
     "deploy_dir": deploy_dir,
+    "rollout_base": rollout_base,
 }
 
 

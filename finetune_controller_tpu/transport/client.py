@@ -443,6 +443,52 @@ class RemoteReplica:
         )
         return int(out.get("busy", 0))
 
+    # ---- rollout streaming (docs/preference.md §Disaggregated rollouts) ----
+    # The canonical call sites for the rollout/reward op family: every other
+    # caller (prefs/rollout_plane.py) routes through these methods, keeping
+    # the rpc-conformance lint's client table inside transport/.
+
+    async def rollout_start(self, pairs_per_round: int) -> dict[str, Any]:
+        """Start (idempotently) the worker's pair-producer loop."""
+        return await self._conn.call(
+            "rollout_start", {"pairs_per_round": int(pairs_per_round)},
+            timeout_s=120.0,
+        )
+
+    async def rollout_pull(self, after_seq: int,
+                           max_rounds: int = 8) -> dict[str, Any]:
+        """Rounds with ``seq > after_seq`` — idempotent cursor read."""
+        return await self._conn.call(
+            "rollout_pull",
+            {"after_seq": int(after_seq), "max_rounds": int(max_rounds)},
+            timeout_s=self.probe_timeout_s + 60.0,
+        )
+
+    async def rollout_ack(self, up_to_seq: int) -> dict[str, Any]:
+        """Trim the worker's outbox through ``up_to_seq``."""
+        return await self._conn.call(
+            "rollout_ack", {"up_to_seq": int(up_to_seq)}, timeout_s=60.0
+        )
+
+    async def rollout_policy_version(self, version: int,
+                                     tree_blob: bytes | None) -> dict[str, Any]:
+        """Ship an adapter delta (flax-msgpack blob) as the new policy —
+        the fleet-rollover push; idempotent and monotonic worker-side."""
+        return await self._conn.call(
+            "rollout_policy_version",
+            {"version": int(version), "tree": tree_blob},
+            timeout_s=300.0,
+        )
+
+    async def reward_score(
+        self, items: list[dict[str, Any]]
+    ) -> list[float]:
+        """Batched scalar scoring of (prompt, completion) items."""
+        out = await self._conn.call(
+            "reward_score", {"items": items}, timeout_s=300.0
+        )
+        return [float(s) for s in out.get("scores") or []]
+
     # ---- batcher-shaped sync surface (last-probe snapshots) ----------------
 
     @property
@@ -481,3 +527,65 @@ class RemoteReplica:
         out["transport"] = "process"
         out["pid"] = self.pid
         return out
+
+
+class RewardClient:
+    """Synchronous facade over the ``reward_score`` RPC for callers that live
+    on a plain thread — the rollout worker's producer loop scores each round
+    from inside its (non-async) generate path.  Owns a private event loop on
+    a daemon thread plus one :class:`_Connection`; every :meth:`score` is a
+    thread-safe round trip onto that loop."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 300.0):
+        import threading
+
+        self._host = host
+        self._port = int(port)
+        self._timeout_s = timeout_s
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="ftc-reward-client",
+            daemon=True,
+        )
+        self._thread.start()
+        self._conn: _Connection = self._run(
+            _Connection.open(self._host, self._port)
+        )
+
+    def _run(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
+            self._timeout_s + 30.0
+        )
+
+    def score(self, items: list[dict[str, Any]]) -> list[float]:
+        """Score a batch of ``{"prompt": [...], "completion": [...]}`` items."""
+
+        async def _call() -> list[float]:
+            out = await self._conn.call(
+                "reward_score", {"items": items}, timeout_s=self._timeout_s
+            )
+            return [float(s) for s in out.get("scores") or []]
+
+        return self._run(_call())
+
+    def batch_reward_fn(self):
+        """Adapter for :class:`~..prefs.actor.RolloutActor`'s
+        ``batch_reward_fn`` signature (list of (prompt, completion) tuples)."""
+
+        def fn(pairs: list[tuple[list[int], list[int]]]) -> list[float]:
+            return self.score([
+                {"prompt": [int(t) for t in p],
+                 "completion": [int(t) for t in c]}
+                for p, c in pairs
+            ])
+
+        return fn
+
+    def close(self) -> None:
+        try:
+            self._run(self._conn.close())
+        # ftc: ignore[silent-except] -- best-effort teardown of a dead socket
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5.0)

@@ -121,6 +121,8 @@ class ProcessTransport:
         batcher_kwargs: dict[str, Any],
         adapters=None,
         warm_start: bool = True,
+        rollout: dict[str, Any] | None = None,
+        reward: dict[str, Any] | None = None,
     ) -> RemoteReplica:
         """Spawn one worker sandbox and hand back its connected client."""
         sandbox = self.root / f"{replica_id}-g{generation}"
@@ -147,6 +149,10 @@ class ProcessTransport:
             "heartbeat_interval_s": self.heartbeat_interval_s,
             "warm_start": warm_start,
         }
+        if rollout:
+            spec["rollout"] = dict(rollout)
+        if reward:
+            spec["reward"] = dict(reward)
         spec_path = sandbox / "worker_spec.json"
         log_path = sandbox / "worker.log"
 

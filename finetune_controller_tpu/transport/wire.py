@@ -79,6 +79,25 @@ def loads(data: bytes) -> Any:
     return json.loads(data.decode("utf-8"), object_hook=_json_hook)
 
 
+def tree_to_blob(tree: Any) -> bytes:
+    """flax-msgpack blob of a (device or host) pytree — the adapter-delta
+    wire format (``serve/adapters.py::entry_to_wire``) reused for rollout
+    policy rollover: megabytes of LoRA deltas, never base weights."""
+    import jax
+    import numpy as np
+    from flax import serialization
+
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    return serialization.msgpack_serialize(host)
+
+
+def tree_from_blob(blob: bytes) -> Any:
+    """Inverse of :func:`tree_to_blob`: host-side numpy pytree."""
+    from flax import serialization
+
+    return serialization.msgpack_restore(bytes(blob))
+
+
 class FrameError(RuntimeError):
     """A torn or oversized frame — the connection is unusable afterwards."""
 

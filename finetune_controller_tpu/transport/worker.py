@@ -35,10 +35,14 @@ queued, finish in-flight, then exit 0), ``tenant_busy``,
 ``adapter_register`` / ``adapter_unregister`` / ``stack_sync`` (the
 registry-sync RPCs — flax-msgpack adapter deltas, megabytes, never base
 weights; a re-register with ``refresh`` drops the tenant's prefix
-namespace worker-side, so no separate drop op exists).  The op table is
-verified against the client's call sites by ftc-lint's ``rpc-conformance``
-rule — it deleted two dead ops (``shutdown``, ``drop_namespace``) on
-landing, and a handler/client rename turns the lint red (mutation-tested).
+namespace worker-side, so no separate drop op exists).  A rollout tenant
+(``spec.rollout``) adds the idempotent streaming ops ``rollout_start`` /
+``rollout_pull`` / ``rollout_ack`` / ``rollout_policy_version``, and a
+reward tenant (``spec.reward``) adds the batched ``reward_score``
+(docs/preference.md §Disaggregated rollouts).  The op table is verified
+against the client's call sites by ftc-lint's ``rpc-conformance`` rule —
+it deleted two dead ops (``shutdown``, ``drop_namespace``) on landing,
+and a handler/client rename turns the lint red (mutation-tested).
 
 Engine work (prefill/step/adapter installs) always runs in worker threads so
 the RPC loop stays responsive — probes answer mid-compile.
@@ -79,6 +83,12 @@ class WorkerSpec:
     engine: dict[str, Any]
     batcher: dict[str, Any]
     adapters: dict[str, Any] | None = None
+    #: rollout-tenant section: the worker runs a RolloutService (an actor
+    #: streaming scored preference pairs) instead of a request batcher
+    rollout: dict[str, Any] | None = None
+    #: reward-tenant section: attach a RewardScorer over the built payload
+    #: (``{"artifacts_dir": ...}`` names the reward job's export)
+    reward: dict[str, Any] | None = None
     host: str = "127.0.0.1"
     port: int = 0
     heartbeat_interval_s: float = 2.0
@@ -129,6 +139,10 @@ class WorkerServer:
         self.engine = engine
         self.batcher = batcher
         self.registry = registry
+        #: rollout tenant only (``spec.rollout``): the streaming pair service
+        self.rollout = None
+        #: reward tenant only (``spec.reward``): the batched pair scorer
+        self.reward_scorer = None
         self.exit_on_drain = exit_on_drain
         self._server: asyncio.base_events.Server | None = None
         self.port: int | None = None
@@ -394,6 +408,59 @@ class WorkerServer:
             installed.append({"adapter_id": doc["adapter_id"], **out})
         return {"installed": installed}
 
+    # ---- rollout tenant (docs/preference.md §Disaggregated rollouts) -------
+
+    def _require_rollout(self):
+        if self.rollout is None:
+            raise ValueError(
+                "worker is not a rollout tenant (spec has no rollout section)"
+            )
+        return self.rollout
+
+    async def _op_rollout_start(self, payload: dict) -> dict:
+        """Start (or idempotently re-confirm) the producer loop."""
+        svc = self._require_rollout()
+        return await asyncio.to_thread(
+            svc.start, int(payload["pairs_per_round"])
+        )
+
+    async def _op_rollout_pull(self, payload: dict) -> dict:
+        """Rounds with ``seq > after_seq`` — an idempotent cursor read: a
+        re-delivered pull replays the same rounds with the same pair ids."""
+        svc = self._require_rollout()
+        return await asyncio.to_thread(
+            svc.pull, int(payload["after_seq"]),
+            int(payload.get("max_rounds", 8)),
+        )
+
+    async def _op_rollout_ack(self, payload: dict) -> dict:
+        """Trim the outbox through ``up_to_seq`` (monotonic; stale acks no-op)."""
+        svc = self._require_rollout()
+        return await asyncio.to_thread(svc.ack, int(payload["up_to_seq"]))
+
+    async def _op_rollout_policy_version(self, payload: dict) -> dict:
+        """Install a learner-shipped adapter delta (idempotent, monotonic) —
+        the fleet-rollover push: megabytes of LoRA, never a model load."""
+        svc = self._require_rollout()
+        return await asyncio.to_thread(
+            svc.push_policy, int(payload["version"]), payload.get("tree")
+        )
+
+    # ---- reward tenant -----------------------------------------------------
+
+    def _require_reward(self):
+        if self.reward_scorer is None:
+            raise ValueError(
+                "worker is not a reward tenant (spec has no reward section)"
+            )
+        return self.reward_scorer
+
+    async def _op_reward_score(self, payload: dict) -> dict:
+        """Batched scalar scoring: one forward for a whole rollout round."""
+        scorer = self._require_reward()
+        scores = await asyncio.to_thread(scorer.score, payload["items"] or [])
+        return {"scores": [float(s) for s in scores]}
+
 
 def _write_transport_file(spec: WorkerSpec, port: int) -> str:
     path = os.path.join(spec.sandbox, TRANSPORT_FILENAME)
@@ -412,6 +479,14 @@ def build_worker(spec: WorkerSpec, *, exit_on_drain: bool = True) -> WorkerServe
     from ..serve.batcher import Batcher
     from ..serve.engine import BatchEngine, EngineConfig, warm_engine
     from .builders import resolve_builder
+
+    if spec.rollout:
+        # rollout tenant: the actor's engine replaces the request batcher —
+        # the whole (service, shim-batcher, server) assembly lives with the
+        # rest of the data plane in prefs/rollout_plane.py
+        from ..prefs.rollout_plane import build_rollout_worker
+
+        return build_rollout_worker(spec, exit_on_drain=exit_on_drain)
 
     builder = resolve_builder(spec.builder)
     model, variables = builder(**(spec.builder_kwargs or {}))
@@ -432,11 +507,21 @@ def build_worker(spec: WorkerSpec, *, exit_on_drain: bool = True) -> WorkerServe
         logger.warning("worker %s armed with a serve fault (hard kill)",
                        spec.replica_id)
     batcher = Batcher(engine, **(spec.batcher or {}))
-    return WorkerServer(spec, engine, batcher, registry,
-                        exit_on_drain=exit_on_drain)
+    server = WorkerServer(spec, engine, batcher, registry,
+                          exit_on_drain=exit_on_drain)
+    if spec.reward:
+        # reward tenant: the scorer shares the engine's (merged) weights —
+        # the head rides separately in the reward job's export
+        from ..prefs.rollout_plane import RewardScorer
+
+        server.reward_scorer = RewardScorer.from_artifacts(
+            str(spec.reward["artifacts_dir"]), model, variables
+        )
+    return server
 
 
 async def _amain(spec: WorkerSpec) -> int:
+    # ftc: ignore[blocking-io-in-async-transitive] -- startup path: build_worker (weights + reward-head reads) runs once, before the loop serves anything
     server = build_worker(spec)
     port = await server.start()
     server.start_heartbeat()

@@ -86,6 +86,22 @@ if [ "$obs_rc" -ne 0 ]; then
     exit "$obs_rc"
 fi
 
+echo "== rlhf-fast (disaggregated rollout plane + reward model) ==" >&2
+# The distributed RLHF data plane (docs/preference.md §Disaggregated
+# rollouts): rollout RPC protocol idempotence, exactly-once dedup across
+# respawns, policy rollover as adapter deltas, the Bradley–Terry reward
+# trainer, AND the slow-marked chaos (SIGKILL mid-round) and remote-overlap
+# e2e runs.  No 'not slow' filter: the e2es are excluded from tier-1 only
+# to protect that stage's wall-clock.
+timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_rollout_plane.py tests/test_reward_model.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rlhf_rc=$?
+if [ "$rlhf_rc" -ne 0 ]; then
+    echo "ci_check: rlhf-fast failed (exit $rlhf_rc)" >&2
+    exit "$rlhf_rc"
+fi
+
 echo "== dpo-fast (preference optimization: losses, data, actor/learner) ==" >&2
 # DPO loss math (hand-computed logits, beta monotonicity, stop-gradient),
 # seeded preference-pair round trips, rollout buffer/actor/learner loop,

@@ -628,6 +628,34 @@ def test_mutation_renaming_client_op_turns_lint_red():
     assert any("_op_generate" in m and "dead op" in m for m in msgs), msgs
 
 
+def test_mutation_deleting_rollout_handler_turns_lint_red():
+    # the disaggregated-rlhf ops are covered exactly like the serve ops:
+    # deleting one worker handler must turn rpc-conformance red for both
+    # the now-unanswered client op and the dead handler name.
+    src = WORKER.read_text()
+    assert "async def _op_rollout_pull(" in src
+    mutated = src.replace(
+        "async def _op_rollout_pull(", "async def _op_rollout_pull_gone(")
+    result = _rpc_lint({str(WORKER): mutated})
+    msgs = [f.message for f in result.active]
+    assert any("'rollout_pull'" in m and "no worker handler" in m
+               for m in msgs), msgs
+    assert result.exit_code == 1
+
+
+def test_mutation_rollout_ops_covered_at_head():
+    # green baseline: every rollout/reward op has a matching client call
+    # site and worker handler, so none of them appear in head findings.
+    result = _rpc_lint(None)
+    assert result.active == []
+    src = WORKER.read_text()
+    client_src = CLIENT.read_text()
+    for op in ("rollout_start", "rollout_pull", "rollout_ack",
+               "rollout_policy_version", "reward_score"):
+        assert f"async def _op_{op}(" in src, op
+        assert f'"{op}"' in client_src, op
+
+
 def test_mutation_deleting_state_rpc_handler_turns_lint_red():
     src = STATE_SVC.read_text()
     mutated = src.replace('@_rpc("get_job")', '@_rpc("get_job_gone")')

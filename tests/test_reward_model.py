@@ -1,0 +1,281 @@
+"""The learned reward model (``task: reward``) — docs/preference.md.
+
+Anchors: ``bradley_terry_loss`` is the standard pairwise objective (hand-math
+pinned); :class:`RewardModelTrainer` rides the full SFT/DPO machinery with a
+``{"lora", "head"}`` trainable tree whose head init (a=1, w=0, b=0) makes the
+step-0 score exactly the mean completion likelihood; ``export_artifacts``
+ships ``reward_head.msgpack`` and :class:`RewardScorer` loads it back — or,
+for a staged serve prefix that carries only spec+checkpoints, restores the
+head straight out of the latest checkpoint's trainable tree.  Slow: the
+ISSUE-19 acceptance pair — a reward job trains to held-out pairwise accuracy
+>= 0.7 on the increment task, and a remote-actor rlhf run scores its rollout
+candidates through that model's batched ``reward_score`` RPC over the wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from finetune_controller_tpu.data.preference import (
+    synthetic_preference_batches,
+)
+from finetune_controller_tpu.models.llama import PRESETS
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.prefs.losses import bradley_terry_loss
+from finetune_controller_tpu.prefs.reward_trainer import (
+    REWARD_HEAD_FILENAME,
+    RewardModelTrainer,
+)
+from finetune_controller_tpu.prefs.rollout_plane import RewardScorer
+from finetune_controller_tpu.train.trainer import TrainConfig
+
+
+def _model_cfg(rank=4):
+    return PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=rank))
+
+
+def _train_cfg(**kw):
+    kw.setdefault("task", "reward")
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("seq_len", 16)
+    kw.setdefault("warmup_steps", 1)
+    kw.setdefault("heartbeat_interval_s", 0)
+    return TrainConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# the objective
+# ---------------------------------------------------------------------------
+
+
+def test_bradley_terry_loss_hand_math():
+    import jax.numpy as jnp
+
+    chosen = jnp.asarray([2.0, 0.0], jnp.float32)
+    rejected = jnp.asarray([0.0, 1.0], jnp.float32)
+    loss, metrics = bradley_terry_loss(chosen, rejected)
+    # margins [2, -1]: loss = mean(-log sigmoid(margin))
+    expect = -(np.log(1 / (1 + np.exp(-2.0)))
+               + np.log(1 / (1 + np.exp(1.0)))) / 2
+    assert abs(float(loss) - expect) < 1e-5
+    assert float(metrics["bt_accuracy"]) == 0.5  # one pair ranked correctly
+    assert abs(float(metrics["reward_margin"]) - 0.5) < 1e-6
+    assert abs(float(metrics["score_chosen"]) - 1.0) < 1e-6
+    # perfectly-ranked pairs: accuracy 1, loss below ln(2)
+    loss2, m2 = bradley_terry_loss(chosen, rejected - 2.0)
+    assert float(m2["bt_accuracy"]) == 1.0
+    assert float(loss2) < float(np.log(2.0))
+
+
+def test_reward_trainer_mode_guards():
+    with pytest.raises(ValueError, match="mode='lora'"):
+        RewardModelTrainer(_model_cfg(), _train_cfg(mode="full"))
+    moe = PRESETS["tiny-moe-test"].replace(lora=LoRAConfig(rank=4))
+    with pytest.raises(ValueError, match="MoE"):
+        RewardModelTrainer(moe, _train_cfg())
+
+
+def test_reward_trainer_head_init_and_step_smoke():
+    trainer = RewardModelTrainer(_model_cfg(), _train_cfg(total_steps=4))
+    state = trainer.init_state()
+    trainable = trainer.state_to_host(state, fields=("trainable",))[
+        "trainable"
+    ]
+    assert set(trainable) == {"lora", "head"}
+    head = trainable["head"]
+    vocab = int(trainer.model_cfg.vocab_size)
+    # a=1, w=0, b=0: the step-0 score IS the mean completion likelihood
+    assert float(head["a"]) == 1.0 and float(head["b"]) == 0.0
+    assert head["w"].shape == (vocab,) and not np.any(head["w"])
+    batches = synthetic_preference_batches(4, 16, vocab, seed=0)
+    state, metrics = trainer.step(state, next(batches))
+    for key in ("loss", "bt_accuracy", "accuracy", "reward_margin"):
+        assert np.isfinite(float(metrics[key])), key
+    assert float(metrics["accuracy"]) == float(metrics["bt_accuracy"])
+    # the loss moves the head too, not just the trunk adapter
+    state, _ = trainer.step(state, next(batches))
+    head2 = trainer.state_to_host(state, fields=("trainable",))[
+        "trainable"
+    ]["head"]
+    assert np.any(head2["w"]) or float(head2["b"]) != 0.0
+
+
+def test_export_artifacts_and_scorer_roundtrip(tmp_path):
+    from finetune_controller_tpu.transport.builders import tiny_test
+
+    trainer = RewardModelTrainer(_model_cfg(), _train_cfg(total_steps=2))
+    state = trainer.init_state()
+    trainer.export_artifacts(state, str(tmp_path))
+    assert os.path.exists(tmp_path / REWARD_HEAD_FILENAME)
+    model, variables = tiny_test()
+    scorer = RewardScorer.from_artifacts(str(tmp_path), model, variables)
+    scores = scorer.score([
+        {"prompt": [1, 2, 3], "completion": [4, 5, 6]},
+        {"prompt": [1, 2, 3], "completion": [9, 0, 2]},
+    ])
+    assert len(scores) == 2 and all(np.isfinite(scores))
+    # freshly-initialised head: score == mean completion likelihood, so two
+    # different completions of one prompt almost surely score differently
+    assert scores[0] != scores[1]
+
+
+def test_scorer_checkpoint_fallback_without_msgpack(tmp_path):
+    """A staged serve prefix carries only spec + checkpoints
+    (``serve/loader.py::fetch_promoted``): the scorer must rebuild the head
+    from the latest checkpoint's trainable tree."""
+    from finetune_controller_tpu.transport.builders import tiny_test
+
+    trainer = RewardModelTrainer(
+        _model_cfg(),
+        _train_cfg(total_steps=2, checkpoint_every=2, log_every=2,
+                   learning_rate=1e-3, prefetch=0),
+    )
+    vocab = int(trainer.model_cfg.vocab_size)
+    batches = synthetic_preference_batches(4, 16, vocab, seed=0)
+    trainer.fit(batches, str(tmp_path), resume=False)
+    assert not os.path.exists(tmp_path / REWARD_HEAD_FILENAME)
+    model, variables = tiny_test()
+    scorer = RewardScorer.from_artifacts(str(tmp_path), model, variables)
+    assert scorer._head["w"].shape == (vocab,)
+    scores = scorer.score([{"prompt": [3, 4], "completion": [5, 6]}])
+    assert np.isfinite(scores[0])
+
+
+# ---------------------------------------------------------------------------
+# slow: the ISSUE-19 acceptance pair
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_reward_model_trains_to_heldout_pairwise_accuracy(tmp_path):
+    """``task: reward`` learns the increment ranking: held-out Bradley–Terry
+    pairwise accuracy >= 0.7 (the promotion gate's number)."""
+    trainer = RewardModelTrainer(
+        _model_cfg(),
+        _train_cfg(total_steps=400, batch_size=8, learning_rate=5e-3,
+                   warmup_steps=5, eval_steps=8),
+    )
+    vocab = int(trainer.model_cfg.vocab_size)
+    batches = synthetic_preference_batches(8, 16, vocab, seed=0)
+    state = trainer.init_state()
+    acc = 0.0
+    for i in range(400):
+        state, _ = trainer.step(state, next(batches))
+        if i >= 199 and (i + 1) % 20 == 0:
+            held = synthetic_preference_batches(8, 16, vocab, seed=100_003)
+            acc = float(
+                trainer.evaluate(state, held)["eval_bt_accuracy"]
+            )
+            if acc >= 0.7:
+                break
+    assert acc >= 0.7, f"held-out bt_accuracy plateaued at {acc}"
+    # the export of the TRAINED job round-trips through the scorer and
+    # still ranks held-out pairs — over the trunk WITH its adapter, which
+    # is what serving deploys (the head was trained over those logits)
+    trainer.export_artifacts(state, str(tmp_path))
+    from finetune_controller_tpu.data.preference import make_increment_pair
+
+    variables = trainer._assemble(state.frozen, state.trainable)
+    scorer = RewardScorer.from_artifacts(
+        str(tmp_path), trainer.model, variables
+    )
+    rng = np.random.default_rng(7)
+    margins, correct = [], 0
+    for _ in range(32):
+        prompt, chosen, rejected = make_increment_pair(rng, 16, vocab)
+        sc, sr = scorer.score([
+            {"prompt": prompt, "completion": chosen},
+            {"prompt": prompt, "completion": rejected},
+        ])
+        margins.append(sc - sr)
+        correct += sc > sr
+    assert np.mean(margins) > 0
+    assert correct >= 20, f"exported scorer ranked only {correct}/32"
+
+
+@pytest.mark.slow
+def test_remote_rlhf_scored_by_served_reward_model(tmp_path, monkeypatch):
+    """End to end over real wires: a served reward model answers the batched
+    ``reward_score`` RPC, a remote rollout worker (separate process) scores
+    its candidate completions through it, and the learner trains on the
+    resulting pairs.  The oracle bootstrap never runs — scores come from the
+    learned head."""
+    from finetune_controller_tpu.prefs.dpo_trainer import DPOTrainer
+    from finetune_controller_tpu.prefs.learner import RolloutConfig
+    from finetune_controller_tpu.prefs.rollout_plane import (
+        build_remote_rlhf_loop,
+    )
+    from finetune_controller_tpu.transport.worker import (
+        WorkerSpec,
+        build_worker,
+    )
+
+    monkeypatch.setenv("FTC_TRACE_ID", "")
+    reward_dir = tmp_path / "reward"
+    reward_dir.mkdir()
+    rm = RewardModelTrainer(_model_cfg(), _train_cfg(total_steps=2))
+    rm.export_artifacts(rm.init_state(), str(reward_dir))
+
+    # the reward fleet tenant, served from a background loop in this process
+    # so the test can read its scorer's counters directly
+    spec = WorkerSpec(
+        job_id="reward-svc", replica_id="rw0",
+        sandbox=str(tmp_path / "reward_sandbox"),
+        builder="tiny_test", builder_kwargs={},
+        engine=dict(slots=2, prompt_buckets=[8], max_new_tokens=8),
+        batcher={},
+        reward={"artifacts_dir": str(reward_dir)},
+        warm_start=False,
+    )
+    os.makedirs(spec.sandbox, exist_ok=True)
+    server = build_worker(spec, exit_on_drain=False)
+    loop = asyncio.new_event_loop()
+    loop_thread = threading.Thread(target=loop.run_forever, daemon=True)
+    loop_thread.start()
+    port = asyncio.run_coroutine_threadsafe(server.start(), loop).result(120)
+
+    cfg = TrainConfig(
+        task="rlhf", batch_size=2, seq_len=16, total_steps=10**9,
+        warmup_steps=1, learning_rate=1e-3, log_every=10**9,
+        checkpoint_every=10**9, prefetch=0, heartbeat_interval_s=0,
+        rollout_workers=1,
+    )
+    learner = DPOTrainer(_model_cfg(), cfg)
+    stream, plane, buffer = build_remote_rlhf_loop(
+        learner, str(tmp_path / "rlhf"),
+        # reward_port set ⇒ the worker scores through the RPC and never
+        # builds the oracle bootstrap (build_rollout_worker)
+        rollout=RolloutConfig(
+            pairs_per_round=4, min_fill=4, buffer_capacity=128,
+            max_new_tokens=8, slots=2, temperature=0.9,
+            reward_host="127.0.0.1", reward_port=port,
+        ),
+        model_spec={"preset": "tiny-test", "lora": {"rank": 4}},
+    )
+    try:
+        state = learner.init_state()
+        batch = next(stream)
+        state, metrics = learner.step(state, batch)
+        assert np.isfinite(float(metrics["reward_margin"]))
+        # every buffered pair was scored by the served model over the wire
+        assert server.reward_scorer.scored_total > 0
+        with plane._lock:
+            pairs = list(buffer._pairs)
+        assert pairs
+        assert all(
+            np.isfinite(p.reward_chosen) and np.isfinite(p.reward_rejected)
+            for p in pairs
+        )
+        assert all(
+            p.reward_chosen >= p.reward_rejected for p in pairs
+        )
+    finally:
+        plane.close()
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(60)
+        loop.call_soon_threadsafe(loop.stop)
+        loop_thread.join(timeout=10)

@@ -1,0 +1,817 @@
+"""Disaggregated RLHF data plane (ISSUE 19, docs/preference.md
+§Disaggregated rollouts).
+
+Anchors: the rollout RPC protocol is idempotent end to end (re-delivered
+start/pull/ack/policy pushes change nothing); the worker's outbox replays
+byte-identical round documents at a cursor; deterministic regeneration makes
+a respawned worker re-emit the SAME pair ids so the learner's dedup keeps
+every pair exactly-once across kills; policy rollover is a monotonic
+adapter-delta push installed between rounds (never a reload stall); the
+plane re-pushes its cached policy to every respawned incarnation BEFORE
+streaming resumes; `remote_rollout_batch_stream` ships committed checkpoints
+to the fleet and enforces the staleness watermark; RolloutTenant accounts
+worker chips in the scheduler's rollout queue and hands preempted workers
+back; DPOTrainer's prefetch=0/blocking-commit coupling applies ONLY to the
+in-process loop (remote mode keeps both freedoms); and the slow-marked
+chaos run SIGKILLs a real worker process mid-round — the learner keeps
+stepping on buffered pairs, the worker respawns with backoff and resumes
+streaming, and no duplicate pair ever enters the buffer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from conftest import run_async
+from finetune_controller_tpu.models.llama import PRESETS
+from finetune_controller_tpu.models.lora import LoRAConfig
+from finetune_controller_tpu.prefs import rollout_plane as rp
+from finetune_controller_tpu.prefs.dpo_trainer import DPOTrainer
+from finetune_controller_tpu.prefs.learner import RolloutConfig
+from finetune_controller_tpu.prefs.rollout_buffer import (
+    PreferencePair,
+    RolloutBuffer,
+)
+from finetune_controller_tpu.prefs.rollout_plane import (
+    RewardScorer,
+    RolloutPlane,
+    RolloutService,
+    build_remote_rlhf_loop,
+    pair_id,
+    remote_rollout_batch_stream,
+    write_rollout_base,
+)
+from finetune_controller_tpu.resilience.policy import RetryPolicy
+from finetune_controller_tpu.train.trainer import TrainConfig
+from finetune_controller_tpu.transport.wire import tree_from_blob, tree_to_blob
+
+
+def _wait(cond, timeout=10.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pair documents
+# ---------------------------------------------------------------------------
+
+
+def test_pair_id_and_doc_roundtrip():
+    assert pair_id(3, 7, 1) == "v3:r7:p1"
+    pair = PreferencePair(
+        prompt=(1, 2), chosen=(1, 2, 3), rejected=(1, 2, 4),
+        version=5, reward_chosen=1.5, reward_rejected=-0.5,
+    )
+    doc = rp._pair_doc(pair, pair_id(5, 2, 0))
+    assert doc["id"] == "v5:r2:p0"
+    # wire-safe: plain ints/floats/lists only
+    json.dumps(doc)
+    assert rp._pair_from_doc(doc) == pair
+
+
+# ---------------------------------------------------------------------------
+# RolloutService protocol (fake actor — no engine, pure protocol semantics)
+# ---------------------------------------------------------------------------
+
+
+class _FakeActor:
+    """Deterministic per (seed, version, round) — the real actor's
+    regeneration contract, without an engine."""
+
+    def __init__(self, seed=0, fail_after=None):
+        self.seed = seed
+        self.version = 0
+        self.rounds = 0
+        self.pairs_generated = 0
+        self.tokens_generated = 0
+        self.generate_seconds = 0.0
+        self.installs: list[tuple[int, object]] = []
+        self._fail_after = fail_after
+
+    @property
+    def tokens_per_sec(self):
+        return self.tokens_generated / max(self.generate_seconds, 1e-9)
+
+    def install_policy(self, version, tree):
+        if int(version) <= self.version:
+            return False
+        self.version = int(version)
+        self.installs.append((self.version, tree))
+        return True
+
+    def generate_pairs(self, n):
+        if self._fail_after is not None and self.rounds >= self._fail_after:
+            raise RuntimeError("synthetic actor fault")
+        self.rounds += 1
+        out = []
+        for i in range(n):
+            base = (self.seed * 811 + self.version * 97
+                    + self.rounds * 13 + i) % 23
+            prompt = (base % 7 + 1, (base + 1) % 7 + 1)
+            out.append(PreferencePair(
+                prompt=prompt,
+                chosen=prompt + ((base + 2) % 7 + 1,),
+                rejected=prompt + ((base + 3) % 7 + 1,),
+                version=self.version,
+                reward_chosen=1.0, reward_rejected=0.0,
+            ))
+        self.pairs_generated += n
+        self.tokens_generated += 2 * n
+        self.generate_seconds += 1e-4
+        return out
+
+
+def test_service_start_is_idempotent_and_pull_replays_identically():
+    svc = RolloutService(_FakeActor(seed=1), max_outbox_rounds=4)
+    try:
+        assert svc.start(2)["started"]
+        assert svc.start(2)["started"]  # re-delivered start: no second thread
+        assert _wait(lambda: svc.pull(0)["rounds"])
+        first = svc.pull(0, max_rounds=2)
+        again = svc.pull(0, max_rounds=2)
+        # a re-delivered pull replays byte-identical round documents
+        assert first["rounds"] == again["rounds"]
+        ids = [p["id"] for r in first["rounds"] for p in r["pairs"]]
+        assert len(ids) == len(set(ids))
+        assert all(r["span"]["end_ns"] >= r["span"]["start_ns"]
+                   for r in first["rounds"])
+    finally:
+        svc.stop()
+
+
+def test_service_ack_trims_and_backpressures_the_producer():
+    svc = RolloutService(_FakeActor(), max_outbox_rounds=3)
+    try:
+        svc.start(1)
+        # producer fills to the outbox bound, then parks
+        assert _wait(lambda: len(svc.pull(0)["rounds"]) == 3)
+        time.sleep(0.05)
+        out = svc.pull(0)
+        assert len(out["rounds"]) == 3  # bounded: no 4th round piled up
+        top = out["rounds"][-1]["seq"]
+        acked = svc.ack(out["rounds"][0]["seq"])
+        assert acked["acked"] == 1 and acked["outbox_depth"] == 2
+        # stale ack is a no-op
+        assert svc.ack(0)["acked"] == 0
+        # the ack woke the producer: new rounds continue PAST the old top
+        assert _wait(lambda: svc.pull(top)["rounds"])
+    finally:
+        svc.stop()
+
+
+def test_service_policy_push_is_monotonic_and_installs_between_rounds():
+    actor = _FakeActor()
+    svc = RolloutService(actor, max_outbox_rounds=64)
+    blob = tree_to_blob({"w": np.ones((2,), np.float32)})
+    try:
+        # pushed before start(): installs inline
+        assert svc.push_policy(3, blob)["accepted"]
+        assert actor.version == 3
+        svc.start(1)
+        assert _wait(lambda: svc.pull(0)["rounds"])
+        # stale and duplicate pushes are no-ops
+        assert not svc.push_policy(3, blob)["accepted"]
+        assert not svc.push_policy(2, blob)["accepted"]
+        assert actor.version == 3
+        # a newer push is installed by the producer between rounds
+        assert svc.push_policy(8, blob)["accepted"]
+        assert _wait(lambda: actor.version == 8)
+        top = svc.pull(0)["seq"]
+        svc.ack(top)  # unpark the (possibly backpressured) producer
+        assert _wait(lambda: any(
+            r["version"] == 8 for r in svc.pull(top)["rounds"]
+        ))
+        (v, tree), = actor.installs[-1:]
+        assert v == 8 and np.allclose(tree["w"], 1.0)
+    finally:
+        svc.stop()
+
+
+def test_service_producer_death_surfaces_on_pull_not_silently():
+    svc = RolloutService(_FakeActor(fail_after=2), max_outbox_rounds=64)
+    try:
+        svc.start(1)
+
+        def _died():
+            try:
+                svc.pull(0)
+                return False
+            except RuntimeError:
+                return True
+
+        assert _wait(_died)
+        with pytest.raises(RuntimeError, match="synthetic actor fault"):
+            svc.pull(0)
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# RolloutPlane (fake worker handles — dedup / respawn / policy re-push)
+# ---------------------------------------------------------------------------
+
+
+class _FakeBackend:
+    """One remote worker's deterministic round source, shared across its
+    incarnations: every incarnation regenerates the SAME rounds from seq 1
+    (the deterministic-regeneration contract that makes pair ids collide)."""
+
+    def __init__(self, seed, *, pairs_per_round=2, total_rounds=6,
+                 die_on_incarnation=None, die_after_pulls=2):
+        self.seed = seed
+        self.pairs_per_round = pairs_per_round
+        self.total_rounds = total_rounds
+        self.die_on_incarnation = die_on_incarnation
+        self.die_after_pulls = die_after_pulls
+        self.version = 0
+        self.events: list[tuple] = []
+
+    def make_round(self, seq):
+        pairs = []
+        for i in range(self.pairs_per_round):
+            base = (self.seed * 811 + seq * 13 + i) % 23
+            prompt = [base % 7 + 1, (base + 1) % 7 + 1]
+            pairs.append({
+                "id": pair_id(self.version, seq, i),
+                "prompt": prompt,
+                "chosen": prompt + [(base + 2) % 7 + 1],
+                "rejected": prompt + [(base + 3) % 7 + 1],
+                "version": self.version,
+                "reward_chosen": 1.0, "reward_rejected": 0.0,
+            })
+        return {
+            "seq": seq, "round": seq, "version": self.version,
+            "pairs": pairs,
+            "span": {"start_ns": seq * 1000, "end_ns": seq * 1000 + 500,
+                     "pairs": len(pairs), "tokens": 2 * len(pairs)},
+        }
+
+
+class _FakeHandle:
+    def __init__(self, backend: _FakeBackend, generation: int):
+        self.backend = backend
+        self.generation = generation
+        self.produced = 0
+        self.pulls = 0
+        self.closed = False
+
+    async def rollout_start(self, pairs_per_round):
+        self.backend.events.append(("start", self.generation))
+        return {"started": True, "seq": 0, "version": self.backend.version}
+
+    async def rollout_policy_version(self, version, blob):
+        self.backend.events.append(
+            ("policy", self.generation, int(version))
+        )
+        accepted = int(version) > self.backend.version
+        if accepted:
+            self.backend.version = int(version)
+        return {"accepted": accepted, "version": self.backend.version,
+                "pending": False}
+
+    async def rollout_pull(self, after_seq, max_rounds=8):
+        if self.closed:
+            raise ConnectionError("handle closed")
+        self.pulls += 1
+        b = self.backend
+        if (b.die_on_incarnation == self.generation
+                and self.pulls > b.die_after_pulls):
+            raise ConnectionError("worker killed")
+        self.produced = min(b.total_rounds, self.produced + 1)
+        rounds = [
+            b.make_round(s)
+            for s in range(int(after_seq) + 1, self.produced + 1)
+        ][: max_rounds]
+        return {
+            "rounds": rounds, "seq": self.produced, "version": b.version,
+            "stats": {"actor_tokens_per_sec": 42.0,
+                      "actor_version": b.version,
+                      "actor_tokens_generated": 2 * self.produced,
+                      "actor_generate_seconds": 0.01 * self.produced},
+        }
+
+    async def rollout_ack(self, up_to_seq):
+        return {"acked": 0, "outbox_depth": 0}
+
+    async def close(self, exc=None):
+        self.closed = True
+
+
+def _mk_plane(buffer, backends, **kw):
+    handles = []
+
+    async def spawn_fn(worker_id, generation):
+        h = _FakeHandle(backends[worker_id], generation)
+        handles.append(h)
+        return h
+
+    plane = RolloutPlane(
+        buffer, num_workers=len(backends), spawn_fn=spawn_fn,
+        pairs_per_round=2,
+        retry=RetryPolicy(max_attempts=10**9, base_delay_s=0.01,
+                          max_delay_s=0.05, seed=0),
+        idle_sleep_s=0.005, **kw,
+    )
+    return plane, handles
+
+
+def test_plane_respawns_dead_worker_and_dedups_regenerated_pairs():
+    backend = _FakeBackend(
+        seed=5, total_rounds=6, die_on_incarnation=1, die_after_pulls=3
+    )
+    buffer = RolloutBuffer(256)
+    plane, handles = _mk_plane(buffer, {"rollout-0": backend})
+    try:
+        plane.start()
+        # incarnation 1 dies after a few rounds; incarnation 2 regenerates
+        # from seq 1 and must stream through to the end
+        assert _wait(lambda: plane.respawns_total >= 1, timeout=20)
+        assert _wait(
+            lambda: buffer.pushed_total == 6 * backend.pairs_per_round,
+            timeout=20,
+        )
+        # exactly-once: every regenerated (replayed) pair was suppressed
+        assert plane.dup_pairs_total >= backend.pairs_per_round
+        assert buffer.pushed_total == 6 * backend.pairs_per_round
+        assert len(handles) >= 2
+        assert handles[0].generation == 1 and handles[-1].generation >= 2
+        assert plane.workers_alive() == 1
+        st = plane.stats()
+        assert st["rollout_respawns_total"] >= 1
+        assert st["rollout_dup_pairs_total"] == plane.dup_pairs_total
+        assert st["actor_tokens_per_sec"] == 42.0
+    finally:
+        plane.close()
+    assert all(h.closed for h in handles)
+
+
+def test_plane_repushes_cached_policy_to_respawned_worker_before_start():
+    backend = _FakeBackend(
+        seed=2, total_rounds=4, die_on_incarnation=1, die_after_pulls=2
+    )
+    buffer = RolloutBuffer(256)
+    plane, handles = _mk_plane(buffer, {"rollout-0": backend})
+    try:
+        plane.start()
+        assert _wait(lambda: buffer.pushed_total > 0, timeout=20)
+        plane.push_policy(7, {"w": np.ones((2,), np.float32)})
+        assert _wait(lambda: plane.respawns_total >= 1, timeout=20)
+        assert _wait(
+            lambda: ("policy", 2, 7) in backend.events, timeout=20
+        )
+        # the cached delta reached incarnation 2 BEFORE its stream started
+        gen2 = [e for e in backend.events if e[1] == 2]
+        assert gen2.index(("policy", 2, 7)) < gen2.index(("start", 2))
+        assert plane._policy is not None and plane._policy[0] == 7
+    finally:
+        plane.close()
+
+
+def test_remote_stream_ships_committed_checkpoints_and_evicts_stale():
+    class _FakeReader:
+        def __init__(self):
+            self.step = None
+
+        def latest_step(self):
+            return self.step
+
+        def restore(self, step, like=None):
+            assert like is not None  # shape-validated restore path
+            return {"trainable": {"w": np.full((2,), float(step),
+                                               np.float32)}}
+
+    # unbounded round supply: fresh (post-rollover) rounds must keep
+    # arriving after the staleness eviction empties the buffer
+    backend = _FakeBackend(seed=9, total_rounds=10**9)
+    buffer = RolloutBuffer(256, version_granularity=1)
+    plane, handles = _mk_plane(buffer, {"rollout-0": backend})
+    reader = _FakeReader()
+    rollout = RolloutConfig(pairs_per_round=2, min_fill=4,
+                            staleness_checkpoints=1)
+    try:
+        plane.start()
+        stream = remote_rollout_batch_stream(
+            plane, reader, {"trainable": None},
+            batch_size=2, seq_len=8, checkpoint_every=1, rollout=rollout,
+            fill_timeout_s=30.0,
+        )
+        batch = next(stream)
+        assert batch["chosen_tokens"].shape == (2, 8)
+        assert not any(e[0] == "policy" for e in backend.events)
+        # a committed checkpoint ships its trainable tree to the fleet...
+        reader.step = 5
+        next(stream)
+        assert ("policy", 1, 5) in backend.events
+        assert plane._policy[0] == 5
+        blob_tree = tree_from_blob(plane._policy[1])
+        assert np.allclose(blob_tree["w"], 5.0)
+        # ...and the staleness watermark evicts every pre-rollover pair
+        _wait(lambda: buffer.depth >= rollout.min_fill, timeout=20)
+        next(stream)
+        assert all(p.version >= 4 for p in buffer._pairs)
+        assert buffer.evicted_stale_total > 0
+    finally:
+        plane.close()
+
+
+# ---------------------------------------------------------------------------
+# loopback rollout worker: the real RPC surface over the real wire
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_worker_loopback_protocol_and_deterministic_regen(tmp_path):
+    from finetune_controller_tpu.transport.client import (
+        RemoteReplica,
+        _Connection,
+    )
+    from finetune_controller_tpu.transport.worker import (
+        WorkerSpec,
+        build_worker,
+    )
+
+    def _spec(sandbox):
+        return WorkerSpec(
+            job_id="rl-loop", replica_id="w0", sandbox=str(sandbox),
+            builder="tiny_test", builder_kwargs={}, engine={}, batcher={},
+            rollout={"seq_len": 16, "prompt_fraction": 0.5,
+                     "max_new_tokens": 8, "slots": 2, "seed": 11},
+            warm_start=False,
+        )
+
+    async def _harvest(sandbox, n_rounds):
+        os.makedirs(sandbox, exist_ok=True)
+        server = build_worker(_spec(sandbox), exit_on_drain=False)
+        port = await server.start()
+        conn = await _Connection.open("127.0.0.1", port)
+        hello = await conn.call("hello", {}, timeout_s=30)
+        rep = RemoteReplica("w0", conn, hello, sandbox=str(sandbox),
+                            heartbeat_interval_s=0.5)
+        try:
+            assert (await rep.rollout_start(2))["started"]
+            assert (await rep.rollout_start(2))["started"]  # idempotent
+            deadline = time.monotonic() + 120
+            rounds = []
+            while len(rounds) < n_rounds:
+                assert time.monotonic() < deadline, "no rollout rounds"
+                out = await rep.rollout_pull(0, max_rounds=n_rounds)
+                rounds = out["rounds"]
+                await asyncio.sleep(0.05)
+            # replayed pull returns byte-identical documents per seq
+            replay = {
+                r["seq"]: r
+                for r in (await rep.rollout_pull(0, n_rounds))["rounds"]
+            }
+            for r in rounds:
+                assert replay[r["seq"]]["pairs"] == r["pairs"]
+            # ack trims: seq 1 never comes back
+            await rep.rollout_ack(rounds[0]["seq"])
+            left = (await rep.rollout_pull(0, 64))["rounds"]
+            assert all(r["seq"] > rounds[0]["seq"] for r in left)
+            # stale policy push is a no-op over the wire too
+            out = await rep.rollout_policy_version(0, None)
+            assert not out["accepted"]
+            return rounds[:n_rounds]
+        finally:
+            await rep.close()
+            await server.stop()
+
+    async def main():
+        first = await _harvest(tmp_path / "a", 2)
+        ids = [p["id"] for r in first for p in r["pairs"]]
+        assert ids and len(ids) == len(set(ids))
+        assert all(p["reward_chosen"] >= p["reward_rejected"]
+                   for r in first for p in r["pairs"])
+        # a FRESH worker from the same spec (same seed) regenerates the
+        # same rounds under the same ids — the exactly-once foundation
+        second = await _harvest(tmp_path / "b", 2)
+        assert [r["pairs"] for r in second] == [r["pairs"] for r in first]
+
+    run_async(main())
+
+
+# ---------------------------------------------------------------------------
+# rollout_base artifact round trip
+# ---------------------------------------------------------------------------
+
+
+def test_write_rollout_base_builder_roundtrip(tmp_path):
+    import jax
+
+    from finetune_controller_tpu.transport.builders import (
+        resolve_builder,
+        tiny_test,
+    )
+
+    model, variables = tiny_test()
+    base = write_rollout_base(
+        str(tmp_path), {"preset": "tiny-test"},
+        dict(variables)["params"],
+    )
+    assert os.path.exists(os.path.join(base, "model.json"))
+    model2, variables2 = resolve_builder("rollout_base")(dir=str(tmp_path))
+    assert model2.cfg.vocab_size == model.cfg.vocab_size
+    jax.tree.map(
+        np.testing.assert_array_equal,
+        jax.tree.map(np.asarray, dict(variables)["params"]),
+        jax.tree.map(np.asarray, dict(variables2)["params"]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# RewardScorer
+# ---------------------------------------------------------------------------
+
+
+def test_reward_scorer_matches_reference_math(tmp_path):
+    import jax.numpy as jnp
+
+    from finetune_controller_tpu.data.preference import _pad_pair
+    from finetune_controller_tpu.prefs.losses import reward_scores
+    from finetune_controller_tpu.transport.builders import tiny_test
+
+    model, variables = tiny_test()
+    vocab = int(model.cfg.vocab_size)
+    head = {
+        "a": np.ones((), np.float32),
+        "w": np.zeros((vocab,), np.float32),
+        "b": np.zeros((), np.float32),
+    }
+    scorer = RewardScorer(model, variables, head)
+    items = [
+        {"prompt": [1, 2, 3], "completion": [4, 5]},
+        {"prompt": [2, 2], "completion": [6, 1, 3]},
+        {"prompt": [5], "completion": [7]},
+    ]
+    scores = scorer.score(items)
+    assert len(scores) == 3 and all(np.isfinite(scores))
+    assert scorer.scored_total == 3
+    # reference: unbatched reward_scores over the same padding
+    for it, got in zip(items, scores):
+        t, m = _pad_pair(it["prompt"], it["completion"], 8)
+        logits = model.apply(
+            variables, jnp.asarray(t[None], jnp.int32), deterministic=True
+        )
+        ref = reward_scores(
+            logits, jnp.asarray(t[None], jnp.int32),
+            jnp.asarray(m[None], jnp.float32),
+            {k: jnp.asarray(v) for k, v in head.items()},
+        )
+        assert abs(float(ref[0]) - got) < 1e-4
+    # pow2 bucketing: batch of 3 and batch of 1 hit two compiled shapes only
+    scorer.score(items[:1])
+    assert set(scorer._fns) <= {(4, 8), (1, 8)}
+
+
+def test_reward_scorer_from_artifacts_msgpack_and_missing(tmp_path):
+    from flax import serialization
+
+    from finetune_controller_tpu.transport.builders import tiny_test
+
+    model, variables = tiny_test()
+    vocab = int(model.cfg.vocab_size)
+    head = {
+        "a": np.float32(1.0),
+        "w": np.zeros((vocab,), np.float32),
+        "b": np.float32(0.5),
+    }
+    with open(tmp_path / rp.REWARD_HEAD_FILENAME, "wb") as f:
+        f.write(serialization.msgpack_serialize(head))
+    scorer = RewardScorer.from_artifacts(str(tmp_path), model, variables)
+    assert float(scorer._head["b"]) == 0.5
+    with pytest.raises(FileNotFoundError, match="task: reward"):
+        RewardScorer.from_artifacts(str(tmp_path / "nope"), model, variables)
+
+
+# ---------------------------------------------------------------------------
+# scheduler accounting: RolloutTenant
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_tenant_accounting_and_preemption_intake():
+    from finetune_controller_tpu.controller.devices import (
+        DeviceCatalog,
+        DeviceFlavor,
+        FlavorQuota,
+    )
+    from finetune_controller_tpu.sched import FairShareScheduler
+    from finetune_controller_tpu.sched.serve_tenant import (
+        ROLLOUT_QUEUE,
+        RolloutTenant,
+    )
+
+    catalog = DeviceCatalog(
+        flavors=[DeviceFlavor(name="chip", generation="cpu", hosts=1,
+                              chips_per_host=1, runtime="cpu", queue="q")],
+        quotas=[FlavorQuota(flavor="chip", nominal_chips=2)],
+        default_flavor="chip",
+    )
+    sched = FairShareScheduler(catalog, {ROLLOUT_QUEUE: 1.0, "train": 1.0})
+    tenant = RolloutTenant(sched, "job1", flavor="chip")
+    tenant.submit("rollout-0")
+    tenant.submit("rollout-1")
+    sched.try_admit()
+    assert tenant.is_admitted("rollout-0")
+    summary = tenant.tick()
+    assert sorted(summary["admitted"]) == ["rollout-0", "rollout-1"]
+    assert summary["preempted"] == []
+    # a normal-priority training job reclaims a low-priority rollout chip
+    sched.submit("train-1", "chip", 1, queue="train", priority="normal")
+    sched.try_admit()
+    summary = tenant.tick()
+    assert len(summary["preempted"]) == 1
+    assert tenant.preempted_total == 1
+    assert len(summary["admitted"]) == 1
+    sched.try_admit()
+    assert sched.is_admitted("train-1")
+    tenant.close()
+    assert tenant.stats()["workloads"] == {}
+
+
+# ---------------------------------------------------------------------------
+# DPOTrainer coupling: forced only for the IN-PROCESS rlhf loop
+# ---------------------------------------------------------------------------
+
+
+def _tiny_model_cfg():
+    return PRESETS["tiny-test"].replace(lora=LoRAConfig(rank=4))
+
+
+def test_inprocess_rlhf_forces_prefetch_zero_and_blocking_commits():
+    cfg = TrainConfig(task="rlhf", batch_size=2, seq_len=16, total_steps=4,
+                      prefetch=2)
+    trainer = DPOTrainer(_tiny_model_cfg(), cfg)
+    assert cfg.prefetch == 0
+    assert trainer._blocking_checkpoints is True
+
+
+def test_remote_rlhf_keeps_prefetch_and_async_commits():
+    cfg = TrainConfig(task="rlhf", batch_size=2, seq_len=16, total_steps=4,
+                      prefetch=2, rollout_workers=2)
+    trainer = DPOTrainer(_tiny_model_cfg(), cfg)
+    # disaggregation's whole point: actors decode elsewhere, so the learner
+    # keeps background prefetch AND async checkpoint commits
+    assert cfg.prefetch == 2
+    assert trainer._blocking_checkpoints is False
+    # the remote-plane health columns ride the metrics header
+    trainer.rollout_stats_fn = lambda: {}
+    fields = trainer._writer_extra_fields(False)
+    assert "rollout_workers_alive" in fields
+    assert "rollout_respawns_total" in fields
+
+
+def test_dpo_task_never_touches_prefetch():
+    cfg = TrainConfig(task="dpo", batch_size=2, seq_len=16, total_steps=4,
+                      prefetch=3)
+    trainer = DPOTrainer(_tiny_model_cfg(), cfg)
+    assert cfg.prefetch == 3
+    assert trainer._blocking_checkpoints is False
+
+
+# ---------------------------------------------------------------------------
+# slow e2e: real worker processes
+# ---------------------------------------------------------------------------
+
+
+def _remote_loop(tmp_path, monkeypatch, *, total_steps, checkpoint_every,
+                 trace_id=""):
+    monkeypatch.setenv("FTC_TRACE_ID", trace_id)
+    cfg = TrainConfig(
+        task="rlhf", batch_size=2, seq_len=16, total_steps=total_steps,
+        warmup_steps=1, learning_rate=1e-3, log_every=1,
+        checkpoint_every=checkpoint_every, prefetch=0,
+        heartbeat_interval_s=0, rollout_workers=1, trace=bool(trace_id),
+    )
+    learner = DPOTrainer(_tiny_model_cfg(), cfg)
+    stream, plane, buffer = build_remote_rlhf_loop(
+        learner, str(tmp_path),
+        rollout=RolloutConfig(pairs_per_round=4, min_fill=4,
+                              buffer_capacity=128, max_new_tokens=8,
+                              slots=2, temperature=0.9),
+        model_spec={"preset": "tiny-test", "lora": {"rank": 4}},
+    )
+    return learner, stream, plane, buffer
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_remote_worker_streams_resume_exactly_once(
+        tmp_path, monkeypatch):
+    """SIGKILL the rollout worker mid-round: the learner keeps stepping on
+    buffered pairs, the plane respawns the worker with backoff and streaming
+    resumes, and the dedup admits NO pair twice."""
+    ingested: list[str] = []
+    real = rp._pair_from_doc
+
+    def _spy(doc):
+        ingested.append(str(doc["id"]))  # called only for FRESH pairs
+        return real(doc)
+
+    monkeypatch.setattr(rp, "_pair_from_doc", _spy)
+    learner, stream, plane, _buf = _remote_loop(
+        tmp_path, monkeypatch, total_steps=10**9, checkpoint_every=10**9
+    )
+    try:
+        state = learner.init_state()
+        b = next(stream)
+        state, m = learner.step(state, b)
+        assert np.isfinite(float(m["reward_margin"]))
+        assert _wait(lambda: plane.workers_alive() == 1, timeout=30)
+        pid = plane._workers[0].handle.pid
+        rounds_before = plane.rounds_received_total
+        os.kill(pid, signal.SIGKILL)
+        # the learner never stops: buffered pairs keep feeding steps while
+        # the worker is down and respawning (the respawn pays a fresh
+        # process spawn + XLA compile, so bound by time, not step count)
+        steps_during_outage = 0
+        deadline = time.monotonic() + 300
+        while plane.respawns_total < 1 and time.monotonic() < deadline:
+            state, m = learner.step(state, plane.sample_batch(2, 16))
+            float(m["reward_margin"])
+            steps_during_outage += 1
+        assert plane.respawns_total >= 1, "worker was never respawned"
+        assert steps_during_outage >= 1
+        # streaming resumes: fresh rounds arrive from the new incarnation
+        assert _wait(
+            lambda: plane.rounds_received_total > rounds_before, timeout=180
+        ), "respawned worker never resumed streaming"
+        new_pid = plane._workers[0].handle.pid
+        assert new_pid != pid
+    finally:
+        plane.close()
+    # exactly-once: every pair that entered the buffer did so ONCE — the
+    # respawned worker regenerated earlier rounds (same seed, reset cursor)
+    # and the dedup suppressed every replay
+    assert len(ingested) == len(set(ingested)), (
+        "duplicate pair entered the buffer"
+    )
+    assert plane.dup_pairs_total >= 0
+
+
+@pytest.mark.slow
+def test_remote_overlap_spans_and_policy_rollover_e2e(tmp_path, monkeypatch):
+    """The PR-9 timeline proof: rollout.round spans (worker-stamped) overlap
+    learner step intervals, and a committed checkpoint rolls the fleet's
+    policy over as an adapter delta without restarting the worker."""
+    from finetune_controller_tpu.obs.trace import (
+        TRACE_DIRNAME,
+        TRAINER_SPANS_FILENAME,
+    )
+
+    learner, stream, plane, _buf = _remote_loop(
+        tmp_path, monkeypatch, total_steps=4, checkpoint_every=2,
+        trace_id="trace-rl",
+    )
+    step_intervals = []
+    try:
+        state = learner.init_state()
+        b = next(stream)
+        state, _ = learner.step(state, b)  # compile before timing
+        pid0 = plane._workers[0].handle.pid
+        for _ in range(8):
+            b = next(stream)
+            t0 = time.time_ns()
+            state, m = learner.step(state, b)
+            float(m["reward_margin"])  # device sync closes the interval
+            step_intervals.append((t0, time.time_ns()))
+        # rollover: commit a checkpoint, then the stream's next() ships it
+        learner.fit(stream, str(tmp_path), resume=True)
+        next(stream)
+        assert plane._policy is not None and plane._policy[0] >= 4
+        assert _wait(
+            lambda: plane.stats()["actor_version"] >= 4, timeout=120
+        ), "fleet never installed the pushed adapter delta"
+        # rollover was a push, not a worker restart
+        assert plane._workers[0].handle.pid == pid0
+        assert plane.respawns_total == 0
+    finally:
+        plane.close()
+    spans_path = os.path.join(
+        str(tmp_path), TRACE_DIRNAME, TRAINER_SPANS_FILENAME
+    )
+    with open(spans_path) as f:
+        spans = [json.loads(line) for line in f]
+    rollout_spans = [
+        s for s in spans
+        if s["name"] == "rollout.round"
+        and s.get("attributes", {}).get("service") == "rollout"
+    ]
+    assert rollout_spans, "no rollout.round spans in the trace"
+    overlapped = any(
+        s["start_ns"] < t1 and s["end_ns"] > t0
+        for s in rollout_spans
+        for (t0, t1) in step_intervals
+    )
+    assert overlapped, (
+        "no rollout round overlapped a learner step — generation and "
+        "training serialized"
+    )
